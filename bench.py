@@ -1,0 +1,72 @@
+"""Benchmark entry point — prints ONE JSON line for the driver.
+
+Flagship config (BASELINE.json config 1 for now; upgraded to BERT-base as
+the op/model inventory widens): LeNet-class CNN training throughput,
+static-graph fluid-style Executor on one chip.
+"""
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    from paddle_tpu.framework.place import _default_place
+    from paddle_tpu.framework.program import Program, program_guard
+    from paddle_tpu.optimizer import MomentumOptimizer
+
+    batch = 256
+    main_p, startup = Program(), Program()
+    main_p.random_seed = 1
+    with program_guard(main_p, startup):
+        img = layers.data("img", [1, 28, 28])
+        label = layers.data("label", [1], dtype="int64")
+        c1 = layers.conv2d(img, 32, 5, padding=2, act="relu")
+        p1 = layers.pool2d(c1, 2, "max", 2)
+        c2 = layers.conv2d(p1, 64, 5, padding=2, act="relu")
+        p2 = layers.pool2d(c2, 2, "max", 2)
+        f1 = layers.fc(p2, 512, act="relu")
+        logits = layers.fc(f1, 10)
+        loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
+        MomentumOptimizer(0.01, 0.9).minimize(loss)
+
+    place = _default_place()
+    exe = pt.Executor(place)
+    exe.run(startup)
+
+    rng = np.random.RandomState(0)
+    imgs = rng.randn(batch, 1, 28, 28).astype("float32")
+    labels = rng.randint(0, 10, (batch, 1)).astype("int64")
+    feed = {"img": imgs, "label": labels}
+
+    # warmup (compile)
+    for _ in range(3):
+        exe.run(main_p, feed=feed, fetch_list=[loss])
+
+    iters = 50
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = exe.run(main_p, feed=feed, fetch_list=[loss])
+    _ = float(np.asarray(out[0])[0])  # force sync
+    dt = time.perf_counter() - t0
+
+    ips = batch * iters / dt
+    # A100 reference for this config (small CNN, fp32): ~60k img/s; target
+    # is >=0.9x per BASELINE.json.
+    baseline = 60000.0
+    print(
+        json.dumps(
+            {
+                "metric": "lenet_mnist_images_per_sec",
+                "value": round(ips, 1),
+                "unit": "images/sec/chip",
+                "vs_baseline": round(ips / (0.9 * baseline), 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,26 @@
+"""paddle_tpu: a TPU-native deep learning framework.
+
+Capability parity with the fluid-era PaddlePaddle reference (see SURVEY.md),
+built on JAX/XLA/Pallas: programs are a protobuf graph IR whose blocks
+compile to single XLA computations; collectives lower to XLA collectives
+over a device mesh.
+"""
+from . import framework  # noqa: F401
+from . import ops  # noqa: F401
+from . import initializer, layers, optimizer, regularizer  # noqa: F401
+from . import fluid  # noqa: F401
+from .framework.backward import append_backward, calc_gradient  # noqa: F401
+from .param_attr import ParamAttr  # noqa: F401
+from .framework import (  # noqa: F401
+    CPUPlace,
+    CUDAPlace,
+    Executor,
+    Program,
+    TPUPlace,
+    default_main_program,
+    default_startup_program,
+    global_scope,
+    program_guard,
+)
+
+__version__ = "0.1.0"

@@ -1,0 +1,43 @@
+"""``fluid``-compatible namespace so reference-era user scripts port directly.
+
+Role parity: python/paddle/fluid/__init__.py of the reference.
+"""
+from .. import initializer, layers, optimizer, regularizer  # noqa: F401
+from ..framework import (  # noqa: F401
+    CPUPlace,
+    CUDAPinnedPlace,
+    CUDAPlace,
+    Executor,
+    Program,
+    Scope,
+    TPUPlace,
+    Variable,
+    default_main_program,
+    default_startup_program,
+    global_scope,
+    is_compiled_with_cuda,
+    is_compiled_with_tpu,
+    program_guard,
+)
+from ..framework import unique_name  # noqa: F401
+from ..framework.backward import append_backward, calc_gradient  # noqa: F401
+from ..layers import data  # noqa: F401
+from ..param_attr import ParamAttr, WeightNormParamAttr  # noqa: F401
+
+# fluid.io arrives with the checkpoint milestone; fluid.dygraph with dygraph.
+
+
+def scope_guard(scope):
+    import contextlib
+
+    from ..framework.scope import _switch_scope
+
+    @contextlib.contextmanager
+    def _guard():
+        old = _switch_scope(scope)
+        try:
+            yield
+        finally:
+            _switch_scope(old)
+
+    return _guard()

@@ -1,0 +1,24 @@
+from . import ir_pb2  # noqa: F401
+from .dtypes import to_enum, to_jnp, to_np, to_str  # noqa: F401
+from .executor import Executor  # noqa: F401
+from .place import (  # noqa: F401
+    CPUPlace,
+    CUDAPinnedPlace,
+    CUDAPlace,
+    Place,
+    TPUPlace,
+    is_compiled_with_cuda,
+    is_compiled_with_tpu,
+)
+from .program import (  # noqa: F401
+    Block,
+    Operator,
+    Parameter,
+    Program,
+    Variable,
+    default_main_program,
+    default_startup_program,
+    grad_var_name,
+    program_guard,
+)
+from .scope import Scope, global_scope  # noqa: F401

@@ -1,0 +1,95 @@
+"""Dtype bridging between the IR enum, numpy, and jax.
+
+Role parity: reference framework.proto VarType::Type + data_type.h maps
+(`framework::proto::VarType::FP32` etc.) — here a single table keyed by the
+proto enum in paddle_tpu/proto/ir.proto.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import ir_pb2
+
+# Public names mirror the reference's string dtype vocabulary so user code
+# like ``fluid.data(..., dtype='float32')`` works unchanged.
+_STR_TO_ENUM = {
+    "float32": ir_pb2.DT_FP32,
+    "float64": ir_pb2.DT_FP64,
+    "float16": ir_pb2.DT_FP16,
+    "bfloat16": ir_pb2.DT_BF16,
+    "int8": ir_pb2.DT_INT8,
+    "int16": ir_pb2.DT_INT16,
+    "int32": ir_pb2.DT_INT32,
+    "int64": ir_pb2.DT_INT64,
+    "uint8": ir_pb2.DT_UINT8,
+    "uint16": ir_pb2.DT_UINT16,
+    "uint32": ir_pb2.DT_UINT32,
+    "uint64": ir_pb2.DT_UINT64,
+    "bool": ir_pb2.DT_BOOL,
+    "complex64": ir_pb2.DT_COMPLEX64,
+    "complex128": ir_pb2.DT_COMPLEX128,
+}
+
+_ENUM_TO_STR = {v: k for k, v in _STR_TO_ENUM.items()}
+
+
+def to_enum(dtype) -> int:
+    """Normalize a dtype spec (str | np.dtype | jnp dtype | enum) to the IR enum."""
+    if isinstance(dtype, int):
+        if dtype not in _ENUM_TO_STR and dtype != ir_pb2.DT_UNDEFINED:
+            raise ValueError(f"unknown dtype enum {dtype}")
+        return dtype
+    if isinstance(dtype, str):
+        if dtype not in _STR_TO_ENUM:
+            raise ValueError(f"unknown dtype string {dtype!r}")
+        return _STR_TO_ENUM[dtype]
+    # numpy / jax dtype objects (incl. ml_dtypes.bfloat16)
+    name = np.dtype(dtype).name if not hasattr(dtype, "name") else dtype.name
+    if name not in _STR_TO_ENUM:
+        name = np.dtype(dtype).name
+    if name not in _STR_TO_ENUM:
+        raise ValueError(f"unknown dtype {dtype!r}")
+    return _STR_TO_ENUM[name]
+
+
+def to_str(dtype) -> str:
+    return _ENUM_TO_STR[to_enum(dtype)]
+
+
+def to_np(dtype):
+    """IR enum/str -> numpy dtype (bfloat16 via ml_dtypes)."""
+    s = to_str(dtype)
+    if s == "bfloat16":
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(s)
+
+
+def to_jnp(dtype):
+    import jax.numpy as jnp
+
+    s = to_str(dtype)
+    return jnp.dtype(s)
+
+
+def is_floating(dtype) -> bool:
+    return to_enum(dtype) in (
+        ir_pb2.DT_FP32,
+        ir_pb2.DT_FP64,
+        ir_pb2.DT_FP16,
+        ir_pb2.DT_BF16,
+    )
+
+
+def is_integer(dtype) -> bool:
+    return to_enum(dtype) in (
+        ir_pb2.DT_INT8,
+        ir_pb2.DT_INT16,
+        ir_pb2.DT_INT32,
+        ir_pb2.DT_INT64,
+        ir_pb2.DT_UINT8,
+        ir_pb2.DT_UINT16,
+        ir_pb2.DT_UINT32,
+        ir_pb2.DT_UINT64,
+    )

@@ -1,0 +1,135 @@
+"""Op lowering registry: IR op -> jax/XLA emission.
+
+Role parity: this registry replaces the reference's entire kernel dispatch
+machinery — OpRegistry/OpKernelType (op_registry.h:256, op_kernel_type.h)
+and OperatorWithKernel::RunImpl's choose/prepare/infershape/launch sequence
+(operator.cc:1017-1141).  TPU-native: there is no per-step dispatch at all;
+each rule runs **once at trace time**, emitting jax ops into the single XLA
+computation the Executor compiles.  Kernel selection by (place, dtype,
+layout, library) collapses to "XLA decides".
+
+A rule has signature ``rule(ctx, op) -> None`` and communicates through the
+trace environment (``ctx.get``/``ctx.set``).
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+LOWERINGS: Dict[str, Callable] = {}
+
+# ops the executor itself handles (data movement endpoints)
+PSEUDO_OPS = {"feed", "fetch"}
+
+
+def register_lower(*op_types: str):
+    def deco(fn):
+        for t in op_types:
+            if t in LOWERINGS:
+                raise RuntimeError(f"duplicate lowering for op {t!r}")
+            LOWERINGS[t] = fn
+        return fn
+
+    return deco
+
+
+# installed by ops/grad_generic.py: fallback for unregistered *_grad ops
+GENERIC_GRAD_LOWERING: Optional[Callable] = None
+
+
+def get_lowering(op_type: str) -> Callable:
+    try:
+        return LOWERINGS[op_type]
+    except KeyError:
+        if op_type.endswith("_grad") and GENERIC_GRAD_LOWERING is not None:
+            return GENERIC_GRAD_LOWERING
+        raise NotImplementedError(
+            f"no TPU lowering registered for op {op_type!r}; "
+            f"{len(LOWERINGS)} ops available"
+        ) from None
+
+
+class LoweringContext:
+    """Trace-time environment for one block lowering.
+
+    ``env`` maps var name -> traced jax value (SSA: last write wins, which
+    reproduces the reference's scope-mutation semantics inside a functional
+    program — SURVEY.md §7 'In-place/aliasing').
+    """
+
+    def __init__(self, block, env: dict, rng_key=None, mesh=None, axis_env=()):
+        self.block = block
+        self.program = block.program
+        self.env = env
+        self._rng = rng_key
+        self.mesh = mesh
+        # names of spmd axes currently in scope (inside shard_map)
+        self.axis_env = tuple(axis_env)
+        self.rng_consumed = False
+
+    # -- values -----------------------------------------------------------
+    def get(self, name: str):
+        if name not in self.env:
+            raise KeyError(
+                f"op input {name!r} is not defined at this point in the program "
+                "(not a feed, not in scope, not produced by an earlier op)"
+            )
+        return self.env[name]
+
+    def get_opt(self, name: Optional[str]):
+        if not name:
+            return None
+        return self.env.get(name)
+
+    def set(self, name: str, value):
+        self.env[name] = value
+
+    # -- op slot helpers ---------------------------------------------------
+    def in1(self, op, slot: str):
+        names = op.inputs.get(slot, [])
+        return self.get(names[0]) if names else None
+
+    def in_list(self, op, slot: str) -> List:
+        return [self.get(n) for n in op.inputs.get(slot, [])]
+
+    def out_name(self, op, slot: str) -> Optional[str]:
+        names = op.outputs.get(slot, [])
+        return names[0] if names else None
+
+    def set_out(self, op, slot: str, value):
+        name = self.out_name(op, slot)
+        if name is not None:
+            self.env[name] = value
+
+    def var_dtype(self, name: str):
+        from . import dtypes
+
+        v = self.block._find_var_recursive(name)
+        return dtypes.to_jnp(v.dtype if v is not None else "float32")
+
+    # -- randomness --------------------------------------------------------
+    def next_key(self):
+        import jax
+
+        if self._rng is None:
+            raise RuntimeError("program uses random ops but no RNG key was threaded")
+        self.rng_consumed = True
+        self._rng, k = jax.random.split(self._rng)
+        return k
+
+    @property
+    def rng_key(self):
+        return self._rng
+
+    def lower_op(self, op):
+        get_lowering(op.type)(self, op)
+
+    def lower_block(self, block):
+        old = self.block
+        self.block = block
+        try:
+            for op in block.ops:
+                if op.type in PSEUDO_OPS:
+                    continue
+                self.lower_op(op)
+        finally:
+            self.block = old

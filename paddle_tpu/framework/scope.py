@@ -1,0 +1,102 @@
+"""Scope: runtime variable storage (name -> device array).
+
+Role parity: reference paddle/fluid/framework/scope.h:52 (hierarchical
+name->Variable maps) and tensor.h:46.  TPU-native simplification: values are
+jax Arrays owned by PJRT; a Scope is a flat dict with an optional parent
+chain.  There is no per-op lookup on the hot path — the Executor gathers the
+state tuple once per compiled step.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+
+class _TensorView:
+    """Minimal ``.get_tensor()`` compatibility object."""
+
+    def __init__(self, scope: "Scope", name: str):
+        self._scope = scope
+        self._name = name
+
+    def set(self, array, place=None):
+        self._scope.set_var(self._name, np.asarray(array), place)
+
+    def shape(self):
+        v = self._scope.get_var(self._name)
+        return list(v.shape)
+
+    def __array__(self, dtype=None):
+        arr = np.asarray(self._scope.get_var(self._name))
+        return arr.astype(dtype) if dtype is not None else arr
+
+
+class _VarView:
+    def __init__(self, scope: "Scope", name: str):
+        self._scope = scope
+        self._name = name
+
+    def get_tensor(self) -> _TensorView:
+        return _TensorView(self._scope, self._name)
+
+
+class Scope:
+    def __init__(self, parent: Optional["Scope"] = None):
+        self._vars: Dict[str, object] = {}
+        self._parent = parent
+        self._kids = []
+
+    # -- core -------------------------------------------------------------
+    def has_var(self, name: str) -> bool:
+        return name in self._vars or (self._parent is not None and self._parent.has_var(name))
+
+    def get_var(self, name: str):
+        s = self
+        while s is not None:
+            if name in s._vars:
+                return s._vars[name]
+            s = s._parent
+        raise KeyError(f"variable {name!r} not found in scope")
+
+    def set_var(self, name: str, value, place=None):
+        if place is not None:
+            import jax
+
+            value = jax.device_put(value, place.jax_device())
+        self._vars[name] = value
+
+    def erase(self, name: str):
+        self._vars.pop(name, None)
+
+    def local_var_names(self):
+        return list(self._vars)
+
+    def new_scope(self) -> "Scope":
+        kid = Scope(self)
+        self._kids.append(kid)
+        return kid
+
+    def drop_kids(self):
+        self._kids.clear()
+
+    # -- reference-api compatibility --------------------------------------
+    def var(self, name: str) -> _VarView:
+        self._vars.setdefault(name, None)
+        return _VarView(self, name)
+
+    def find_var(self, name: str) -> Optional[_VarView]:
+        return _VarView(self, name) if self.has_var(name) else None
+
+
+_global_scope = Scope()
+
+
+def global_scope() -> Scope:
+    return _global_scope
+
+
+def _switch_scope(scope: Scope) -> Scope:
+    global _global_scope
+    old, _global_scope = _global_scope, scope
+    return old

@@ -1,0 +1,22 @@
+"""Op lowering library: importing this package registers all lowering rules.
+
+Layer parity: reference paddle/fluid/operators/ (657 REGISTER_OPERATOR
+sites) — here each op is a trace-time jax emission rule (SURVEY.md §2.4
+'TPU equivalent').
+"""
+from . import (  # noqa: F401
+    activations,
+    creation,
+    grad_generic,
+    math_ops,
+    misc,
+    nn_ops,
+    optimizer_ops,
+    tensor_ops,
+)
+
+from ..framework.lowering import LOWERINGS
+
+
+def registered_ops():
+    return sorted(LOWERINGS)

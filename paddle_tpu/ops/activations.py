@@ -1,0 +1,96 @@
+"""Activation ops (reference operators/activation_op.cc — 60+ activations)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.lowering import register_lower
+
+
+def _unary(fn):
+    def lower(ctx, op):
+        ctx.set_out(op, "Out", fn(ctx.in1(op, "X"), op))
+
+    return lower
+
+
+_SIMPLE = {
+    "relu": lambda x, op: jax.nn.relu(x),
+    "relu6": lambda x, op: jnp.clip(x, 0.0, float(op.attr("threshold", 6.0))),
+    "sigmoid": lambda x, op: jax.nn.sigmoid(x),
+    "tanh": lambda x, op: jnp.tanh(x),
+    "tanh_shrink": lambda x, op: x - jnp.tanh(x),
+    "softplus": lambda x, op: jax.nn.softplus(x),
+    "softsign": lambda x, op: x / (1 + jnp.abs(x)),
+    "softshrink": lambda x, op: _softshrink(x, float(op.attr("lambda", 0.5))),
+    "hard_shrink": lambda x, op: jnp.where(
+        jnp.abs(x) > float(op.attr("threshold", 0.5)), x, jnp.zeros_like(x)
+    ),
+    "hard_sigmoid": lambda x, op: jnp.clip(
+        float(op.attr("slope", 0.2)) * x + float(op.attr("offset", 0.5)), 0.0, 1.0
+    ),
+    "hard_swish": lambda x, op: x
+    * jnp.clip(x + float(op.attr("offset", 3.0)), 0.0, float(op.attr("threshold", 6.0)))
+    / float(op.attr("scale", 6.0)),
+    "swish": lambda x, op: x * jax.nn.sigmoid(float(op.attr("beta", 1.0)) * x),
+    "silu": lambda x, op: jax.nn.silu(x),
+    "mish": lambda x, op: x * jnp.tanh(jax.nn.softplus(x)),
+    "elu": lambda x, op: jax.nn.elu(x, alpha=float(op.attr("alpha", 1.0))),
+    "celu": lambda x, op: jax.nn.celu(x, alpha=float(op.attr("alpha", 1.0))),
+    "selu": lambda x, op: float(op.attr("scale", 1.0507009873554805))
+    * jnp.where(
+        x > 0,
+        x,
+        float(op.attr("alpha", 1.6732632423543772)) * (jnp.exp(x) - 1),
+    ),
+    "leaky_relu": lambda x, op: jax.nn.leaky_relu(x, float(op.attr("alpha", 0.02))),
+    "logsigmoid": lambda x, op: jax.nn.log_sigmoid(x),
+    "thresholded_relu": lambda x, op: jnp.where(
+        x > float(op.attr("threshold", 1.0)), x, jnp.zeros_like(x)
+    ),
+    "stanh": lambda x, op: float(op.attr("scale_b", 1.7159))
+    * jnp.tanh(float(op.attr("scale_a", 0.67)) * x),
+    "brelu": lambda x, op: jnp.clip(
+        x, float(op.attr("t_min", 0.0)), float(op.attr("t_max", 24.0))
+    ),
+    "expm1": lambda x, op: jnp.expm1(x),
+    "atanh": lambda x, op: jnp.arctanh(x),
+    "asinh": lambda x, op: jnp.arcsinh(x),
+    "acosh": lambda x, op: jnp.arccosh(x),
+}
+
+
+def _softshrink(x, lam):
+    return jnp.where(x > lam, x - lam, jnp.where(x < -lam, x + lam, jnp.zeros_like(x)))
+
+
+for _name, _fn in _SIMPLE.items():
+    register_lower(_name)(_unary(_fn))
+
+
+@register_lower("gelu")
+def _gelu(ctx, op):
+    x = ctx.in1(op, "X")
+    ctx.set_out(op, "Out", jax.nn.gelu(x, approximate=bool(op.attr("approximate", False))))
+
+
+@register_lower("prelu")
+def _prelu(ctx, op):
+    x = ctx.in1(op, "X")
+    alpha = ctx.in1(op, "Alpha")
+    mode = op.attr("mode", "all")
+    if mode == "channel" and alpha.size > 1:
+        shape = [1, -1] + [1] * (x.ndim - 2)
+        alpha = alpha.reshape(shape)
+    ctx.set_out(op, "Out", jnp.where(x > 0, x, alpha * x))
+
+
+@register_lower("maxout")
+def _maxout(ctx, op):
+    x = ctx.in1(op, "X")  # NCHW
+    groups = int(op.attr("groups"))
+    axis = int(op.attr("axis", 1))
+    c = x.shape[axis]
+    new_shape = list(x.shape)
+    new_shape[axis : axis + 1] = [c // groups, groups]
+    ctx.set_out(op, "Out", jnp.max(x.reshape(new_shape), axis=axis + 1))

@@ -1,0 +1,51 @@
+"""Shared helpers for op lowering rules."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtypes
+
+
+def attr_dtype(op, name="dtype", default="float32"):
+    """Resolve a dtype attribute (IR enum int or string) to a jnp dtype."""
+    v = op.attr(name, None)
+    if v is None or v == 0:
+        return jnp.dtype(default)
+    return dtypes.to_jnp(v)
+
+
+def op_seed_key(ctx, op):
+    """Deterministic key for a random op: explicit nonzero `seed` attr wins
+    (reference per-op seed semantics), else draw from the threaded program key."""
+    seed = int(op.attr("seed", 0) or 0)
+    if seed:
+        return jax.random.PRNGKey(seed)
+    return ctx.next_key()
+
+
+def bcast_shapes_elementwise(x, y, axis: int):
+    """Reference elementwise broadcast: align y's dims to x starting at
+    `axis` (reference operators/elementwise/elementwise_op_function.h trim/
+    expand semantics), then rely on numpy-style broadcasting."""
+    if x.ndim == y.ndim or y.ndim == 0:
+        return x, y
+    if y.ndim > x.ndim:
+        # mirrored case: broadcast x into y (resolve axis against y's rank)
+        y2, x2 = bcast_shapes_elementwise(y, x, axis)
+        return x2, y2
+    if axis == -1:
+        axis = x.ndim - y.ndim
+    new_shape = [1] * x.ndim
+    new_shape[axis : axis + y.ndim] = list(y.shape)
+    return x, y.reshape(new_shape)
+
+
+def resolve_shape_attr(shape, env_get=None):
+    return [int(s) for s in shape]
+
+
+def as_scalar(x):
+    """Ops like sgd receive learning rate as a [1] tensor."""
+    return jnp.reshape(x, ()) if hasattr(x, "shape") and np.prod(x.shape) == 1 else x

@@ -1,0 +1,178 @@
+"""Creation / random ops.
+
+Reference parity: operators/fill_constant_op.cc, gaussian_random_op.cc,
+uniform_random_op.cc, truncated_gaussian_random_op.cc, assign_value_op.cc,
+fill_zeros_like_op.cc, range_op.cc, linspace_op.cc, eye_op.cc.
+RNG is threefry (TPU-native); bitwise parity with the reference's Philox
+streams is a non-goal (SURVEY.md §7 'RNG parity').
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.lowering import register_lower
+from .common import attr_dtype, op_seed_key
+
+
+@register_lower("fill_constant")
+def _fill_constant(ctx, op):
+    dtype = attr_dtype(op)
+    shape = [int(s) for s in op.attr("shape", [])]
+    st = op.inputs.get("ShapeTensor") or op.inputs.get("ShapeTensorList")
+    if st:
+        # XLA needs static shapes: the shape tensor must be concrete here
+        vals = [ctx.get(n) for n in st]
+        try:
+            if len(vals) == 1 and np.asarray(vals[0]).size > 1:
+                shape = [int(v) for v in np.asarray(vals[0])]
+            else:
+                shape = [int(np.asarray(v).item()) for v in vals]
+        except Exception as e:  # traced (data-dependent) shape
+            raise NotImplementedError(
+                "fill_constant with a runtime-computed ShapeTensor is not "
+                "supported under XLA static shapes; pass the shape attr"
+            ) from e
+    value = op.attr("value", 0.0)
+    if op.attr("str_value", ""):
+        value = float(op.attr("str_value"))
+    ctx.set_out(op, "Out", jnp.full(shape, value, dtype=dtype))
+
+
+@register_lower("fill_any_like", "fill_zeros_like")
+def _fill_any_like(ctx, op):
+    x = ctx.in1(op, "X")
+    value = op.attr("value", 0.0)
+    dt = op.attr("dtype", -1)
+    dtype = x.dtype if dt in (-1, 0, None) else attr_dtype(op)
+    ctx.set_out(op, "Out", jnp.full(x.shape, value, dtype=dtype))
+
+
+@register_lower("gaussian_random")
+def _gaussian_random(ctx, op):
+    dtype = attr_dtype(op)
+    shape = [int(s) for s in op.attr("shape", [])]
+    mean = op.attr("mean", 0.0)
+    std = op.attr("std", 1.0)
+    k = op_seed_key(ctx, op)
+    out = mean + std * jax.random.normal(k, shape, dtype=jnp.float32)
+    ctx.set_out(op, "Out", out.astype(dtype))
+
+
+@register_lower("truncated_gaussian_random")
+def _truncated_gaussian_random(ctx, op):
+    dtype = attr_dtype(op)
+    shape = [int(s) for s in op.attr("shape", [])]
+    mean = op.attr("mean", 0.0)
+    std = op.attr("std", 1.0)
+    k = op_seed_key(ctx, op)
+    out = mean + std * jax.random.truncated_normal(k, -2.0, 2.0, shape, dtype=jnp.float32)
+    ctx.set_out(op, "Out", out.astype(dtype))
+
+
+@register_lower("uniform_random")
+def _uniform_random(ctx, op):
+    dtype = attr_dtype(op)
+    shape = [int(s) for s in op.attr("shape", [])]
+    lo = op.attr("min", -1.0)
+    hi = op.attr("max", 1.0)
+    k = op_seed_key(ctx, op)
+    out = jax.random.uniform(k, shape, minval=lo, maxval=hi, dtype=jnp.float32)
+    ctx.set_out(op, "Out", out.astype(dtype))
+
+
+@register_lower("randint")
+def _randint(ctx, op):
+    dtype = attr_dtype(op, default="int64")
+    shape = [int(s) for s in op.attr("shape", [])]
+    k = op_seed_key(ctx, op)
+    out = jax.random.randint(k, shape, op.attr("low", 0), op.attr("high", 1))
+    ctx.set_out(op, "Out", out.astype(dtype))
+
+
+@register_lower("randperm")
+def _randperm(ctx, op):
+    n = int(op.attr("n"))
+    k = op_seed_key(ctx, op)
+    ctx.set_out(op, "Out", jax.random.permutation(k, n).astype(attr_dtype(op, default="int64")))
+
+
+@register_lower("dropout")
+def _dropout(ctx, op):
+    x = ctx.in1(op, "X")
+    p = float(op.attr("dropout_prob", 0.5))
+    is_test = bool(op.attr("is_test", False))
+    impl = op.attr("dropout_implementation", "downgrade_in_infer")
+    if is_test:
+        out = x if impl == "upscale_in_train" else x * (1.0 - p)
+        ctx.set_out(op, "Out", out)
+        ctx.set_out(op, "Mask", jnp.ones_like(x, dtype=jnp.uint8))
+        return
+    k = op_seed_key(ctx, op)
+    keep = jax.random.bernoulli(k, 1.0 - p, x.shape)
+    if impl == "upscale_in_train":
+        scale = 0.0 if p >= 1.0 else 1.0 / (1.0 - p)
+        out = jnp.where(keep, x * scale, jnp.zeros_like(x))
+    else:
+        out = jnp.where(keep, x, jnp.zeros_like(x))
+    ctx.set_out(op, "Out", out)
+    ctx.set_out(op, "Mask", keep.astype(jnp.uint8))
+
+
+@register_lower("dropout_grad")
+def _dropout_grad(ctx, op):
+    dy = ctx.in1(op, "Out@GRAD")
+    mask = ctx.in1(op, "Mask")
+    p = float(op.attr("dropout_prob", 0.5))
+    impl = op.attr("dropout_implementation", "downgrade_in_infer")
+    keep = mask.astype(dy.dtype)
+    if impl == "upscale_in_train":
+        scale = 0.0 if p >= 1.0 else 1.0 / (1.0 - p)
+        dx = dy * keep * scale
+    else:
+        dx = dy * keep
+    ctx.set_out(op, "X@GRAD", dx)
+
+
+@register_lower("range")
+def _range(ctx, op):
+    start = ctx.in1(op, "Start")
+    end = ctx.in1(op, "End")
+    step = ctx.in1(op, "Step")
+    # XLA needs static sizes: range bounds must be trace-time constants.
+    start, end, step = (np.asarray(v).item() for v in (start, end, step))
+    ctx.set_out(op, "Out", jnp.arange(start, end, step))
+
+
+@register_lower("linspace")
+def _linspace(ctx, op):
+    start = np.asarray(ctx.in1(op, "Start")).item()
+    stop = np.asarray(ctx.in1(op, "Stop")).item()
+    num = int(np.asarray(ctx.in1(op, "Num")).item())
+    ctx.set_out(op, "Out", jnp.linspace(start, stop, num, dtype=attr_dtype(op)))
+
+
+@register_lower("eye")
+def _eye(ctx, op):
+    n = int(op.attr("num_rows"))
+    m = int(op.attr("num_columns", -1))
+    m = n if m in (-1, 0) else m
+    ctx.set_out(op, "Out", jnp.eye(n, m, dtype=attr_dtype(op)))
+
+
+@register_lower("assign")
+def _assign(ctx, op):
+    ctx.set_out(op, "Out", ctx.in1(op, "X"))
+
+
+@register_lower("assign_value")
+def _assign_value(ctx, op):
+    dtype = attr_dtype(op)
+    shape = [int(s) for s in op.attr("shape", [])]
+    for key in ("fp32_values", "int32_values", "int64_values", "bool_values"):
+        vals = op.attr(key, None)
+        if vals:
+            ctx.set_out(op, "Out", jnp.asarray(vals, dtype=dtype).reshape(shape))
+            return
+    ctx.set_out(op, "Out", jnp.zeros(shape, dtype=dtype))

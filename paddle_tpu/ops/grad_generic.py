@@ -1,0 +1,138 @@
+"""Generic gradient lowering: vjp over the recomputed forward.
+
+Any ``<type>_grad`` op without an explicit lowering lands here.  The op
+carries the forward op's full slots + attrs (see backward.default_grad_maker);
+we rebuild the forward emission in a sub-environment and differentiate it
+with ``jax.vjp``.  Forward and backward share one XLA computation, so XLA's
+CSE removes the duplicated forward — runtime cost is the same as a
+hand-written gradient, with none of the per-op backward-kernel surface the
+reference maintains (its ~500 GradOpDescMaker + grad kernels).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework import lowering as _lowering
+from ..framework.lowering import LoweringContext, register_lower
+from ..framework.program import Operator
+
+GRAD_SUFFIX = "@GRAD"
+
+
+def _is_float(v):
+    return jnp.issubdtype(jnp.asarray(v).dtype, jnp.floating) or jnp.issubdtype(
+        jnp.asarray(v).dtype, jnp.complexfloating
+    )
+
+
+def lower_generic_grad(ctx: LoweringContext, gop) -> None:
+    fwd_type = gop.attr("__fwd_type__")
+    if not fwd_type:
+        raise NotImplementedError(
+            f"op {gop.type!r}: no lowering and no __fwd_type__ attr for the "
+            "generic vjp path"
+        )
+    out_slots = set(gop.attr("__fwd_out_slots__", []) or [])
+    in_slots = [
+        s
+        for s in gop.inputs
+        if s not in out_slots and not s.endswith(GRAD_SUFFIX)
+    ]
+    fwd_lower = _lowering.LOWERINGS[fwd_type]
+    attrs = {k: v for k, v in gop.attrs.items() if not k.startswith("__fwd_")}
+
+    fwd_inputs = {s: list(gop.inputs[s]) for s in in_slots}
+    fwd_outputs = {s: list(gop.inputs[s]) for s in out_slots if s in gop.inputs}
+
+    # which (slot, idx) need grads, and which are differentiable floats
+    want = {}  # slot -> [(idx, grad_out_name)]
+    for s in in_slots:
+        gnames = gop.outputs.get(s + GRAD_SUFFIX, [])
+        pairs = [(i, g) for i, g in enumerate(gnames) if g]
+        if pairs:
+            want[s] = pairs
+
+    diff_args = []  # list of (slot, idx) that are float and wanted
+    for s, pairs in want.items():
+        for i, _ in pairs:
+            val = ctx.get(fwd_inputs[s][i])
+            if _is_float(val):
+                diff_args.append((s, i))
+
+    const_env = {}
+    for s in in_slots:
+        for n in fwd_inputs[s]:
+            const_env[n] = ctx.get(n)
+
+    def run_forward(diff_vals):
+        """Re-emit the forward op in a sub-env; returns env after the op."""
+        env = dict(const_env)
+        for (s, i), v in zip(diff_args, diff_vals):
+            env[fwd_inputs[s][i]] = v
+        fop = Operator.__new__(Operator)
+        fop.block = ctx.block
+        fop.type = fwd_type
+        fop.inputs = fwd_inputs
+        fop.outputs = fwd_outputs
+        fop.attrs = attrs
+        fop.callstack = gop.callstack
+        sub = LoweringContext(ctx.block, env, rng_key=None, mesh=ctx.mesh, axis_env=ctx.axis_env)
+        fwd_lower(sub, fop)
+        return env
+
+    if not diff_args:
+        # nothing differentiable wanted; emit zeros for requested int grads
+        for s, pairs in want.items():
+            for i, gname in pairs:
+                val = ctx.get(fwd_inputs[s][i])
+                ctx.set(gname, jnp.zeros_like(val))
+        return
+
+    diff_vals = tuple(ctx.get(fwd_inputs[s][i]) for s, i in diff_args)
+
+    # probe with abstract values to learn which outputs are float
+    probe = jax.eval_shape(lambda dv: run_forward(dv), diff_vals)
+    float_outs = []  # (slot, index_in_slot, var_name)
+    for s in fwd_outputs:
+        for j, n in enumerate(fwd_outputs[s]):
+            if jnp.issubdtype(probe[n].dtype, jnp.floating) or jnp.issubdtype(
+                probe[n].dtype, jnp.complexfloating
+            ):
+                float_outs.append((s, j, n))
+
+    def fwd_fn(*dv):
+        env = run_forward(dv)
+        return tuple(env[n] for _, _, n in float_outs)
+
+    primals, vjp_fn = jax.vjp(fwd_fn, *diff_vals)
+
+    cots = []
+    for (s, j, n), ref in zip(float_outs, primals):
+        gnames = gop.inputs.get(s + GRAD_SUFFIX, [])
+        gname = gnames[j] if j < len(gnames) else ""
+        if gname:
+            cots.append(ctx.get(gname).astype(ref.dtype))
+        else:
+            cots.append(jnp.zeros_like(ref))
+    grads = vjp_fn(tuple(cots))
+
+    grad_by_arg = dict(zip(diff_args, grads))
+    for s, pairs in want.items():
+        for i, gname in pairs:
+            if (s, i) in grad_by_arg:
+                val = ctx.get(fwd_inputs[s][i])
+                ctx.set(gname, grad_by_arg[(s, i)].astype(val.dtype))
+            else:
+                ctx.set(gname, jnp.zeros_like(ctx.get(fwd_inputs[s][i])))
+
+
+# install as the fallback for unregistered *_grad ops
+_lowering.GENERIC_GRAD_LOWERING = lower_generic_grad
+
+
+@register_lower("reshape_like_grad")
+def _reshape_like_grad(ctx, op):
+    dy = ctx.in1(op, "Out@GRAD")
+    x = ctx.in1(op, "X")
+    ctx.set_out(op, "X@GRAD", dy.reshape(x.shape))

@@ -1,0 +1,332 @@
+"""Math ops: matmul family, elementwise family, reductions.
+
+Reference parity: operators/matmul_op.cc, mul_op.cc, matmul_v2_op.cc,
+elementwise/*, reduce_ops/*.  All lower to single XLA HLOs; the MXU sees
+plain dot_general / broadcasts, fusion is XLA's job.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.lowering import register_lower
+from .common import bcast_shapes_elementwise
+
+
+# ---------------------------------------------------------------------------
+# matmul family
+# ---------------------------------------------------------------------------
+
+
+@register_lower("mul")
+def _mul(ctx, op):
+    """Flattening matmul: X flattened at x_num_col_dims, Y at y_num_col_dims."""
+    x = ctx.in1(op, "X")
+    y = ctx.in1(op, "Y")
+    xn = int(op.attr("x_num_col_dims", 1))
+    yn = int(op.attr("y_num_col_dims", 1))
+    xs, ys = x.shape, y.shape
+    x2 = x.reshape((-1, int(_prod(xs[xn:]))))
+    y2 = y.reshape((int(_prod(ys[:yn])), -1))
+    out = x2 @ y2
+    out_shape = tuple(xs[:xn]) + tuple(ys[yn:])
+    ctx.set_out(op, "Out", out.reshape(out_shape))
+
+
+def _prod(t):
+    p = 1
+    for v in t:
+        p *= int(v)
+    return p
+
+
+def _matmul_common(x, y, trans_x, trans_y, alpha=1.0):
+    if trans_x:
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if trans_y:
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    if x.ndim == 1 and y.ndim == 1:
+        out = jnp.dot(x, y)
+    else:
+        out = jnp.matmul(x, y)
+    if alpha != 1.0:
+        out = out * alpha
+    return out
+
+
+@register_lower("matmul")
+def _matmul(ctx, op):
+    x = ctx.in1(op, "X")
+    y = ctx.in1(op, "Y")
+    out = _matmul_common(
+        x,
+        y,
+        bool(op.attr("transpose_X", False)),
+        bool(op.attr("transpose_Y", False)),
+        float(op.attr("alpha", 1.0)),
+    )
+    ctx.set_out(op, "Out", out)
+
+
+@register_lower("matmul_v2")
+def _matmul_v2(ctx, op):
+    x = ctx.in1(op, "X")
+    y = ctx.in1(op, "Y")
+    out = _matmul_common(
+        x, y, bool(op.attr("trans_x", False)), bool(op.attr("trans_y", False))
+    )
+    ctx.set_out(op, "Out", out)
+
+
+@register_lower("dot")
+def _dot(ctx, op):
+    x = ctx.in1(op, "X")
+    y = ctx.in1(op, "Y")
+    ctx.set_out(op, "Out", jnp.sum(x * y, axis=-1, keepdims=x.ndim > 1))
+
+
+@register_lower("bmm")
+def _bmm(ctx, op):
+    ctx.set_out(op, "Out", jnp.matmul(ctx.in1(op, "X"), ctx.in1(op, "Y")))
+
+
+# ---------------------------------------------------------------------------
+# elementwise binary family (axis-broadcast semantics of the reference)
+# ---------------------------------------------------------------------------
+
+_BINARY = {
+    "elementwise_add": jnp.add,
+    "elementwise_sub": jnp.subtract,
+    "elementwise_mul": jnp.multiply,
+    "elementwise_div": jnp.divide,
+    "elementwise_max": jnp.maximum,
+    "elementwise_min": jnp.minimum,
+    "elementwise_pow": jnp.power,
+    "elementwise_mod": jnp.mod,
+    "elementwise_floordiv": jnp.floor_divide,
+}
+
+
+def _make_binary(fn):
+    def lower(ctx, op):
+        x = ctx.in1(op, "X")
+        y = ctx.in1(op, "Y")
+        axis = int(op.attr("axis", -1))
+        x, y = bcast_shapes_elementwise(x, y, axis)
+        ctx.set_out(op, "Out", fn(x, y))
+
+    return lower
+
+
+for _name, _fn in _BINARY.items():
+    register_lower(_name)(_make_binary(_fn))
+
+
+@register_lower("scale")
+def _scale(ctx, op):
+    x = ctx.in1(op, "X")
+    scale = op.attr("scale", 1.0)
+    s_in = ctx.in_list(op, "ScaleTensor")
+    if s_in:
+        scale = jnp.reshape(s_in[0], ())
+    bias = op.attr("bias", 0.0)
+    if bool(op.attr("bias_after_scale", True)):
+        out = x * scale + jnp.asarray(bias, x.dtype)
+    else:
+        out = (x + jnp.asarray(bias, x.dtype)) * scale
+    ctx.set_out(op, "Out", out.astype(x.dtype))
+
+
+@register_lower("sum")
+def _sum(ctx, op):
+    xs = ctx.in_list(op, "X")
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    ctx.set_out(op, "Out", out)
+
+
+# ---------------------------------------------------------------------------
+# reductions
+# ---------------------------------------------------------------------------
+
+
+def _reduce_axes(op, x):
+    axes = op.attr("dim", None)
+    if op.attr("reduce_all", False) or axes is None or axes == []:
+        return None
+    return tuple(int(a) % x.ndim for a in (axes if isinstance(axes, (list, tuple)) else [axes]))
+
+
+def _make_reduce(fn):
+    def lower(ctx, op):
+        x = ctx.in1(op, "X")
+        axes = _reduce_axes(op, x)
+        keep = bool(op.attr("keep_dim", False))
+        out = fn(x, axis=axes, keepdims=keep)
+        ctx.set_out(op, "Out", out)
+
+    return lower
+
+
+for _name, _fn in {
+    "reduce_sum": jnp.sum,
+    "reduce_mean": jnp.mean,
+    "reduce_max": jnp.max,
+    "reduce_min": jnp.min,
+    "reduce_prod": jnp.prod,
+}.items():
+    register_lower(_name)(_make_reduce(_fn))
+
+
+@register_lower("reduce_all")
+def _reduce_all(ctx, op):
+    x = ctx.in1(op, "X")
+    ctx.set_out(op, "Out", jnp.all(x, axis=_reduce_axes(op, x), keepdims=bool(op.attr("keep_dim", False))))
+
+
+@register_lower("reduce_any")
+def _reduce_any(ctx, op):
+    x = ctx.in1(op, "X")
+    ctx.set_out(op, "Out", jnp.any(x, axis=_reduce_axes(op, x), keepdims=bool(op.attr("keep_dim", False))))
+
+
+@register_lower("mean")
+def _mean(ctx, op):
+    # reference mean_op reduces to a single-element tensor of shape [1]
+    ctx.set_out(op, "Out", jnp.mean(ctx.in1(op, "X")).reshape((1,)))
+
+
+@register_lower("mean_grad")
+def _mean_grad(ctx, op):
+    x = ctx.in1(op, "X")
+    dy = ctx.in1(op, "Out@GRAD")
+    ctx.set_out(op, "X@GRAD", jnp.broadcast_to(jnp.reshape(dy, ()) / x.size, x.shape).astype(x.dtype))
+
+
+# ---------------------------------------------------------------------------
+# comparison / logical
+# ---------------------------------------------------------------------------
+
+for _name, _fn in {
+    "equal": jnp.equal,
+    "not_equal": jnp.not_equal,
+    "less_than": jnp.less,
+    "less_equal": jnp.less_equal,
+    "greater_than": jnp.greater,
+    "greater_equal": jnp.greater_equal,
+}.items():
+
+    def _mk(fn):
+        def lower(ctx, op):
+            x = ctx.in1(op, "X")
+            y = ctx.in1(op, "Y")
+            x, y = bcast_shapes_elementwise(x, y, int(op.attr("axis", -1)))
+            ctx.set_out(op, "Out", fn(x, y))
+
+        return lower
+
+    register_lower(_name)(_mk(_fn))
+
+for _name, _fn in {
+    "logical_and": jnp.logical_and,
+    "logical_or": jnp.logical_or,
+    "logical_xor": jnp.logical_xor,
+}.items():
+
+    def _mk2(fn):
+        def lower(ctx, op):
+            ctx.set_out(op, "Out", fn(ctx.in1(op, "X"), ctx.in1(op, "Y")))
+
+        return lower
+
+    register_lower(_name)(_mk2(_fn))
+
+
+@register_lower("logical_not")
+def _logical_not(ctx, op):
+    ctx.set_out(op, "Out", jnp.logical_not(ctx.in1(op, "X")))
+
+
+# ---------------------------------------------------------------------------
+# unary math (non-activation)
+# ---------------------------------------------------------------------------
+
+for _name, _fn in {
+    "exp": jnp.exp,
+    "log": jnp.log,
+    "log2": jnp.log2,
+    "log10": jnp.log10,
+    "log1p": jnp.log1p,
+    "sqrt": jnp.sqrt,
+    "rsqrt": lambda x: jax.lax.rsqrt(x),
+    "abs": jnp.abs,
+    "ceil": jnp.ceil,
+    "floor": jnp.floor,
+    "round": jnp.round,
+    "cos": jnp.cos,
+    "sin": jnp.sin,
+    "tan": jnp.tan,
+    "acos": jnp.arccos,
+    "asin": jnp.arcsin,
+    "atan": jnp.arctan,
+    "cosh": jnp.cosh,
+    "sinh": jnp.sinh,
+    "reciprocal": lambda x: 1.0 / x,
+    "square": jnp.square,
+    "sign": jnp.sign,
+    "erf": jax.scipy.special.erf,
+}.items():
+
+    def _mku(fn):
+        def lower(ctx, op):
+            ctx.set_out(op, "Out", fn(ctx.in1(op, "X")))
+
+        return lower
+
+    register_lower(_name)(_mku(_fn))
+
+
+@register_lower("pow")
+def _pow(ctx, op):
+    x = ctx.in1(op, "X")
+    factor = op.attr("factor", 1.0)
+    f_in = ctx.in_list(op, "FactorTensor")
+    if f_in:
+        factor = jnp.reshape(f_in[0], ())
+    ctx.set_out(op, "Out", jnp.power(x, factor))
+
+
+@register_lower("clip")
+def _clip(ctx, op):
+    x = ctx.in1(op, "X")
+    lo = op.attr("min", None)
+    hi = op.attr("max", None)
+    ctx.set_out(op, "Out", jnp.clip(x, lo, hi))
+
+
+@register_lower("isfinite", "isfinite_v2")
+def _isfinite(ctx, op):
+    x = ctx.in1(op, "X")
+    out = jnp.all(jnp.isfinite(x)) if op.type == "isfinite" else jnp.isfinite(x)
+    ctx.set_out(op, "Out", out)
+
+
+@register_lower("isnan_v2")
+def _isnan(ctx, op):
+    ctx.set_out(op, "Out", jnp.isnan(ctx.in1(op, "X")))
+
+
+@register_lower("isinf_v2")
+def _isinf(ctx, op):
+    ctx.set_out(op, "Out", jnp.isinf(ctx.in1(op, "X")))
+
+
+@register_lower("maximum")
+def _maximum(ctx, op):
+    ctx.set_out(op, "Out", jnp.maximum(ctx.in1(op, "X"), ctx.in1(op, "Y")))
+
+
+@register_lower("minimum")
+def _minimum(ctx, op):
+    ctx.set_out(op, "Out", jnp.minimum(ctx.in1(op, "X"), ctx.in1(op, "Y")))

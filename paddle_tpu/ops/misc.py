@@ -1,0 +1,128 @@
+"""Metrics, misc, and grad-infrastructure ops.
+
+Reference parity: operators/metrics/accuracy_op.cc, coalesce-free grad
+accumulation (sum), clip_by_norm_op.cc, squared_l2_norm_op.cc,
+fill ops used by append_backward, increment/assign used by LR schedules
+and control flow.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.lowering import register_lower
+from .common import as_scalar
+
+
+@register_lower("accuracy")
+def _accuracy(ctx, op):
+    pred_idx = ctx.in1(op, "Indices")  # [N, k] from top_k
+    label = ctx.in1(op, "Label")  # [N, 1]
+    if label.ndim == 1:
+        label = label[:, None]
+    correct = jnp.any(pred_idx == label, axis=1)
+    num_correct = jnp.sum(correct.astype(jnp.float32))
+    total = jnp.asarray(pred_idx.shape[0], jnp.float32)
+    ctx.set_out(op, "Accuracy", (num_correct / total).reshape((1,)))
+    ctx.set_out(op, "Correct", num_correct.astype(jnp.int32).reshape((1,)))
+    ctx.set_out(op, "Total", jnp.asarray([pred_idx.shape[0]], jnp.int32))
+
+
+@register_lower("increment")
+def _increment(ctx, op):
+    x = ctx.in1(op, "X")
+    ctx.set_out(op, "Out", x + jnp.asarray(op.attr("step", 1.0), x.dtype))
+
+
+@register_lower("clip_by_norm")
+def _clip_by_norm(ctx, op):
+    x = ctx.in1(op, "X")
+    max_norm = op.attr("max_norm")
+    norm = jnp.sqrt(jnp.sum(jnp.square(x)))
+    factor = jnp.where(norm > max_norm, max_norm / jnp.maximum(norm, 1e-12), 1.0)
+    ctx.set_out(op, "Out", x * factor.astype(x.dtype))
+
+
+@register_lower("squared_l2_norm")
+def _squared_l2_norm(ctx, op):
+    x = ctx.in1(op, "X")
+    ctx.set_out(op, "Out", jnp.sum(jnp.square(x.astype(jnp.float32))).reshape((1,)))
+
+
+@register_lower("p_norm")
+def _p_norm(ctx, op):
+    x = ctx.in1(op, "X")
+    porder = float(op.attr("porder", 2.0))
+    axis = op.attr("axis", None)
+    keepdim = bool(op.attr("keepdim", False))
+    if axis is None or axis == [] or bool(op.attr("asvector", False)):
+        axis = None
+    else:
+        axis = int(axis)
+    if porder == float("inf"):
+        out = jnp.max(jnp.abs(x), axis=axis, keepdims=keepdim)
+    elif porder == float("-inf"):
+        out = jnp.min(jnp.abs(x), axis=axis, keepdims=keepdim)
+    else:
+        out = jnp.power(
+            jnp.sum(jnp.power(jnp.abs(x), porder), axis=axis, keepdims=keepdim),
+            1.0 / porder,
+        )
+    ctx.set_out(op, "Out", out)
+
+
+@register_lower("frobenius_norm")
+def _frobenius_norm(ctx, op):
+    x = ctx.in1(op, "X")
+    axes = tuple(int(a) for a in op.attr("dim", []))
+    keep = bool(op.attr("keep_dim", False))
+    if op.attr("reduce_all", False) or not axes:
+        axes = None
+    ctx.set_out(op, "Out", jnp.sqrt(jnp.sum(jnp.square(x), axis=axes, keepdims=keep)))
+
+
+@register_lower("auc")
+def _auc(ctx, op):
+    # streaming AUC needs host-side state; provide the batch statistic path
+    preds = ctx.in1(op, "Predict")
+    label = ctx.in1(op, "Label")
+    pos_score = preds[:, 1]
+    lbl = jnp.squeeze(label, -1) if label.ndim == 2 else label
+    n_pos = jnp.sum(lbl == 1)
+    n_neg = jnp.sum(lbl == 0)
+    order = jnp.argsort(pos_score)
+    ranks = jnp.empty_like(order).at[order].set(jnp.arange(1, pos_score.shape[0] + 1))
+    sum_pos_ranks = jnp.sum(jnp.where(lbl == 1, ranks, 0))
+    auc = (sum_pos_ranks - n_pos * (n_pos + 1) / 2.0) / jnp.maximum(n_pos * n_neg, 1)
+    ctx.set_out(op, "AUC", auc.reshape((1,)).astype(jnp.float64))
+
+
+@register_lower("print")
+def _print(ctx, op):
+    x = ctx.in1(op, "In")
+    jax.debug.print("{} = {}", op.attr("message", op.input("In")[0]), x)
+    ctx.set_out(op, "Out", x)
+
+
+@register_lower("coalesce_tensor")
+def _coalesce_tensor(ctx, op):
+    # XLA fuses; grad-fusion buffers are a no-op — pass values through.
+    for name_in, name_out in zip(op.inputs.get("Input", []), op.outputs.get("Output", [])):
+        ctx.set(name_out, ctx.get(name_in))
+    fused = op.outputs.get("FusedOutput")
+    if fused:
+        vals = [jnp.ravel(ctx.get(n)) for n in op.inputs.get("Input", [])]
+        ctx.set(fused[0], jnp.concatenate(vals) if vals else jnp.zeros((0,)))
+
+
+@register_lower("share_data", "memcpy", "memcpy_h2d", "memcpy_d2h")
+def _share_data(ctx, op):
+    ctx.set_out(op, "Out", ctx.in1(op, "X"))
+
+
+@register_lower("beam_search_decode", "beam_search")
+def _beam_search(ctx, op):
+    raise NotImplementedError(
+        "beam search has dynamic shapes; use the functional decoding API "
+        "(paddle_tpu.text.decode) on TPU"
+    )

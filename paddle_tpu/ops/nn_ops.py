@@ -1,0 +1,423 @@
+"""Neural-net ops: conv, pool, softmax/cross-entropy, norms, embedding.
+
+Reference parity: operators/conv_op.cc, pool_op.cc, softmax_op.cc,
+softmax_with_cross_entropy_op.cc, cross_entropy_op.cc, batch_norm_op.cc,
+layer_norm_op.cc, lookup_table_v2_op.cc.  Convs/matmuls stay big and
+bfloat16-friendly for the MXU; XLA picks layouts.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.lowering import register_lower
+
+
+# ---------------------------------------------------------------------------
+# convolution
+# ---------------------------------------------------------------------------
+
+
+def _conv_paddings(paddings, padding_algorithm, ksize, strides, dilations, in_hw):
+    """Resolve reference padding semantics -> lax ((lo, hi), ...) pairs."""
+    nd = len(ksize)
+    if padding_algorithm == "VALID":
+        return [(0, 0)] * nd
+    if padding_algorithm == "SAME":
+        pads = []
+        for i in range(nd):
+            eff = (ksize[i] - 1) * dilations[i] + 1
+            out = -(-in_hw[i] // strides[i])
+            total = max(0, (out - 1) * strides[i] + eff - in_hw[i])
+            pads.append((total // 2, total - total // 2))
+        return pads
+    paddings = [int(p) for p in paddings]
+    if len(paddings) == nd:
+        return [(p, p) for p in paddings]
+    if len(paddings) == 2 * nd:
+        return [(paddings[2 * i], paddings[2 * i + 1]) for i in range(nd)]
+    raise ValueError(f"bad paddings {paddings}")
+
+
+@register_lower("conv2d", "depthwise_conv2d")
+def _conv2d(ctx, op):
+    x = ctx.in1(op, "Input")
+    w = ctx.in1(op, "Filter")  # OIHW
+    strides = [int(s) for s in op.attr("strides", [1, 1])]
+    dilations = [int(d) for d in op.attr("dilations", [1, 1])]
+    groups = int(op.attr("groups", 1) or 1)
+    data_format = op.attr("data_format", "NCHW") or "NCHW"
+    if data_format in ("NHWC", "NDHWC"):
+        x = jnp.transpose(x, (0, 3, 1, 2))
+    if op.type == "depthwise_conv2d":
+        groups = x.shape[1]
+    ksize = w.shape[2:]
+    pads = _conv_paddings(
+        op.attr("paddings", [0, 0]),
+        op.attr("padding_algorithm", "EXPLICIT"),
+        ksize,
+        strides,
+        dilations,
+        x.shape[2:],
+    )
+    out = jax.lax.conv_general_dilated(
+        x,
+        w,
+        window_strides=strides,
+        padding=pads,
+        rhs_dilation=dilations,
+        feature_group_count=groups,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+    )
+    if data_format in ("NHWC", "NDHWC"):
+        out = jnp.transpose(out, (0, 2, 3, 1))
+    ctx.set_out(op, "Output", out)
+
+
+@register_lower("conv2d_transpose")
+def _conv2d_transpose(ctx, op):
+    x = ctx.in1(op, "Input")
+    w = ctx.in1(op, "Filter")  # [in, out/groups, kh, kw]
+    strides = [int(s) for s in op.attr("strides", [1, 1])]
+    dilations = [int(d) for d in op.attr("dilations", [1, 1])]
+    groups = int(op.attr("groups", 1) or 1)
+    ksize = w.shape[2:]
+    pads = _conv_paddings(
+        op.attr("paddings", [0, 0]),
+        op.attr("padding_algorithm", "EXPLICIT"),
+        ksize,
+        strides,
+        dilations,
+        x.shape[2:],
+    )
+    def one_group(xg, wg):
+        return jax.lax.conv_transpose(
+            xg,
+            wg,
+            strides=strides,
+            padding=pads,
+            rhs_dilation=dilations,
+            dimension_numbers=("NCHW", "IOHW", "NCHW"),
+            transpose_kernel=True,
+        )
+
+    if groups == 1:
+        out = one_group(x, w)
+    else:
+        # lax.conv_transpose has no grouping; split channels per group
+        xs = jnp.split(x, groups, axis=1)
+        ws = jnp.split(w, groups, axis=0)
+        out = jnp.concatenate([one_group(a, b) for a, b in zip(xs, ws)], axis=1)
+    output_padding = [int(p) for p in op.attr("output_padding", []) or []]
+    if output_padding and any(output_padding):
+        out = jnp.pad(
+            out,
+            [(0, 0), (0, 0)] + [(0, p) for p in output_padding],
+        )
+    ctx.set_out(op, "Output", out)
+
+
+# ---------------------------------------------------------------------------
+# pooling
+# ---------------------------------------------------------------------------
+
+
+@register_lower("pool2d")
+def _pool2d(ctx, op):
+    x = ctx.in1(op, "X")
+    ptype = op.attr("pooling_type", "max")
+    ksize = [int(k) for k in op.attr("ksize", [1, 1])]
+    strides = [int(s) for s in op.attr("strides", [1, 1])]
+    adaptive = bool(op.attr("adaptive", False))
+    global_pool = bool(op.attr("global_pooling", False))
+    data_format = op.attr("data_format", "NCHW") or "NCHW"
+    if data_format == "NHWC":
+        x = jnp.transpose(x, (0, 3, 1, 2))
+
+    if global_pool or (adaptive and ksize == [1, 1]):
+        red = jnp.max if ptype == "max" else jnp.mean
+        out = red(x, axis=(2, 3), keepdims=True)
+    elif adaptive:
+        oh, ow = ksize
+        ih, iw = x.shape[2:]
+        if ih % oh == 0 and iw % ow == 0:
+            x5 = x.reshape(x.shape[0], x.shape[1], oh, ih // oh, ow, iw // ow)
+            red = jnp.max if ptype == "max" else jnp.mean
+            out = red(x5, axis=(3, 5))
+        else:
+            raise NotImplementedError("adaptive pool with non-divisible sizes")
+    else:
+        pads = _conv_paddings(
+            op.attr("paddings", [0, 0]),
+            op.attr("padding_algorithm", "EXPLICIT"),
+            ksize,
+            strides,
+            [1, 1],
+            x.shape[2:],
+        )
+        window = (1, 1) + tuple(ksize)
+        strides4 = (1, 1) + tuple(strides)
+        pads4 = [(0, 0), (0, 0)] + pads
+        if ptype == "max":
+            init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+            out = jax.lax.reduce_window(x, init, jax.lax.max, window, strides4, pads4)
+        else:
+            ones = jnp.ones_like(x)
+            s = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides4, pads4)
+            if bool(op.attr("exclusive", True)):
+                cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides4, pads4)
+            else:
+                cnt = float(np.prod(ksize))
+            out = s / cnt
+    if data_format == "NHWC":
+        out = jnp.transpose(out, (0, 2, 3, 1))
+    ctx.set_out(op, "Out", out)
+
+
+# ---------------------------------------------------------------------------
+# softmax & losses
+# ---------------------------------------------------------------------------
+
+
+@register_lower("softmax")
+def _softmax(ctx, op):
+    x = ctx.in1(op, "X")
+    axis = int(op.attr("axis", -1))
+    ctx.set_out(op, "Out", jax.nn.softmax(x, axis=axis))
+
+
+@register_lower("log_softmax")
+def _log_softmax(ctx, op):
+    x = ctx.in1(op, "X")
+    ctx.set_out(op, "Out", jax.nn.log_softmax(x, axis=int(op.attr("axis", -1))))
+
+
+def _one_hot_last(labels, depth, dtype):
+    return jax.nn.one_hot(jnp.squeeze(labels, -1) if labels.shape[-1] == 1 else labels, depth, dtype=dtype)
+
+
+@register_lower("softmax_with_cross_entropy")
+def _softmax_with_cross_entropy(ctx, op):
+    logits = ctx.in1(op, "Logits")
+    label = ctx.in1(op, "Label")
+    axis = int(op.attr("axis", -1)) % logits.ndim
+    soft_label = bool(op.attr("soft_label", False))
+    ignore_index = int(op.attr("ignore_index", -100))
+    logp = jax.nn.log_softmax(logits, axis=axis)
+    softmax = jnp.exp(logp)
+    if soft_label:
+        loss = -jnp.sum(label * logp, axis=axis, keepdims=True)
+    else:
+        lbl = label
+        if lbl.ndim == logits.ndim and lbl.shape[axis] == 1:
+            lbl = jnp.squeeze(lbl, axis)
+        picked = jnp.take_along_axis(logp, jnp.expand_dims(lbl, axis), axis=axis)
+        loss = -picked
+        if ignore_index >= 0:
+            mask = jnp.expand_dims(lbl, axis) != ignore_index
+            loss = jnp.where(mask, loss, jnp.zeros_like(loss))
+    ctx.set_out(op, "Softmax", softmax)
+    ctx.set_out(op, "Loss", loss)
+
+
+@register_lower("softmax_with_cross_entropy_grad")
+def _softmax_with_cross_entropy_grad(ctx, op):
+    softmax = ctx.in1(op, "Softmax")
+    label = ctx.in1(op, "Label")
+    dloss = ctx.in1(op, "Loss@GRAD")
+    axis = int(op.attr("axis", -1)) % softmax.ndim
+    soft_label = bool(op.attr("soft_label", False))
+    if soft_label:
+        dlogits = (softmax - label) * dloss
+    else:
+        lbl = label
+        if lbl.ndim == softmax.ndim and lbl.shape[axis] == 1:
+            lbl = jnp.squeeze(lbl, axis)
+        onehot = jax.nn.one_hot(lbl, softmax.shape[axis], axis=axis, dtype=softmax.dtype)
+        dlogits = (softmax - onehot) * dloss
+    ctx.set_out(op, "Logits@GRAD", dlogits)
+
+
+@register_lower("cross_entropy", "cross_entropy2")
+def _cross_entropy(ctx, op):
+    x = ctx.in1(op, "X")  # probabilities
+    label = ctx.in1(op, "Label")
+    soft_label = bool(op.attr("soft_label", False))
+    eps = 1e-12
+    logp = jnp.log(jnp.clip(x, eps, 1.0))
+    if soft_label:
+        loss = -jnp.sum(label * logp, axis=-1, keepdims=True)
+    else:
+        lbl = jnp.squeeze(label, -1) if label.ndim == x.ndim and label.shape[-1] == 1 else label
+        picked = jnp.take_along_axis(logp, jnp.expand_dims(lbl, -1), axis=-1)
+        loss = -picked
+    ctx.set_out(op, "Y", loss)
+    if op.outputs.get("XShape"):
+        ctx.set_out(op, "XShape", jnp.zeros((0,), x.dtype))
+
+
+@register_lower("sigmoid_cross_entropy_with_logits")
+def _bce_logits(ctx, op):
+    x = ctx.in1(op, "X")
+    label = ctx.in1(op, "Label")
+    # stable: max(x,0) - x*z + log(1+exp(-|x|))
+    loss = jnp.maximum(x, 0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    ignore_index = int(op.attr("ignore_index", -100))
+    if ignore_index != -100:
+        loss = jnp.where(label == ignore_index, jnp.zeros_like(loss), loss)
+    if bool(op.attr("normalize", False)):
+        norm = jnp.maximum(jnp.sum((label != ignore_index).astype(x.dtype)), 1.0)
+        loss = loss / norm
+    ctx.set_out(op, "Out", loss)
+
+
+@register_lower("square_error_cost")
+def _square_error_cost(ctx, op):
+    x = ctx.in1(op, "X")
+    y = ctx.in1(op, "Y")
+    ctx.set_out(op, "Out", jnp.square(x - y))
+
+
+@register_lower("huber_loss")
+def _huber_loss(ctx, op):
+    x = ctx.in1(op, "X")
+    y = ctx.in1(op, "Y")
+    d = float(op.attr("delta", 1.0))
+    r = y - x
+    a = jnp.abs(r)
+    loss = jnp.where(a <= d, 0.5 * r * r, d * (a - 0.5 * d))
+    ctx.set_out(op, "Out", loss)
+    ctx.set_out(op, "Residual", r)
+
+
+# ---------------------------------------------------------------------------
+# normalization
+# ---------------------------------------------------------------------------
+
+
+@register_lower("batch_norm", "sync_batch_norm")
+def _batch_norm(ctx, op):
+    x = ctx.in1(op, "X")
+    scale = ctx.in1(op, "Scale")
+    bias = ctx.in1(op, "Bias")
+    mean = ctx.in1(op, "Mean")
+    var = ctx.in1(op, "Variance")
+    eps = float(op.attr("epsilon", 1e-5))
+    momentum = float(op.attr("momentum", 0.9))
+    is_test = bool(op.attr("is_test", False))
+    use_global = bool(op.attr("use_global_stats", False)) or is_test
+    data_layout = op.attr("data_layout", "NCHW") or "NCHW"
+
+    caxis = 1 if data_layout == "NCHW" else x.ndim - 1
+    red_axes = tuple(i for i in range(x.ndim) if i != caxis)
+    bshape = [1] * x.ndim
+    bshape[caxis] = x.shape[caxis]
+
+    if use_global:
+        m, v = mean, var
+        saved_mean, saved_var = mean, var
+    else:
+        m = jnp.mean(x, axis=red_axes)
+        v = jnp.var(x, axis=red_axes)
+        if op.type == "sync_batch_norm" and ctx.axis_env:
+            # cross-replica moments ride ICI (reference sync_batch_norm_pass)
+            ex2 = v + jnp.square(m)
+            for ax in ctx.axis_env:
+                m = jax.lax.pmean(m, ax)
+                ex2 = jax.lax.pmean(ex2, ax)
+            v = ex2 - jnp.square(m)
+        saved_mean, saved_var = m, v
+        new_running_mean = momentum * mean + (1 - momentum) * m
+        new_running_var = momentum * var + (1 - momentum) * v
+        ctx.set_out(op, "MeanOut", new_running_mean)
+        ctx.set_out(op, "VarianceOut", new_running_var)
+    inv = jax.lax.rsqrt(v.astype(jnp.float32) + eps).astype(x.dtype)
+    out = (x - m.reshape(bshape)) * inv.reshape(bshape) * scale.reshape(bshape) + bias.reshape(bshape)
+    ctx.set_out(op, "Y", out)
+    if use_global:
+        ctx.set_out(op, "MeanOut", mean)
+        ctx.set_out(op, "VarianceOut", var)
+    ctx.set_out(op, "SavedMean", saved_mean)
+    ctx.set_out(op, "SavedVariance", jax.lax.rsqrt(saved_var.astype(jnp.float32) + eps))
+
+
+@register_lower("layer_norm")
+def _layer_norm(ctx, op):
+    x = ctx.in1(op, "X")
+    scale = ctx.get_opt(op.inputs.get("Scale", [None])[0] if op.inputs.get("Scale") else None)
+    bias = ctx.get_opt(op.inputs.get("Bias", [None])[0] if op.inputs.get("Bias") else None)
+    eps = float(op.attr("epsilon", 1e-5))
+    begin = int(op.attr("begin_norm_axis", 1))
+    red = tuple(range(begin, x.ndim))
+    xf = x.astype(jnp.float32)
+    m = jnp.mean(xf, axis=red, keepdims=True)
+    v = jnp.var(xf, axis=red, keepdims=True)
+    y = (xf - m) * jax.lax.rsqrt(v + eps)
+    norm_shape = x.shape[begin:]
+    if scale is not None:
+        y = y * scale.reshape(norm_shape).astype(jnp.float32)
+    if bias is not None:
+        y = y + bias.reshape(norm_shape).astype(jnp.float32)
+    ctx.set_out(op, "Y", y.astype(x.dtype))
+    ctx.set_out(op, "Mean", jnp.squeeze(m, red).reshape((-1,)))
+    ctx.set_out(op, "Variance", jnp.squeeze(v, red).reshape((-1,)))
+
+
+@register_lower("instance_norm")
+def _instance_norm(ctx, op):
+    x = ctx.in1(op, "X")
+    scale = ctx.in1(op, "Scale")
+    bias = ctx.in1(op, "Bias")
+    eps = float(op.attr("epsilon", 1e-5))
+    red = tuple(range(2, x.ndim))
+    m = jnp.mean(x, axis=red, keepdims=True)
+    v = jnp.var(x, axis=red, keepdims=True)
+    y = (x - m) * jax.lax.rsqrt(v + eps)
+    shape = [1, x.shape[1]] + [1] * (x.ndim - 2)
+    y = y * scale.reshape(shape) + bias.reshape(shape)
+    ctx.set_out(op, "Y", y)
+    ctx.set_out(op, "SavedMean", jnp.squeeze(m))
+    ctx.set_out(op, "SavedVariance", jnp.squeeze(jax.lax.rsqrt(v + eps)))
+
+
+@register_lower("group_norm")
+def _group_norm(ctx, op):
+    x = ctx.in1(op, "X")  # NCHW
+    scale = ctx.get_opt(op.inputs.get("Scale", [None])[0] if op.inputs.get("Scale") else None)
+    bias = ctx.get_opt(op.inputs.get("Bias", [None])[0] if op.inputs.get("Bias") else None)
+    eps = float(op.attr("epsilon", 1e-5))
+    groups = int(op.attr("groups", 1))
+    n, c = x.shape[0], x.shape[1]
+    xg = x.reshape((n, groups, c // groups) + x.shape[2:])
+    red = tuple(range(2, xg.ndim))
+    m = jnp.mean(xg, axis=red, keepdims=True)
+    v = jnp.var(xg, axis=red, keepdims=True)
+    y = ((xg - m) * jax.lax.rsqrt(v + eps)).reshape(x.shape)
+    shape = [1, c] + [1] * (x.ndim - 2)
+    if scale is not None:
+        y = y * scale.reshape(shape)
+    if bias is not None:
+        y = y + bias.reshape(shape)
+    ctx.set_out(op, "Y", y)
+    ctx.set_out(op, "Mean", m.reshape((n, groups)))
+    ctx.set_out(op, "Variance", v.reshape((n, groups)))
+
+
+# ---------------------------------------------------------------------------
+# embedding
+# ---------------------------------------------------------------------------
+
+
+@register_lower("lookup_table", "lookup_table_v2")
+def _lookup_table(ctx, op):
+    w = ctx.in1(op, "W")
+    ids = ctx.in1(op, "Ids")
+    padding_idx = int(op.attr("padding_idx", -1))
+    if op.type == "lookup_table" and ids.ndim >= 2 and ids.shape[-1] == 1:
+        ids = jnp.squeeze(ids, -1)
+    out = jnp.take(w, ids, axis=0)
+    if padding_idx >= 0:
+        mask = (ids != padding_idx)[..., None].astype(out.dtype)
+        out = out * mask
+    ctx.set_out(op, "Out", out)

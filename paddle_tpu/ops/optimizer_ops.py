@@ -1,0 +1,274 @@
+"""Optimizer update ops.
+
+Reference parity: operators/optimizers/ (sgd_op.cc, momentum_op.cc,
+adam_op.cc, adamax_op.cc, adagrad_op.cc, adadelta_op.cc, rmsprop_op.cc,
+ftrl_op.cc, lamb_op.cc, lars_momentum_op.cc) and operators/amp/
+(check_finite_and_unscale_op.cc, update_loss_scaling_op.cc).
+
+These run inside the same compiled train-step XLA computation as forward
+and backward — the whole reference "executor hot loop" is one executable.
+Param outputs reuse the param var name, so the SSA env + donated state give
+in-place update memory behavior.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.lowering import register_lower
+from .common import as_scalar
+
+
+@register_lower("sgd")
+def _sgd(ctx, op):
+    p = ctx.in1(op, "Param")
+    g = ctx.in1(op, "Grad")
+    lr = as_scalar(ctx.in1(op, "LearningRate"))
+    ctx.set_out(op, "ParamOut", (p - lr.astype(p.dtype) * g.astype(p.dtype)).astype(p.dtype))
+
+
+@register_lower("momentum")
+def _momentum(ctx, op):
+    p = ctx.in1(op, "Param")
+    g = ctx.in1(op, "Grad").astype(p.dtype)
+    v = ctx.in1(op, "Velocity")
+    lr = as_scalar(ctx.in1(op, "LearningRate")).astype(p.dtype)
+    mu = jnp.asarray(op.attr("mu", 0.9), p.dtype)
+    use_nesterov = bool(op.attr("use_nesterov", False))
+    rd = float(op.attr("regularization_coeff", 0.0))
+    if op.attr("regularization_method", "") == "l2_decay" and rd:
+        g = g + rd * p
+    v_new = mu * v + g
+    if use_nesterov:
+        p_new = p - lr * (g + mu * v_new)
+    else:
+        p_new = p - lr * v_new
+    ctx.set_out(op, "ParamOut", p_new)
+    ctx.set_out(op, "VelocityOut", v_new)
+
+
+@register_lower("adam", "adamw")
+def _adam(ctx, op):
+    p = ctx.in1(op, "Param")
+    g = ctx.in1(op, "Grad").astype(jnp.float32)
+    m1 = ctx.in1(op, "Moment1")
+    m2 = ctx.in1(op, "Moment2")
+    b1p = ctx.in1(op, "Beta1Pow")
+    b2p = ctx.in1(op, "Beta2Pow")
+    lr = as_scalar(ctx.in1(op, "LearningRate")).astype(jnp.float32)
+    b1 = jnp.asarray(op.attr("beta1", 0.9), jnp.float32)
+    b2 = jnp.asarray(op.attr("beta2", 0.999), jnp.float32)
+    eps = jnp.asarray(op.attr("epsilon", 1e-8), jnp.float32)
+
+    pf = p.astype(jnp.float32)
+    if op.type == "adamw":
+        coeff = float(op.attr("coeff", op.attr("weight_decay", 0.01)))
+        with_decay = bool(op.attr("with_decay", True))
+        if with_decay:
+            pf = pf * (1.0 - lr * coeff)
+    m1n = b1 * m1 + (1 - b1) * g
+    m2n = b2 * m2 + (1 - b2) * jnp.square(g)
+    # reference adam_op: bias correction uses the *input* pows (beta^t at
+    # step t, accumulators initialized to beta), pows advance afterwards
+    lr_t = lr * jnp.sqrt(1 - as_scalar(b2p)) / (1 - as_scalar(b1p))
+    b1pn = b1p * b1
+    b2pn = b2p * b2
+    pn = pf - lr_t * m1n / (jnp.sqrt(m2n) + eps)
+    ctx.set_out(op, "ParamOut", pn.astype(p.dtype))
+    ctx.set_out(op, "Moment1Out", m1n)
+    ctx.set_out(op, "Moment2Out", m2n)
+    ctx.set_out(op, "Beta1PowOut", b1pn)
+    ctx.set_out(op, "Beta2PowOut", b2pn)
+
+
+@register_lower("adamax")
+def _adamax(ctx, op):
+    p = ctx.in1(op, "Param")
+    g = ctx.in1(op, "Grad")
+    m = ctx.in1(op, "Moment")
+    inf_norm = ctx.in1(op, "InfNorm")
+    b1p = ctx.in1(op, "Beta1Pow")
+    lr = as_scalar(ctx.in1(op, "LearningRate"))
+    b1 = op.attr("beta1", 0.9)
+    b2 = op.attr("beta2", 0.999)
+    eps = op.attr("epsilon", 1e-8)
+    mn = b1 * m + (1 - b1) * g
+    inf_n = jnp.maximum(b2 * inf_norm, jnp.abs(g) + eps)
+    pn = p - (lr / (1 - as_scalar(b1p))) * (mn / inf_n)
+    ctx.set_out(op, "ParamOut", pn)
+    ctx.set_out(op, "MomentOut", mn)
+    ctx.set_out(op, "InfNormOut", inf_n)
+
+
+@register_lower("adagrad")
+def _adagrad(ctx, op):
+    p = ctx.in1(op, "Param")
+    g = ctx.in1(op, "Grad")
+    mom = ctx.in1(op, "Moment")
+    lr = as_scalar(ctx.in1(op, "LearningRate"))
+    eps = op.attr("epsilon", 1e-6)
+    mn = mom + jnp.square(g)
+    pn = p - lr * g / (jnp.sqrt(mn) + eps)
+    ctx.set_out(op, "ParamOut", pn)
+    ctx.set_out(op, "MomentOut", mn)
+
+
+@register_lower("adadelta")
+def _adadelta(ctx, op):
+    p = ctx.in1(op, "Param")
+    g = ctx.in1(op, "Grad")
+    avg_sq = ctx.in1(op, "AvgSquaredGrad")
+    avg_upd = ctx.in1(op, "AvgSquaredUpdate")
+    rho = op.attr("rho", 0.95)
+    eps = op.attr("epsilon", 1e-6)
+    asq = rho * avg_sq + (1 - rho) * jnp.square(g)
+    upd = jnp.sqrt(avg_upd + eps) / jnp.sqrt(asq + eps) * g
+    aupd = rho * avg_upd + (1 - rho) * jnp.square(upd)
+    ctx.set_out(op, "ParamOut", p - upd)
+    ctx.set_out(op, "AvgSquaredGradOut", asq)
+    ctx.set_out(op, "AvgSquaredUpdateOut", aupd)
+
+
+@register_lower("rmsprop")
+def _rmsprop(ctx, op):
+    p = ctx.in1(op, "Param")
+    g = ctx.in1(op, "Grad")
+    ms = ctx.in1(op, "MeanSquare")
+    mom = ctx.in1(op, "Moment")
+    lr = as_scalar(ctx.in1(op, "LearningRate"))
+    eps = op.attr("epsilon", 1e-10)
+    rho = op.attr("decay", 0.9)
+    momentum = op.attr("momentum", 0.0)
+    centered = bool(op.attr("centered", False))
+    msn = rho * ms + (1 - rho) * jnp.square(g)
+    if centered:
+        mg = ctx.in1(op, "MeanGrad")
+        mgn = rho * mg + (1 - rho) * g
+        denom = msn - jnp.square(mgn) + eps
+        ctx.set_out(op, "MeanGradOut", mgn)
+    else:
+        denom = msn + eps
+    momn = momentum * mom + lr * g / jnp.sqrt(denom)
+    ctx.set_out(op, "ParamOut", p - momn)
+    ctx.set_out(op, "MeanSquareOut", msn)
+    ctx.set_out(op, "MomentOut", momn)
+
+
+@register_lower("lamb")
+def _lamb(ctx, op):
+    p = ctx.in1(op, "Param")
+    g = ctx.in1(op, "Grad").astype(jnp.float32)
+    m1 = ctx.in1(op, "Moment1")
+    m2 = ctx.in1(op, "Moment2")
+    b1p = ctx.in1(op, "Beta1Pow")
+    b2p = ctx.in1(op, "Beta2Pow")
+    lr = as_scalar(ctx.in1(op, "LearningRate")).astype(jnp.float32)
+    b1 = op.attr("beta1", 0.9)
+    b2 = op.attr("beta2", 0.999)
+    eps = op.attr("epsilon", 1e-6)
+    wd = op.attr("weight_decay", 0.01)
+    pf = p.astype(jnp.float32)
+    m1n = b1 * m1 + (1 - b1) * g
+    m2n = b2 * m2 + (1 - b2) * jnp.square(g)
+    mhat = m1n / (1 - as_scalar(b1p))
+    vhat = m2n / (1 - as_scalar(b2p))
+    r = mhat / (jnp.sqrt(vhat) + eps) + wd * pf
+    w_norm = jnp.linalg.norm(pf)
+    r_norm = jnp.linalg.norm(r)
+    trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+    pn = pf - lr * trust * r
+    ctx.set_out(op, "ParamOut", pn.astype(p.dtype))
+    ctx.set_out(op, "Moment1Out", m1n)
+    ctx.set_out(op, "Moment2Out", m2n)
+    ctx.set_out(op, "Beta1PowOut", b1p * b1)
+    ctx.set_out(op, "Beta2PowOut", b2p * b2)
+
+
+@register_lower("lars_momentum")
+def _lars_momentum(ctx, op):
+    p = ctx.in1(op, "Param")
+    g = ctx.in1(op, "Grad")
+    v = ctx.in1(op, "Velocity")
+    lr = as_scalar(ctx.in1(op, "LearningRate"))
+    mu = op.attr("mu", 0.9)
+    lars_coeff = op.attr("lars_coeff", 0.001)
+    lars_wd = op.attr("lars_weight_decay", 0.0005)
+    eps = op.attr("epsilon", 0.0)
+    p_norm = jnp.linalg.norm(p)
+    g_norm = jnp.linalg.norm(g)
+    local_lr = jnp.where(
+        (p_norm > 0) & (g_norm > 0),
+        lr * lars_coeff * p_norm / (g_norm + lars_wd * p_norm + eps),
+        lr,
+    )
+    vn = mu * v + local_lr * (g + lars_wd * p)
+    ctx.set_out(op, "ParamOut", p - vn)
+    ctx.set_out(op, "VelocityOut", vn)
+
+
+@register_lower("ftrl")
+def _ftrl(ctx, op):
+    p = ctx.in1(op, "Param")
+    g = ctx.in1(op, "Grad")
+    sq = ctx.in1(op, "SquaredAccumulator")
+    lin = ctx.in1(op, "LinearAccumulator")
+    lr = as_scalar(ctx.in1(op, "LearningRate"))
+    l1 = op.attr("l1", 0.0)
+    l2 = op.attr("l2", 0.0)
+    lr_power = op.attr("lr_power", -0.5)
+    new_sq = sq + jnp.square(g)
+    sigma = (jnp.power(new_sq, -lr_power) - jnp.power(sq, -lr_power)) / lr
+    new_lin = lin + g - sigma * p
+    x = -new_lin
+    y = jnp.power(new_sq, -lr_power) / lr + 2 * l2
+    pre_shrink = jnp.where(jnp.abs(new_lin) > l1, (x + jnp.sign(new_lin) * l1) / y, jnp.zeros_like(p))
+    ctx.set_out(op, "ParamOut", pre_shrink)
+    ctx.set_out(op, "SquaredAccumOut", new_sq)
+    ctx.set_out(op, "LinearAccumOut", new_lin)
+
+
+# ---------------------------------------------------------------------------
+# AMP loss-scaling state machine (reference operators/amp/)
+# ---------------------------------------------------------------------------
+
+
+@register_lower("check_finite_and_unscale")
+def _check_finite_and_unscale(ctx, op):
+    scale = as_scalar(ctx.in1(op, "Scale"))
+    found_inf = jnp.zeros((), jnp.bool_)
+    outs = op.outputs.get("Out", [])
+    for name_in, name_out in zip(op.inputs.get("X", []), outs):
+        x = ctx.get(name_in)
+        xs = x.astype(jnp.float32) / scale
+        found_inf = found_inf | ~jnp.all(jnp.isfinite(xs))
+        ctx.set(name_out, xs.astype(x.dtype) if x.dtype != jnp.float16 else xs)
+    ctx.set_out(op, "FoundInfinite", found_inf.reshape((1,)))
+
+
+@register_lower("update_loss_scaling")
+def _update_loss_scaling(ctx, op):
+    found_inf = jnp.reshape(ctx.in1(op, "FoundInfinite"), ())
+    scale = as_scalar(ctx.in1(op, "PrevLossScaling"))
+    good = as_scalar(ctx.in1(op, "InGoodSteps"))
+    bad = as_scalar(ctx.in1(op, "InBadSteps"))
+    incr_every = op.attr("incr_every_n_steps", 1000)
+    decr_every = op.attr("decr_every_n_nan_or_inf", 2)
+    incr_ratio = op.attr("incr_ratio", 2.0)
+    decr_ratio = op.attr("decr_ratio", 0.5)
+
+    new_bad = jnp.where(found_inf, bad + 1, jnp.zeros_like(bad))
+    new_good = jnp.where(found_inf, jnp.zeros_like(good), good + 1)
+    shrink = new_bad >= decr_every
+    grow = new_good >= incr_every
+    new_scale = jnp.where(
+        shrink, jnp.maximum(scale * decr_ratio, 1.0), jnp.where(grow, scale * incr_ratio, scale)
+    )
+    new_bad = jnp.where(shrink, jnp.zeros_like(new_bad), new_bad)
+    new_good = jnp.where(grow, jnp.zeros_like(new_good), new_good)
+    ctx.set_out(op, "LossScaling", new_scale.reshape((1,)))
+    ctx.set_out(op, "OutGoodSteps", new_good.reshape((1,)).astype(jnp.int32))
+    ctx.set_out(op, "OutBadSteps", new_bad.reshape((1,)).astype(jnp.int32))
+    # zero grads when non-finite (reference semantics: skip the update)
+    for name_in, name_out in zip(op.inputs.get("X", []), op.outputs.get("Out", [])):
+        x = ctx.get(name_in)
+        ctx.set(name_out, jnp.where(found_inf, jnp.zeros_like(x), x))

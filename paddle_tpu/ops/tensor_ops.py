@@ -1,0 +1,467 @@
+"""Tensor manipulation ops: reshape/transpose/concat/split/slice/gather/...
+
+Reference parity: operators/reshape_op.cc, transpose_op.cc, concat_op.cc,
+split_op.cc, slice_op.cc, gather_op.cc, scatter_op.cc, squeeze_op.cc,
+unsqueeze_op.cc, stack_op.cc, tile/expand ops, cast_op.cc, top_k_op.cc,
+arg_max/min, where/select ops, pad ops, one_hot.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.lowering import register_lower
+from .common import attr_dtype
+
+
+def _resolve_reshape(x, shape):
+    out = list(int(s) for s in shape)
+    for i, s in enumerate(out):
+        if s == 0:
+            out[i] = x.shape[i]
+    return out
+
+
+@register_lower("reshape", "reshape2")
+def _reshape(ctx, op):
+    x = ctx.in1(op, "X")
+    shape = op.attr("shape", [])
+    st = op.inputs.get("ShapeTensor") or op.inputs.get("Shape")
+    if st:
+        vals = [int(np.asarray(ctx.get(n)).item()) if np.asarray(ctx.get(n)).size == 1 else None for n in st]
+        if len(st) == 1 and vals[0] is None:
+            shape = [int(v) for v in np.asarray(ctx.get(st[0]))]
+        elif all(v is not None for v in vals):
+            shape = vals
+    out = x.reshape(_resolve_reshape(x, shape))
+    ctx.set_out(op, "Out", out)
+    if op.outputs.get("XShape"):
+        ctx.set_out(op, "XShape", jnp.zeros((0,) + tuple(x.shape), x.dtype))
+
+
+@register_lower("reshape2_grad")
+def _reshape2_grad(ctx, op):
+    dy = ctx.in1(op, "Out@GRAD")
+    xshape = ctx.in1(op, "XShape")
+    ctx.set_out(op, "X@GRAD", dy.reshape(tuple(xshape.shape)[1:]))
+
+
+@register_lower("transpose", "transpose2")
+def _transpose(ctx, op):
+    x = ctx.in1(op, "X")
+    axis = [int(a) for a in op.attr("axis", [])]
+    out = jnp.transpose(x, axis)
+    ctx.set_out(op, "Out", out)
+    if op.outputs.get("XShape"):
+        ctx.set_out(op, "XShape", jnp.zeros((0,) + tuple(x.shape), x.dtype))
+
+
+@register_lower("transpose2_grad")
+def _transpose2_grad(ctx, op):
+    dy = ctx.in1(op, "Out@GRAD")
+    axis = [int(a) for a in op.attr("axis", [])]
+    inv = np.argsort(axis)
+    ctx.set_out(op, "X@GRAD", jnp.transpose(dy, inv))
+
+
+@register_lower("flatten", "flatten2")
+def _flatten(ctx, op):
+    x = ctx.in1(op, "X")
+    axis = int(op.attr("axis", 1))
+    lead = 1
+    for s in x.shape[:axis]:
+        lead *= int(s)
+    out = x.reshape((lead, -1))
+    ctx.set_out(op, "Out", out)
+    if op.outputs.get("XShape"):
+        ctx.set_out(op, "XShape", jnp.zeros((0,) + tuple(x.shape), x.dtype))
+
+
+@register_lower("flatten_contiguous_range")
+def _flatten_range(ctx, op):
+    x = ctx.in1(op, "X")
+    start = int(op.attr("start_axis", 1)) % max(x.ndim, 1)
+    stop = int(op.attr("stop_axis", -1)) % max(x.ndim, 1)
+    shape = list(x.shape[:start]) + [-1] + list(x.shape[stop + 1 :])
+    ctx.set_out(op, "Out", x.reshape(shape))
+    if op.outputs.get("XShape"):
+        ctx.set_out(op, "XShape", jnp.zeros((0,) + tuple(x.shape), x.dtype))
+
+
+@register_lower("squeeze", "squeeze2")
+def _squeeze(ctx, op):
+    x = ctx.in1(op, "X")
+    axes = [int(a) % x.ndim for a in op.attr("axes", [])]
+    if not axes:
+        axes = [i for i, s in enumerate(x.shape) if s == 1]
+    axes = [a for a in axes if x.shape[a] == 1]
+    ctx.set_out(op, "Out", jnp.squeeze(x, tuple(axes)) if axes else x)
+    if op.outputs.get("XShape"):
+        ctx.set_out(op, "XShape", jnp.zeros((0,) + tuple(x.shape), x.dtype))
+
+
+@register_lower("unsqueeze", "unsqueeze2")
+def _unsqueeze(ctx, op):
+    x = ctx.in1(op, "X")
+    axes = [int(a) for a in op.attr("axes", [])]
+    out = x
+    for a in sorted(axes):
+        out = jnp.expand_dims(out, a if a >= 0 else a + out.ndim + 1)
+    ctx.set_out(op, "Out", out)
+    if op.outputs.get("XShape"):
+        ctx.set_out(op, "XShape", jnp.zeros((0,) + tuple(x.shape), x.dtype))
+
+
+@register_lower("concat")
+def _concat(ctx, op):
+    xs = ctx.in_list(op, "X")
+    axis = int(op.attr("axis", 0))
+    at = op.inputs.get("AxisTensor")
+    if at:
+        axis = int(np.asarray(ctx.get(at[0])).item())
+    ctx.set_out(op, "Out", jnp.concatenate(xs, axis=axis))
+
+
+@register_lower("split")
+def _split(ctx, op):
+    x = ctx.in1(op, "X")
+    axis = int(op.attr("axis", 0))
+    num = int(op.attr("num", 0))
+    sections = [int(s) for s in op.attr("sections", []) or []]
+    outs = op.outputs.get("Out", [])
+    if sections:
+        # sections may contain one -1
+        total = x.shape[axis]
+        known = sum(s for s in sections if s > 0)
+        sections = [s if s > 0 else total - known for s in sections]
+        idx = np.cumsum(sections)[:-1]
+        parts = jnp.split(x, idx, axis=axis)
+    else:
+        parts = jnp.split(x, num or len(outs), axis=axis)
+    for name, p in zip(outs, parts):
+        ctx.set(name, p)
+
+
+@register_lower("stack")
+def _stack(ctx, op):
+    xs = ctx.in_list(op, "X")
+    ctx.set_out(op, "Y", jnp.stack(xs, axis=int(op.attr("axis", 0))))
+
+
+@register_lower("unstack")
+def _unstack(ctx, op):
+    x = ctx.in1(op, "X")
+    axis = int(op.attr("axis", 0))
+    parts = [jnp.squeeze(p, axis) for p in jnp.split(x, x.shape[axis], axis=axis)]
+    for name, p in zip(op.outputs.get("Y", []), parts):
+        ctx.set(name, p)
+
+
+@register_lower("slice")
+def _slice(ctx, op):
+    x = ctx.in1(op, "Input")
+    axes = [int(a) for a in op.attr("axes", [])]
+    starts = [int(s) for s in op.attr("starts", [])]
+    ends = [int(e) for e in op.attr("ends", [])]
+    decrease = [int(d) for d in op.attr("decrease_axis", []) or []]
+    idx = [slice(None)] * x.ndim
+    for a, s, e in zip(axes, starts, ends):
+        dim = x.shape[a]
+        s = max(s + dim, 0) if s < 0 else min(s, dim)
+        e = max(e + dim, 0) if e < 0 else min(e, dim)
+        idx[a] = slice(s, e)
+    out = x[tuple(idx)]
+    if decrease:
+        out = jnp.squeeze(out, tuple(d for d in decrease if out.shape[d] == 1))
+    ctx.set_out(op, "Out", out)
+
+
+@register_lower("strided_slice")
+def _strided_slice(ctx, op):
+    x = ctx.in1(op, "Input")
+    axes = [int(a) for a in op.attr("axes", [])]
+    starts = [int(s) for s in op.attr("starts", [])]
+    ends = [int(e) for e in op.attr("ends", [])]
+    strides = [int(s) for s in op.attr("strides", [])]
+    idx = [slice(None)] * x.ndim
+    for a, s, e, st in zip(axes, starts, ends, strides):
+        idx[a] = slice(s, e, st)
+    ctx.set_out(op, "Out", x[tuple(idx)])
+
+
+@register_lower("gather")
+def _gather(ctx, op):
+    x = ctx.in1(op, "X")
+    index = ctx.in1(op, "Index")
+    axis = int(op.attr("axis", 0))
+    if index.ndim == 2 and index.shape[1] == 1:
+        index = jnp.squeeze(index, -1)
+    ctx.set_out(op, "Out", jnp.take(x, index, axis=axis))
+
+
+@register_lower("gather_nd")
+def _gather_nd(ctx, op):
+    x = ctx.in1(op, "X")
+    index = ctx.in1(op, "Index")
+    k = index.shape[-1]
+    idx = tuple(index[..., i] for i in range(k))
+    ctx.set_out(op, "Out", x[idx])
+
+
+@register_lower("scatter")
+def _scatter(ctx, op):
+    x = ctx.in1(op, "X")
+    ids = ctx.in1(op, "Ids")
+    updates = ctx.in1(op, "Updates")
+    if ids.ndim == 2 and ids.shape[1] == 1:
+        ids = jnp.squeeze(ids, -1)
+    if bool(op.attr("overwrite", True)):
+        out = x.at[ids].set(updates)
+    else:
+        out = x.at[ids].add(updates)
+    ctx.set_out(op, "Out", out)
+
+
+@register_lower("scatter_nd_add")
+def _scatter_nd_add(ctx, op):
+    x = ctx.in1(op, "X")
+    index = ctx.in1(op, "Index")
+    updates = ctx.in1(op, "Updates")
+    k = index.shape[-1]
+    idx = tuple(index[..., i] for i in range(k))
+    ctx.set_out(op, "Out", x.at[idx].add(updates))
+
+
+@register_lower("index_select")
+def _index_select(ctx, op):
+    x = ctx.in1(op, "X")
+    index = ctx.in1(op, "Index")
+    ctx.set_out(op, "Out", jnp.take(x, index, axis=int(op.attr("dim", 0))))
+
+
+@register_lower("cast")
+def _cast(ctx, op):
+    x = ctx.in1(op, "X")
+    ctx.set_out(op, "Out", x.astype(attr_dtype(op, "out_dtype")))
+
+
+@register_lower("expand", "tile")
+def _expand(ctx, op):
+    x = ctx.in1(op, "X")
+    times = [int(t) for t in (op.attr("expand_times", None) or op.attr("repeat_times", []))]
+    if len(times) < x.ndim:
+        times = [1] * (x.ndim - len(times)) + times
+    elif len(times) > x.ndim:
+        x = x.reshape((1,) * (len(times) - x.ndim) + x.shape)
+    ctx.set_out(op, "Out", jnp.tile(x, times))
+
+
+@register_lower("expand_as", "expand_as_v2")
+def _expand_as(ctx, op):
+    x = ctx.in1(op, "X")
+    target = op.inputs.get("Y") or op.inputs.get("target_tensor")
+    shape = tuple(ctx.get(target[0]).shape) if target else tuple(op.attr("target_shape", []))
+    ctx.set_out(op, "Out", jnp.broadcast_to(x, shape))
+
+
+@register_lower("expand_v2")
+def _expand_v2(ctx, op):
+    x = ctx.in1(op, "X")
+    shape = [int(s) for s in op.attr("shape", [])]
+    if len(shape) > x.ndim:
+        x = x.reshape((1,) * (len(shape) - x.ndim) + x.shape)
+    shape = [x.shape[i] if s == -1 else s for i, s in enumerate(shape)]
+    ctx.set_out(op, "Out", jnp.broadcast_to(x, shape))
+
+
+@register_lower("top_k", "top_k_v2")
+def _top_k(ctx, op):
+    x = ctx.in1(op, "X")
+    k = int(op.attr("k", 1))
+    kt = op.inputs.get("K")
+    if kt:
+        k = int(np.asarray(ctx.get(kt[0])).item())
+    axis = int(op.attr("axis", -1))
+    largest = bool(op.attr("largest", True))
+    if axis % x.ndim != x.ndim - 1:
+        xm = jnp.moveaxis(x, axis, -1)
+    else:
+        xm = x
+    vals, idx = jax.lax.top_k(xm if largest else -xm, k)
+    if not largest:
+        vals = -vals
+    if axis % x.ndim != x.ndim - 1:
+        vals = jnp.moveaxis(vals, -1, axis)
+        idx = jnp.moveaxis(idx, -1, axis)
+    ctx.set_out(op, "Out", vals)
+    ctx.set_out(op, "Indices", idx.astype(jnp.int64))
+
+
+@register_lower("arg_max")
+def _arg_max(ctx, op):
+    x = ctx.in1(op, "X")
+    axis = op.attr("axis", -1)
+    keepdims = bool(op.attr("keepdims", False))
+    flatten = bool(op.attr("flatten", False))
+    if flatten:
+        x = x.reshape(-1)
+        axis = 0
+    out = jnp.argmax(x, axis=int(axis))
+    if keepdims and not flatten:
+        out = jnp.expand_dims(out, int(axis))
+    ctx.set_out(op, "Out", out.astype(attr_dtype(op, "dtype", default="int64")))
+
+
+@register_lower("arg_min")
+def _arg_min(ctx, op):
+    x = ctx.in1(op, "X")
+    axis = int(op.attr("axis", -1))
+    out = jnp.argmin(x, axis=axis)
+    if bool(op.attr("keepdims", False)):
+        out = jnp.expand_dims(out, axis)
+    ctx.set_out(op, "Out", out.astype(attr_dtype(op, "dtype", default="int64")))
+
+
+@register_lower("argsort")
+def _argsort(ctx, op):
+    x = ctx.in1(op, "X")
+    axis = int(op.attr("axis", -1))
+    desc = bool(op.attr("descending", False))
+    idx = jnp.argsort(-x if desc else x, axis=axis)
+    out = jnp.take_along_axis(x, idx, axis=axis)
+    ctx.set_out(op, "Out", out)
+    ctx.set_out(op, "Indices", idx.astype(jnp.int64))
+
+
+@register_lower("where")
+def _where(ctx, op):
+    cond = ctx.in1(op, "Condition")
+    x = ctx.in1(op, "X")
+    y = ctx.in1(op, "Y")
+    ctx.set_out(op, "Out", jnp.where(cond, x, y))
+
+
+@register_lower("where_index")
+def _where_index(ctx, op):
+    # dynamic output shape: unsupported under XLA static shapes
+    raise NotImplementedError(
+        "where_index (nonzero) has data-dependent output shape; "
+        "use masking instead on TPU"
+    )
+
+
+@register_lower("one_hot", "one_hot_v2")
+def _one_hot(ctx, op):
+    x = ctx.in1(op, "X")
+    depth = int(op.attr("depth", -1))
+    dt = op.inputs.get("depth_tensor")
+    if dt:
+        depth = int(np.asarray(ctx.get(dt[0])).item())
+    if op.type == "one_hot" and x.ndim >= 2 and x.shape[-1] == 1:
+        x = jnp.squeeze(x, -1)
+    ctx.set_out(op, "Out", jax.nn.one_hot(x, depth, dtype=jnp.float32))
+
+
+@register_lower("shape")
+def _shape(ctx, op):
+    x = ctx.in1(op, "Input")
+    ctx.set_out(op, "Out", jnp.asarray(x.shape, dtype=jnp.int32))
+
+
+@register_lower("size")
+def _size(ctx, op):
+    x = ctx.in1(op, "Input")
+    ctx.set_out(op, "Out", jnp.asarray(x.size, dtype=jnp.int64))
+
+
+@register_lower("pad")
+def _pad(ctx, op):
+    x = ctx.in1(op, "X")
+    paddings = [int(p) for p in op.attr("paddings", [])]
+    pairs = [(paddings[2 * i], paddings[2 * i + 1]) for i in range(x.ndim)]
+    ctx.set_out(op, "Out", jnp.pad(x, pairs, constant_values=op.attr("pad_value", 0.0)))
+
+
+@register_lower("pad2d", "pad3d")
+def _pad2d(ctx, op):
+    x = ctx.in1(op, "X")
+    paddings = [int(p) for p in op.attr("paddings", [])]
+    mode = op.attr("mode", "constant")
+    fmt = op.attr("data_format", "NCHW")
+    nspatial = x.ndim - 2
+    # paddings given as [left,right,top,bottom,...] per reference pad2d/pad3d
+    spatial_pairs = [
+        (paddings[2 * i], paddings[2 * i + 1]) for i in range(len(paddings) // 2)
+    ]
+    spatial_pairs = list(reversed(spatial_pairs))[:nspatial]
+    while len(spatial_pairs) < nspatial:
+        spatial_pairs.insert(0, (0, 0))
+    if fmt.endswith("C"):  # NHWC/NDHWC
+        pairs = [(0, 0)] + spatial_pairs + [(0, 0)]
+    else:
+        pairs = [(0, 0), (0, 0)] + spatial_pairs
+    jmode = {"constant": "constant", "reflect": "reflect", "edge": "edge", "replicate": "edge", "circular": "wrap"}[mode]
+    if jmode == "constant":
+        out = jnp.pad(x, pairs, constant_values=op.attr("value", op.attr("pad_value", 0.0)))
+    else:
+        out = jnp.pad(x, pairs, mode=jmode)
+    ctx.set_out(op, "Out", out)
+
+
+@register_lower("tril_triu")
+def _tril_triu(ctx, op):
+    x = ctx.in1(op, "X")
+    diag = int(op.attr("diagonal", 0))
+    lower = bool(op.attr("lower", True))
+    ctx.set_out(op, "Out", jnp.tril(x, diag) if lower else jnp.triu(x, diag))
+
+
+@register_lower("cumsum")
+def _cumsum(ctx, op):
+    x = ctx.in1(op, "X")
+    axis = int(op.attr("axis", -1))
+    flatten = bool(op.attr("flatten", False))
+    if flatten:
+        x = x.reshape(-1)
+        axis = 0
+    out = jnp.cumsum(x, axis=axis)
+    if bool(op.attr("reverse", False)):
+        out = jnp.flip(jnp.cumsum(jnp.flip(x, axis), axis=axis), axis)
+    if bool(op.attr("exclusive", False)):
+        out = out - x
+    ctx.set_out(op, "Out", out)
+
+
+@register_lower("take_along_axis")
+def _take_along_axis(ctx, op):
+    x = ctx.in1(op, "Input")
+    idx = ctx.in1(op, "Index")
+    ctx.set_out(op, "Result", jnp.take_along_axis(x, idx, axis=int(op.attr("Axis", 0))))
+
+
+@register_lower("meshgrid")
+def _meshgrid(ctx, op):
+    xs = ctx.in_list(op, "X")
+    outs = jnp.meshgrid(*xs, indexing="ij")
+    for name, o in zip(op.outputs.get("Out", []), outs):
+        ctx.set(name, o)
+
+
+@register_lower("flip")
+def _flip(ctx, op):
+    x = ctx.in1(op, "X")
+    axes = [int(a) for a in op.attr("axis", [])]
+    ctx.set_out(op, "Out", jnp.flip(x, tuple(axes)))
+
+
+@register_lower("roll")
+def _roll(ctx, op):
+    x = ctx.in1(op, "X")
+    shifts = [int(s) for s in op.attr("shifts", [])]
+    axes = op.attr("axis", []) or None
+    if axes is not None:
+        axes = [int(a) for a in axes]
+        ctx.set_out(op, "Out", jnp.roll(x, shifts, axes))
+    else:
+        ctx.set_out(op, "Out", jnp.roll(x.reshape(-1), shifts[0]).reshape(x.shape))

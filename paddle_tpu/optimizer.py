@@ -1,0 +1,484 @@
+"""Optimizers — build update ops into the main program.
+
+Role parity: reference python/paddle/fluid/optimizer.py (Optimizer base :57,
+SGD :956, Momentum :1050, Adam :1853, Adamax :2119, Lamb :2962 ...) and
+python/paddle/optimizer (AdamW).  ``minimize`` = append_backward +
+regularization + grad clip + per-param update ops; the whole train step
+(fwd+bwd+update) compiles to one XLA computation.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from .framework import unique_name
+from .framework.backward import append_backward
+from .framework.program import (
+    Program,
+    Variable,
+    default_main_program,
+    default_startup_program,
+)
+from .initializer import ConstantInitializer
+
+
+class Optimizer:
+    _accum_defaults: Dict[str, float] = {}
+
+    def __init__(
+        self,
+        learning_rate=0.001,
+        parameter_list=None,
+        regularization=None,
+        grad_clip=None,
+        name=None,
+    ):
+        self._learning_rate = learning_rate
+        self._parameter_list = parameter_list
+        self.regularization = regularization
+        self._grad_clip = grad_clip
+        self._name = name or unique_name.generate(type(self).__name__)
+        self._lr_var: Optional[Variable] = None
+        self._accumulators: Dict[str, Dict[str, Variable]] = {}
+
+    # -- learning rate ---------------------------------------------------
+    def _create_global_learning_rate(self, program=None):
+        if self._lr_var is not None:
+            return self._lr_var
+        from .optimizer_lr import LRScheduler
+
+        program = program or default_main_program()
+        lr_value = self._learning_rate
+        if isinstance(lr_value, LRScheduler):
+            lr_value._bind(self)
+            init = lr_value.get_lr()
+        elif isinstance(lr_value, Variable):
+            self._lr_var = lr_value
+            return lr_value
+        else:
+            init = float(lr_value)
+        name = unique_name.generate("learning_rate")
+        self._lr_var = program.global_block.create_var(
+            name=name, shape=[1], dtype="float32", persistable=True, stop_gradient=True
+        )
+        sb = default_startup_program().global_block
+        sv = sb.create_var(name=name, shape=[1], dtype="float32", persistable=True)
+        ConstantInitializer(init)(sv, sb)
+        return self._lr_var
+
+    def set_lr(self, value: float, scope=None):
+        """Host-side LR update: writes the scalar into the scope (4-byte H2D,
+        no recompile — the LR var is part of the compiled step's state)."""
+        import numpy as np
+
+        from .framework.scope import global_scope
+
+        scope = scope or global_scope()
+        if self._lr_var is not None:
+            scope.set_var(self._lr_var.name, np.asarray([value], dtype="float32"))
+
+    def get_lr(self) -> float:
+        import numpy as np
+
+        from .framework.scope import global_scope
+
+        if self._lr_var is None:
+            lr = self._learning_rate
+            return float(lr if not hasattr(lr, "get_lr") else lr.get_lr())
+        try:
+            return float(np.asarray(global_scope().get_var(self._lr_var.name))[0])
+        except KeyError:
+            return 0.0
+
+    # -- accumulators ----------------------------------------------------
+    def _add_accumulator(self, name, param, fill_value=0.0, shape=None, dtype="float32"):
+        key = name
+        self._accumulators.setdefault(key, {})
+        if param.name in self._accumulators[key]:
+            return self._accumulators[key][param.name]
+        var_name = unique_name.generate(f"{param.name}_{name}")
+        shape = list(shape if shape is not None else param.shape)
+        v = default_main_program().global_block.create_var(
+            name=var_name, shape=shape, dtype=dtype, persistable=True, stop_gradient=True
+        )
+        sb = default_startup_program().global_block
+        sv = sb.create_var(name=var_name, shape=shape, dtype=dtype, persistable=True)
+        ConstantInitializer(fill_value)(sv, sb)
+        self._accumulators[key][param.name] = v
+        return v
+
+    def _get_accumulator(self, name, param):
+        return self._accumulators[name][param.name]
+
+    # -- pipeline --------------------------------------------------------
+    def backward(self, loss, startup_program=None, parameter_list=None, no_grad_set=None):
+        plist = parameter_list or self._parameter_list
+        return append_backward(loss, parameter_list=plist, no_grad_set=no_grad_set)
+
+    def apply_gradients(self, params_grads):
+        params_grads = self._apply_regularization(params_grads)
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        self._create_global_learning_rate()
+        block = default_main_program().global_block
+        ops = []
+        self._create_accumulators(block, [p for p, _ in params_grads])
+        for p, g in params_grads:
+            ops.append(self._append_optimize_op(block, (p, g)))
+        self._finish_update(block, params_grads)
+        return ops
+
+    def apply_optimize(self, loss, startup_program, params_grads):
+        return self.apply_gradients(params_grads)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None, no_grad_set=None):
+        params_grads = self.backward(
+            loss, startup_program, parameter_list or self._parameter_list, no_grad_set
+        )
+        opt_ops = self.apply_gradients(params_grads)
+        return opt_ops, params_grads
+
+    # hooks
+    def _create_accumulators(self, block, params):
+        pass
+
+    def _finish_update(self, block, params_grads):
+        pass
+
+    def _append_optimize_op(self, block, param_and_grad):
+        raise NotImplementedError
+
+    def _apply_regularization(self, params_grads):
+        from .regularizer import append_regularization_ops
+
+        return append_regularization_ops(params_grads, self.regularization)
+
+    # parity helper used by fleet / meta optimizers
+    def _effective_lr_input(self, param):
+        return self._lr_var
+
+
+class SGDOptimizer(Optimizer):
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        return block.append_op(
+            "sgd",
+            {"Param": p, "Grad": g, "LearningRate": self._lr_var},
+            {"ParamOut": p},
+        )
+
+
+class MomentumOptimizer(Optimizer):
+    def __init__(self, learning_rate, momentum=0.9, use_nesterov=False, **kw):
+        super().__init__(learning_rate, **kw)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _create_accumulators(self, block, params):
+        for p in params:
+            self._add_accumulator("velocity", p)
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        v = self._get_accumulator("velocity", p)
+        return block.append_op(
+            "momentum",
+            {"Param": p, "Grad": g, "Velocity": v, "LearningRate": self._lr_var},
+            {"ParamOut": p, "VelocityOut": v},
+            {"mu": self._momentum, "use_nesterov": self._use_nesterov},
+        )
+
+
+class _AdamBase(Optimizer):
+    op_type = "adam"
+
+    def __init__(
+        self,
+        learning_rate=0.001,
+        beta1=0.9,
+        beta2=0.999,
+        epsilon=1e-8,
+        lazy_mode=False,
+        **kw,
+    ):
+        super().__init__(learning_rate, **kw)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _create_accumulators(self, block, params):
+        for p in params:
+            self._add_accumulator("moment1", p)
+            self._add_accumulator("moment2", p)
+            self._add_accumulator("beta1_pow", p, fill_value=self._beta1, shape=[1])
+            self._add_accumulator("beta2_pow", p, fill_value=self._beta2, shape=[1])
+
+    def _extra_attrs(self, param):
+        return {}
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        attrs = {
+            "beta1": self._beta1,
+            "beta2": self._beta2,
+            "epsilon": self._epsilon,
+            **self._extra_attrs(p),
+        }
+        return block.append_op(
+            self.op_type,
+            {
+                "Param": p,
+                "Grad": g,
+                "Moment1": self._get_accumulator("moment1", p),
+                "Moment2": self._get_accumulator("moment2", p),
+                "Beta1Pow": self._get_accumulator("beta1_pow", p),
+                "Beta2Pow": self._get_accumulator("beta2_pow", p),
+                "LearningRate": self._lr_var,
+            },
+            {
+                "ParamOut": p,
+                "Moment1Out": self._get_accumulator("moment1", p),
+                "Moment2Out": self._get_accumulator("moment2", p),
+                "Beta1PowOut": self._get_accumulator("beta1_pow", p),
+                "Beta2PowOut": self._get_accumulator("beta2_pow", p),
+            },
+            attrs,
+        )
+
+
+class AdamOptimizer(_AdamBase):
+    op_type = "adam"
+
+
+class AdamWOptimizer(_AdamBase):
+    """Decoupled weight decay (paddle 2.0 paddle.optimizer.AdamW)."""
+
+    op_type = "adamw"
+
+    def __init__(self, learning_rate=0.001, weight_decay=0.01, apply_decay_param_fun=None, **kw):
+        super().__init__(learning_rate, **kw)
+        self._weight_decay = weight_decay
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _extra_attrs(self, param):
+        decay = self._weight_decay
+        if self._apply_decay_param_fun is not None and not self._apply_decay_param_fun(param.name):
+            decay = 0.0
+        return {"coeff": float(decay), "with_decay": decay != 0.0}
+
+
+class AdamaxOptimizer(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, **kw):
+        super().__init__(learning_rate, **kw)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _create_accumulators(self, block, params):
+        for p in params:
+            self._add_accumulator("moment", p)
+            self._add_accumulator("inf_norm", p)
+            self._add_accumulator("beta1_pow", p, fill_value=self._beta1, shape=[1])
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        return block.append_op(
+            "adamax",
+            {
+                "Param": p,
+                "Grad": g,
+                "Moment": self._get_accumulator("moment", p),
+                "InfNorm": self._get_accumulator("inf_norm", p),
+                "Beta1Pow": self._get_accumulator("beta1_pow", p),
+                "LearningRate": self._lr_var,
+            },
+            {
+                "ParamOut": p,
+                "MomentOut": self._get_accumulator("moment", p),
+                "InfNormOut": self._get_accumulator("inf_norm", p),
+            },
+            {"beta1": self._beta1, "beta2": self._beta2, "epsilon": self._epsilon},
+        )
+
+    def _finish_update(self, block, params_grads):
+        for p, _ in params_grads:
+            b1p = self._get_accumulator("beta1_pow", p)
+            block.append_op(
+                "scale", {"X": b1p}, {"Out": b1p}, {"scale": self._beta1}
+            )
+
+
+class AdagradOptimizer(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, initial_accumulator_value=0.0, **kw):
+        super().__init__(learning_rate, **kw)
+        self._epsilon = epsilon
+        self._init_accum = initial_accumulator_value
+
+    def _create_accumulators(self, block, params):
+        for p in params:
+            self._add_accumulator("moment", p, fill_value=self._init_accum)
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        m = self._get_accumulator("moment", p)
+        return block.append_op(
+            "adagrad",
+            {"Param": p, "Grad": g, "Moment": m, "LearningRate": self._lr_var},
+            {"ParamOut": p, "MomentOut": m},
+            {"epsilon": self._epsilon},
+        )
+
+
+class AdadeltaOptimizer(Optimizer):
+    def __init__(self, learning_rate=1.0, epsilon=1e-6, rho=0.95, **kw):
+        super().__init__(learning_rate, **kw)
+        self._epsilon, self._rho = epsilon, rho
+
+    def _create_accumulators(self, block, params):
+        for p in params:
+            self._add_accumulator("avg_squared_grad", p)
+            self._add_accumulator("avg_squared_update", p)
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        return block.append_op(
+            "adadelta",
+            {
+                "Param": p,
+                "Grad": g,
+                "AvgSquaredGrad": self._get_accumulator("avg_squared_grad", p),
+                "AvgSquaredUpdate": self._get_accumulator("avg_squared_update", p),
+            },
+            {
+                "ParamOut": p,
+                "AvgSquaredGradOut": self._get_accumulator("avg_squared_grad", p),
+                "AvgSquaredUpdateOut": self._get_accumulator("avg_squared_update", p),
+            },
+            {"epsilon": self._epsilon, "rho": self._rho},
+        )
+
+
+class RMSPropOptimizer(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0, centered=False, **kw):
+        super().__init__(learning_rate, **kw)
+        self._rho, self._epsilon, self._momentum, self._centered = rho, epsilon, momentum, centered
+
+    def _create_accumulators(self, block, params):
+        for p in params:
+            self._add_accumulator("mean_square", p)
+            self._add_accumulator("moment", p)
+            if self._centered:
+                self._add_accumulator("mean_grad", p)
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        inputs = {
+            "Param": p,
+            "Grad": g,
+            "MeanSquare": self._get_accumulator("mean_square", p),
+            "Moment": self._get_accumulator("moment", p),
+            "LearningRate": self._lr_var,
+        }
+        outputs = {
+            "ParamOut": p,
+            "MeanSquareOut": self._get_accumulator("mean_square", p),
+            "MomentOut": self._get_accumulator("moment", p),
+        }
+        if self._centered:
+            inputs["MeanGrad"] = self._get_accumulator("mean_grad", p)
+            outputs["MeanGradOut"] = self._get_accumulator("mean_grad", p)
+        return block.append_op(
+            "rmsprop",
+            inputs,
+            outputs,
+            {
+                "decay": self._rho,
+                "epsilon": self._epsilon,
+                "momentum": self._momentum,
+                "centered": self._centered,
+            },
+        )
+
+
+class LambOptimizer(_AdamBase):
+    op_type = "lamb"
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9, beta2=0.999, epsilon=1e-6, exclude_from_weight_decay_fn=None, **kw):
+        super().__init__(learning_rate, beta1=beta1, beta2=beta2, epsilon=epsilon, **kw)
+        self._weight_decay = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _extra_attrs(self, param):
+        wd = self._weight_decay
+        if self._exclude_fn is not None and self._exclude_fn(param):
+            wd = 0.0
+        return {"weight_decay": float(wd)}
+
+
+class LarsMomentumOptimizer(Optimizer):
+    def __init__(self, learning_rate, momentum=0.9, lars_coeff=0.001, lars_weight_decay=0.0005, epsilon=0.0, **kw):
+        super().__init__(learning_rate, **kw)
+        self._momentum = momentum
+        self._lars_coeff = lars_coeff
+        self._lars_weight_decay = lars_weight_decay
+        self._epsilon = epsilon
+
+    def _create_accumulators(self, block, params):
+        for p in params:
+            self._add_accumulator("velocity", p)
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        v = self._get_accumulator("velocity", p)
+        return block.append_op(
+            "lars_momentum",
+            {"Param": p, "Grad": g, "Velocity": v, "LearningRate": self._lr_var},
+            {"ParamOut": p, "VelocityOut": v},
+            {
+                "mu": self._momentum,
+                "lars_coeff": self._lars_coeff,
+                "lars_weight_decay": self._lars_weight_decay,
+                "epsilon": self._epsilon,
+            },
+        )
+
+
+class FtrlOptimizer(Optimizer):
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, lr_power=-0.5, **kw):
+        super().__init__(learning_rate, **kw)
+        self._l1, self._l2, self._lr_power = l1, l2, lr_power
+
+    def _create_accumulators(self, block, params):
+        for p in params:
+            self._add_accumulator("squared", p)
+            self._add_accumulator("linear", p)
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        return block.append_op(
+            "ftrl",
+            {
+                "Param": p,
+                "Grad": g,
+                "SquaredAccumulator": self._get_accumulator("squared", p),
+                "LinearAccumulator": self._get_accumulator("linear", p),
+                "LearningRate": self._lr_var,
+            },
+            {
+                "ParamOut": p,
+                "SquaredAccumOut": self._get_accumulator("squared", p),
+                "LinearAccumOut": self._get_accumulator("linear", p),
+            },
+            {"l1": self._l1, "l2": self._l2, "lr_power": self._lr_power},
+        )
+
+
+# reference spelling aliases
+SGD = SGDOptimizer
+Momentum = MomentumOptimizer
+Adam = AdamOptimizer
+AdamW = AdamWOptimizer
+Adamax = AdamaxOptimizer
+Adagrad = AdagradOptimizer
+Adadelta = AdadeltaOptimizer
+RMSProp = RMSPropOptimizer
+Lamb = LambOptimizer
+LarsMomentum = LarsMomentumOptimizer
+Ftrl = FtrlOptimizer

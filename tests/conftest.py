@@ -1,0 +1,43 @@
+"""Test configuration: CPU simulation with 8 virtual devices.
+
+Mirrors the reference's localhost-cluster test pattern (SURVEY.md §4): all
+tests run on the jax CPU backend with 8 virtual devices so multi-chip
+sharding is exercised without TPU hardware.  Must run before jax imports.
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_programs():
+    """Each test gets fresh default programs + scope (reference tests use
+    new Programs per test via program_guard)."""
+    import paddle_tpu as pt
+    from paddle_tpu.framework import program as prog_mod
+    from paddle_tpu.framework import scope as scope_mod
+    from paddle_tpu.framework import unique_name
+
+    old_main = prog_mod._main_program
+    old_startup = prog_mod._startup_program
+    old_scope = scope_mod._global_scope
+    prog_mod._main_program = prog_mod.Program()
+    prog_mod._startup_program = prog_mod.Program()
+    scope_mod._global_scope = scope_mod.Scope()
+    with unique_name.guard():
+        yield
+    prog_mod._main_program = old_main
+    prog_mod._startup_program = old_startup
+    scope_mod._global_scope = old_scope
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(1234)

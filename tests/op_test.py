@@ -1,0 +1,201 @@
+"""OpTest: numpy-oracle per-op parity harness.
+
+Role parity: reference python/paddle/fluid/tests/unittests/op_test.py
+(OpTest:226, check_output_with_place:1021, check_grad_with_place:1341) —
+declare op_type / inputs / attrs / expected outputs in numpy; the harness
+builds a one-op program, runs it through the real Executor, and compares.
+check_grad compares append_backward analytic grads against numeric central
+differences.
+"""
+from __future__ import annotations
+
+import unittest
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu.framework import dtypes
+from paddle_tpu.framework.backward import append_backward
+from paddle_tpu.framework.program import Program, program_guard
+
+
+def _flatten_spec(spec):
+    """inputs/outputs may be {slot: array} or {slot: [(name, array), ...]}."""
+    flat = {}
+    for slot, val in (spec or {}).items():
+        if isinstance(val, list) and val and isinstance(val[0], tuple):
+            flat[slot] = [(n, np.asarray(a)) for n, a in val]
+        elif val is None:
+            flat[slot] = []
+        else:
+            flat[slot] = [(f"{slot}_0" if slot != slot.upper() else slot, np.asarray(val))]
+    return flat
+
+
+class OpTest(unittest.TestCase):
+    op_type: str = ""
+
+    def setUp(self):
+        self.inputs = {}
+        self.outputs = {}
+        self.attrs = {}
+        if hasattr(self, "setup"):
+            self.setup()
+
+    # ------------------------------------------------------------------
+    def _build(self, need_grad_of=None):
+        prog = Program()
+        startup = Program()
+        feed = {}
+        fetch = []
+        with program_guard(prog, startup):
+            block = prog.global_block
+            in_spec = _flatten_spec(self.inputs)
+            out_spec = _flatten_spec(self.outputs)
+            op_inputs = {}
+            for slot, pairs in in_spec.items():
+                names = []
+                for name, arr in pairs:
+                    var = block.create_var(
+                        name=name,
+                        shape=arr.shape,
+                        dtype=str(arr.dtype)
+                        if arr.dtype.name != "bfloat16"
+                        else "bfloat16",
+                        stop_gradient=False
+                        if np.issubdtype(arr.dtype, np.floating)
+                        else True,
+                    )
+                    feed[name] = arr
+                    names.append(name)
+                op_inputs[slot] = names
+            op_outputs = {}
+            for slot, pairs in out_spec.items():
+                names = []
+                for name, arr in pairs:
+                    block.create_var(name=name, shape=arr.shape, dtype=str(arr.dtype))
+                    names.append(name)
+                    fetch.append((slot, name, arr))
+                op_outputs[slot] = names
+            block.append_op(self.op_type, op_inputs, op_outputs, dict(self.attrs))
+        return prog, feed, fetch
+
+    # ------------------------------------------------------------------
+    def check_output(self, atol=1e-5, rtol=1e-5, no_check_set=None, place=None):
+        prog, feed, fetch = self._build()
+        exe = pt.Executor(place or pt.CPUPlace())
+        no_check = set(no_check_set or ())
+        names = [n for _, n, _ in fetch]
+        outs = exe.run(prog, feed=feed, fetch_list=names)
+        for (slot, name, expect), got in zip(fetch, outs):
+            if slot in no_check or name in no_check:
+                continue
+            got = np.asarray(got, dtype=np.asarray(expect).dtype)
+            np.testing.assert_allclose(
+                got,
+                expect,
+                atol=atol,
+                rtol=rtol,
+                err_msg=f"op {self.op_type}: output {slot}/{name} mismatch",
+            )
+
+    # ------------------------------------------------------------------
+    def check_grad(
+        self,
+        inputs_to_check,
+        output_name,
+        max_relative_error=0.005,
+        no_grad_set=None,
+        numeric_delta=1e-3,
+        user_defined_grads=None,
+    ):
+        """Analytic (append_backward) vs numeric central-difference grads of
+        sum(output) w.r.t. each input in inputs_to_check."""
+        prog, feed, fetch = self._build()
+        with program_guard(prog):
+            block = prog.global_block
+            out_var = block.var(
+                output_name
+                if block.has_var(output_name)
+                else _flatten_spec(self.outputs)[output_name][0][0]
+            )
+            # scalarize: loss = mean-like reduce via reduce_sum -> shape [1]
+            loss_name = "__loss__"
+            block.create_var(name=loss_name, shape=(), dtype="float32")
+            ssum = "__loss_sum__"
+            block.create_var(name=ssum, shape=(), dtype=out_var.dtype)
+            block.append_op(
+                "reduce_sum", {"X": out_var}, {"Out": ssum}, {"reduce_all": True}
+            )
+            block.append_op(
+                "cast",
+                {"X": ssum},
+                {"Out": loss_name},
+                {"in_dtype": out_var.dtype, "out_dtype": dtypes.to_enum("float32")},
+            )
+            loss = block.var(loss_name)
+            pg = append_backward(
+                loss,
+                parameter_list=list(inputs_to_check),
+                no_grad_set=no_grad_set,
+            )
+        grad_names = {p.name: g.name for p, g in pg}
+        exe = pt.Executor(pt.CPUPlace())
+        missing = [n for n in inputs_to_check if n not in grad_names]
+        assert not missing, f"no grad produced for {missing}"
+        analytic = exe.run(
+            prog, feed=feed, fetch_list=[grad_names[n] for n in inputs_to_check]
+        )
+
+        if user_defined_grads is not None:
+            for name, got, expect in zip(inputs_to_check, analytic, user_defined_grads):
+                self._assert_grad_close(got, np.asarray(expect), name, max_relative_error)
+            return
+
+        # numeric grads on a fresh forward-only program
+        fprog, ffeed, ffetch = self._build()
+        with program_guard(fprog):
+            block = fprog.global_block
+            out_var2 = block.var(out_var.name)
+            block.create_var(name=ssum, shape=(), dtype=out_var2.dtype)
+            block.append_op(
+                "reduce_sum", {"X": out_var2}, {"Out": ssum}, {"reduce_all": True}
+            )
+
+        def f(feed_override):
+            vals = exe.run(fprog, feed=feed_override, fetch_list=[ssum])
+            return float(np.asarray(vals[0]))
+
+        for name, got in zip(inputs_to_check, analytic):
+            base = feed[name].astype(np.float64)
+            num = np.zeros_like(base, dtype=np.float64)
+            flat = base.ravel()
+            nflat = num.ravel()
+            for i in range(flat.size):
+                for sgn, acc in ((1, 1.0), (-1, -1.0)):
+                    pert = flat.copy()
+                    pert[i] += sgn * numeric_delta
+                    f2 = dict(feed)
+                    f2[name] = pert.reshape(base.shape).astype(feed[name].dtype)
+                    nflat[i] += acc * f(f2)
+                nflat[i] /= 2 * numeric_delta
+            self._assert_grad_close(np.asarray(got), num, name, max_relative_error)
+
+    def _assert_grad_close(self, got, expect, name, max_rel):
+        got = got.astype(np.float64)
+        expect = expect.astype(np.float64)
+        denom = np.maximum(np.abs(expect), 1.0)
+        rel = np.abs(got - expect) / denom
+        self.assertLessEqual(
+            float(rel.max(initial=0.0)),
+            max_rel,
+            msg=f"op {self.op_type}: grad mismatch for {name}: "
+            f"max rel err {rel.max(initial=0.0):.3e}\nanalytic={got}\nnumeric={expect}",
+        )
+
+
+def skip_check_grad_ci(reason=""):
+    def deco(cls):
+        return cls
+
+    return deco

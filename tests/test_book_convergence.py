@@ -1,0 +1,137 @@
+"""Book-style end-to-end convergence tests.
+
+Role parity: reference python/paddle/fluid/tests/book/ (test_fit_a_line.py,
+test_recognize_digits.py) — build a model with layers, train with an
+optimizer through the Executor, assert the loss falls below a threshold.
+Data is synthetic (no-egress environment): class-prototype images with
+noise, which LeNet must fit nearly perfectly.
+"""
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.framework.program import Program, program_guard
+from paddle_tpu.optimizer import AdamOptimizer, MomentumOptimizer, SGDOptimizer
+
+
+def _proto_sampler(rng, num_classes=10, hw=28):
+    protos = rng.randn(num_classes, 1, hw, hw).astype("float32")
+
+    def sample(n):
+        labels = rng.randint(0, num_classes, n).astype("int64")
+        imgs = protos[labels] + 0.15 * rng.randn(n, 1, hw, hw).astype("float32")
+        return imgs, labels[:, None]
+
+    return sample
+
+
+def test_fit_a_line():
+    """Linear regression converges (reference book/test_fit_a_line.py)."""
+    rng = np.random.RandomState(0)
+    true_w = rng.randn(13, 1).astype("float32")
+    main, startup = Program(), Program()
+    main.random_seed = 7
+    with program_guard(main, startup):
+        x = layers.data("x", [13])
+        y = layers.data("y", [1])
+        pred = layers.fc(x, 1)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        SGDOptimizer(learning_rate=0.01).minimize(loss)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    last = None
+    for i in range(200):
+        xv = rng.randn(32, 13).astype("float32")
+        yv = xv @ true_w
+        (last,) = exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss])
+    assert last[0] < 0.1, f"fit_a_line did not converge: {last}"
+
+
+def _lenet(img, label):
+    c1 = layers.conv2d(img, 6, 5, padding=2, act="relu")
+    p1 = layers.pool2d(c1, 2, "max", 2)
+    c2 = layers.conv2d(p1, 16, 5, act="relu")
+    p2 = layers.pool2d(c2, 2, "max", 2)
+    f1 = layers.fc(p2, 120, act="relu")
+    f2 = layers.fc(f1, 84, act="relu")
+    logits = layers.fc(f2, 10)
+    loss = layers.mean(
+        layers.softmax_with_cross_entropy(logits, label)
+    )
+    acc = layers.accuracy(layers.softmax(logits), label)
+    return loss, acc
+
+
+def test_recognize_digits_lenet():
+    """LeNet on synthetic digits (reference book/test_recognize_digits.py)."""
+    rng = np.random.RandomState(42)
+    main, startup = Program(), Program()
+    main.random_seed = 42
+    with program_guard(main, startup):
+        img = layers.data("img", [1, 28, 28])
+        label = layers.data("label", [1], dtype="int64")
+        loss, acc = _lenet(img, label)
+        AdamOptimizer(learning_rate=1e-3).minimize(loss)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    sample = _proto_sampler(rng)
+    losses = []
+    for i in range(60):
+        imgs, labels = sample(32)
+        lv, av = exe.run(main, feed={"img": imgs, "label": labels}, fetch_list=[loss, acc])
+        losses.append(float(lv[0]))
+    assert losses[-1] < 0.5, f"LeNet did not converge: {losses[-5:]}"
+    assert losses[-1] < losses[0] * 0.5
+
+
+def test_mlp_adam_accuracy():
+    rng = np.random.RandomState(3)
+    main, startup = Program(), Program()
+    main.random_seed = 3
+    with program_guard(main, startup):
+        x = layers.data("x", [20])
+        label = layers.data("label", [1], dtype="int64")
+        h = layers.fc(x, 64, act="relu")
+        logits = layers.fc(h, 4)
+        loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
+        acc = layers.accuracy(layers.softmax(logits), label)
+        AdamOptimizer(learning_rate=1e-3).minimize(loss)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    protos = rng.randn(4, 20).astype("float32")
+    accs = []
+    for i in range(300):
+        lbl = rng.randint(0, 4, 64).astype("int64")
+        xv = protos[lbl] + 0.3 * rng.randn(64, 20).astype("float32")
+        lv, av = exe.run(
+            main, feed={"x": xv, "label": lbl[:, None]}, fetch_list=[loss, acc]
+        )
+        accs.append(float(av[0]))
+    assert np.mean(accs[-20:]) > 0.95, f"accuracy too low: {np.mean(accs[-20:])}"
+
+
+def test_word2vec_embedding_trains():
+    """Embedding + fc language-model-ish task (reference book/test_word2vec.py)."""
+    rng = np.random.RandomState(5)
+    vocab, dim = 50, 16
+    main, startup = Program(), Program()
+    main.random_seed = 5
+    with program_guard(main, startup):
+        w = layers.data("w", [3], dtype="int64", append_batch_size=True)
+        emb = layers.embedding(w, (vocab, dim))
+        flat = layers.reshape(emb, [-1, 3 * dim])
+        h = layers.fc(flat, 64, act="relu")
+        logits = layers.fc(h, vocab)
+        label = layers.data("label", [1], dtype="int64")
+        loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
+        AdamOptimizer(learning_rate=5e-3).minimize(loss)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    # synthetic rule: next word = (sum of context) % vocab
+    losses = []
+    for i in range(200):
+        ctx = rng.randint(0, vocab, (64, 3)).astype("int64")
+        nxt = (ctx.sum(1) % vocab)[:, None].astype("int64")
+        (lv,) = exe.run(main, feed={"w": ctx, "label": nxt}, fetch_list=[loss])
+        losses.append(float(lv[0]))
+    assert losses[-1] < losses[0], f"word2vec loss not decreasing: {losses[::50]}"

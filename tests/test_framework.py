@@ -1,0 +1,138 @@
+"""IR foundation tests: desc round-trip, program builders, fingerprints."""
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu.framework import ir_pb2
+from paddle_tpu.framework.program import Program, program_guard
+
+
+def test_program_roundtrip():
+    prog = Program()
+    b = prog.global_block
+    b.create_var(name="x", shape=[-1, 3], dtype="float32", stop_gradient=True)
+    b.create_parameter("w", [3, 4], dtype="float32")
+    b.append_op(
+        "mul",
+        {"X": "x", "Y": "w"},
+        {"Out": "y"},
+        {"x_num_col_dims": 1, "y_num_col_dims": 1},
+    )
+    b.create_var(name="y", shape=[-1, 4])
+    data = prog.serialize_to_string()
+    prog2 = Program.parse_from_string(data)
+    assert len(prog2.blocks) == 1
+    b2 = prog2.global_block
+    assert set(b2.vars) == {"x", "w", "y"}
+    assert b2.vars["w"].persistable
+    assert b2.vars["x"].shape == (-1, 3)
+    assert len(b2.ops) == 1
+    op = b2.ops[0]
+    assert op.type == "mul"
+    assert op.input("X") == ["x"]
+    assert op.attr("x_num_col_dims") == 1
+    # fingerprint stability
+    assert prog.fingerprint() == prog2.fingerprint()
+
+
+def test_attr_kinds_roundtrip():
+    prog = Program()
+    b = prog.global_block
+    b.append_op(
+        "fake_op",
+        {},
+        {},
+        {
+            "i": 7,
+            "f": 0.5,
+            "s": "hello",
+            "b_true": True,
+            "ints": [1, 2, 3],
+            "floats": [1.5, 2.5],
+            "strings": ["a", "b"],
+            "bools": [True, False],
+        },
+    )
+    p2 = Program.parse_from_string(prog.serialize_to_string())
+    op = p2.global_block.ops[0]
+    assert op.attr("i") == 7
+    assert op.attr("f") == 0.5
+    assert op.attr("s") == "hello"
+    assert op.attr("b_true") is True
+    assert op.attr("ints") == [1, 2, 3]
+    assert op.attr("floats") == [1.5, 2.5]
+    assert op.attr("strings") == ["a", "b"]
+    assert op.attr("bools") == [True, False]
+
+
+def test_program_guard_and_defaults():
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = pt.layers.data("x", [4], dtype="float32")
+        y = pt.layers.fc(x, 8)
+    assert pt.default_main_program() is not main  # restored after guard
+    assert any(op.type == "mul" for op in main.global_block.ops)
+    # parameters created in both programs
+    params = [v.name for v in main.all_parameters()]
+    assert len(params) == 2  # weight + bias
+    startup_outs = [
+        n for op in startup.global_block.ops for n in op.output_arg_names()
+    ]
+    for p in params:
+        assert p in startup_outs
+
+
+def test_fingerprint_invalidation():
+    prog = Program()
+    f1 = prog.fingerprint()
+    prog.global_block.append_op("relu", {"X": "a"}, {"Out": "b"})
+    assert prog.fingerprint() != f1
+
+
+def test_clone_for_test_flips_is_test():
+    main = Program()
+    with program_guard(main, Program()):
+        x = pt.layers.data("x", [4])
+        h = pt.layers.dropout(x, 0.5)
+    test_prog = main.clone(for_test=True)
+    dop = [op for op in test_prog.global_block.ops if op.type == "dropout"][0]
+    assert dop.attr("is_test") is True
+
+
+def test_executor_basic_feed_fetch():
+    prog = Program()
+    with program_guard(prog, Program()):
+        x = pt.layers.data("x", [3], append_batch_size=True)
+        y = pt.layers.scale(x, scale=2.0, bias=1.0)
+    exe = pt.Executor(pt.CPUPlace())
+    xv = np.arange(6, dtype="float32").reshape(2, 3)
+    (out,) = exe.run(prog, feed={"x": xv}, fetch_list=[y])
+    np.testing.assert_allclose(out, xv * 2 + 1)
+
+
+def test_executor_compile_cache():
+    prog = Program()
+    with program_guard(prog, Program()):
+        x = pt.layers.data("x", [3])
+        y = pt.layers.relu(x)
+    exe = pt.Executor(pt.CPUPlace())
+    xv = np.ones((2, 3), "float32")
+    exe.run(prog, feed={"x": xv}, fetch_list=[y])
+    assert len(exe._cache) == 1
+    exe.run(prog, feed={"x": xv + 1}, fetch_list=[y])
+    assert len(exe._cache) == 1  # same shapes -> cache hit
+    exe.run(prog, feed={"x": np.ones((4, 3), "float32")}, fetch_list=[y])
+    assert len(exe._cache) == 2  # new batch size -> new executable
+
+
+def test_rng_determinism_per_scope_seed():
+    prog = Program()
+    prog.random_seed = 42
+    with program_guard(prog, Program()):
+        u = pt.layers.uniform_random([4, 4], min=0.0, max=1.0)
+    s1, s2 = pt.framework.Scope(), pt.framework.Scope()
+    exe = pt.Executor(pt.CPUPlace())
+    (a,) = exe.run(prog, fetch_list=[u], scope=s1)
+    (b,) = exe.run(prog, fetch_list=[u], scope=s2)
+    np.testing.assert_allclose(a, b)  # same seed, same stream
+    (c,) = exe.run(prog, fetch_list=[u], scope=s1)
+    assert not np.allclose(a, c)  # key advances within a scope

@@ -1,0 +1,491 @@
+"""Per-op numpy parity + gradient checks via the OpTest harness.
+
+Mirrors reference unittests/test_*_op.py structure (SURVEY.md §4).
+"""
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+
+class TestElementwiseAdd(OpTest):
+    op_type = "elementwise_add"
+
+    def setup(self):
+        x = np.random.rand(3, 4).astype("float32")
+        y = np.random.rand(3, 4).astype("float32")
+        self.inputs = {"X": [("x", x)], "Y": [("y", y)]}
+        self.outputs = {"Out": [("out", x + y)]}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["x", "y"], "Out")
+
+
+class TestElementwiseAddBroadcast(OpTest):
+    op_type = "elementwise_add"
+
+    def setup(self):
+        x = np.random.rand(2, 3, 4).astype("float32")
+        y = np.random.rand(3,).astype("float32")
+        self.inputs = {"X": [("x", x)], "Y": [("y", y)]}
+        self.attrs = {"axis": 1}
+        self.outputs = {"Out": [("out", x + y[None, :, None])]}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["x", "y"], "Out")
+
+
+class TestMatmul(OpTest):
+    op_type = "matmul"
+
+    def setup(self):
+        x = np.random.rand(4, 5).astype("float32")
+        y = np.random.rand(5, 3).astype("float32")
+        self.inputs = {"X": [("x", x)], "Y": [("y", y)]}
+        self.outputs = {"Out": [("out", x @ y)]}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["x", "y"], "Out", max_relative_error=0.01)
+
+
+class TestMatmulTranspose(OpTest):
+    op_type = "matmul"
+
+    def setup(self):
+        x = np.random.rand(5, 4).astype("float32")
+        y = np.random.rand(3, 5).astype("float32")
+        self.inputs = {"X": [("x", x)], "Y": [("y", y)]}
+        self.attrs = {"transpose_X": True, "transpose_Y": True}
+        self.outputs = {"Out": [("out", x.T @ y.T)]}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestMul(OpTest):
+    op_type = "mul"
+
+    def setup(self):
+        x = np.random.rand(2, 3, 4).astype("float32")
+        y = np.random.rand(12, 5).astype("float32")
+        self.inputs = {"X": [("x", x)], "Y": [("y", y)]}
+        self.attrs = {"x_num_col_dims": 1, "y_num_col_dims": 1}
+        self.outputs = {"Out": [("out", x.reshape(2, 12) @ y)]}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["x", "y"], "Out", max_relative_error=0.01)
+
+
+class TestSoftmax(OpTest):
+    op_type = "softmax"
+
+    def setup(self):
+        x = np.random.rand(3, 7).astype("float32")
+        e = np.exp(x - x.max(-1, keepdims=True))
+        self.inputs = {"X": [("x", x)]}
+        self.outputs = {"Out": [("out", e / e.sum(-1, keepdims=True))]}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["x"], "Out")
+
+
+class TestSoftmaxWithCrossEntropy(OpTest):
+    op_type = "softmax_with_cross_entropy"
+
+    def setup(self):
+        logits = np.random.rand(5, 10).astype("float32")
+        labels = np.random.randint(0, 10, (5, 1)).astype("int64")
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        softmax = e / e.sum(-1, keepdims=True)
+        loss = -np.log(softmax[np.arange(5), labels.ravel()])[:, None]
+        self.inputs = {"Logits": [("logits", logits)], "Label": [("label", labels)]}
+        self.outputs = {
+            "Softmax": [("softmax", softmax)],
+            "Loss": [("loss", loss)],
+        }
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["logits"], "Loss")
+
+
+class TestReduceSum(OpTest):
+    op_type = "reduce_sum"
+
+    def setup(self):
+        x = np.random.rand(3, 4, 5).astype("float32")
+        self.inputs = {"X": [("x", x)]}
+        self.attrs = {"dim": [1]}
+        self.outputs = {"Out": [("out", x.sum(1))]}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["x"], "Out")
+
+
+class TestReduceMeanKeepdim(OpTest):
+    op_type = "reduce_mean"
+
+    def setup(self):
+        x = np.random.rand(3, 4).astype("float32")
+        self.inputs = {"X": [("x", x)]}
+        self.attrs = {"dim": [0], "keep_dim": True}
+        self.outputs = {"Out": [("out", x.mean(0, keepdims=True))]}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestConv2d(OpTest):
+    op_type = "conv2d"
+
+    def setup(self):
+        x = np.random.rand(2, 3, 8, 8).astype("float32")
+        w = np.random.rand(6, 3, 3, 3).astype("float32")
+        self.inputs = {"Input": [("x", x)], "Filter": [("w", w)]}
+        self.attrs = {"strides": [1, 1], "paddings": [1, 1], "dilations": [1, 1], "groups": 1}
+        # scipy-free reference conv
+        out = self._conv_ref(x, w, 1, 1)
+        self.outputs = {"Output": [("out", out)]}
+
+    @staticmethod
+    def _conv_ref(x, w, stride, pad):
+        n, c, h, ww = x.shape
+        o, _, kh, kw = w.shape
+        xp = np.pad(x, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+        oh = (h + 2 * pad - kh) // stride + 1
+        ow = (ww + 2 * pad - kw) // stride + 1
+        out = np.zeros((n, o, oh, ow), dtype=x.dtype)
+        for i in range(oh):
+            for j in range(ow):
+                patch = xp[:, :, i * stride : i * stride + kh, j * stride : j * stride + kw]
+                out[:, :, i, j] = np.einsum("nchw,ochw->no", patch, w)
+        return out
+
+    def test_output(self):
+        self.check_output(atol=1e-4, rtol=1e-4)
+
+    def test_grad(self):
+        self.check_grad(["x", "w"], "Output", max_relative_error=0.02, numeric_delta=1e-2)
+
+
+class TestPool2dMax(OpTest):
+    op_type = "pool2d"
+
+    def setup(self):
+        # well-separated values so numeric diff never flips the argmax
+        x = (np.random.permutation(2 * 3 * 4 * 4).astype("float32") / 10.0).reshape(2, 3, 4, 4)
+        out = x.reshape(2, 3, 2, 2, 2, 2).max(axis=(3, 5))
+        self.inputs = {"X": [("x", x)]}
+        self.attrs = {"pooling_type": "max", "ksize": [2, 2], "strides": [2, 2], "paddings": [0, 0]}
+        self.outputs = {"Out": [("out", out)]}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["x"], "Out", max_relative_error=0.02, numeric_delta=1e-3)
+
+
+class TestPool2dAvg(OpTest):
+    op_type = "pool2d"
+
+    def setup(self):
+        x = np.random.rand(2, 3, 4, 4).astype("float32")
+        out = x.reshape(2, 3, 2, 2, 2, 2).mean(axis=(3, 5))
+        self.inputs = {"X": [("x", x)]}
+        self.attrs = {"pooling_type": "avg", "ksize": [2, 2], "strides": [2, 2], "paddings": [0, 0]}
+        self.outputs = {"Out": [("out", out)]}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestLayerNorm(OpTest):
+    op_type = "layer_norm"
+
+    def setup(self):
+        x = np.random.rand(4, 6).astype("float32")
+        scale = np.random.rand(6).astype("float32")
+        bias = np.random.rand(6).astype("float32")
+        m = x.mean(-1, keepdims=True)
+        v = x.var(-1, keepdims=True)
+        y = (x - m) / np.sqrt(v + 1e-5) * scale + bias
+        self.inputs = {"X": [("x", x)], "Scale": [("scale", scale)], "Bias": [("bias", bias)]}
+        self.attrs = {"epsilon": 1e-5, "begin_norm_axis": 1}
+        self.outputs = {
+            "Y": [("y", y)],
+            "Mean": [("m", m.ravel())],
+            "Variance": [("v", v.ravel())],
+        }
+
+    def test_output(self):
+        self.check_output(atol=1e-4)
+
+    def test_grad(self):
+        self.check_grad(["x", "scale", "bias"], "Y", max_relative_error=0.02, numeric_delta=1e-2)
+
+
+class TestBatchNormInference(OpTest):
+    op_type = "batch_norm"
+
+    def setup(self):
+        x = np.random.rand(2, 3, 4, 4).astype("float32")
+        scale = np.random.rand(3).astype("float32")
+        bias = np.random.rand(3).astype("float32")
+        mean = np.random.rand(3).astype("float32")
+        var = np.random.rand(3).astype("float32") + 0.5
+        y = (x - mean[None, :, None, None]) / np.sqrt(var[None, :, None, None] + 1e-5)
+        y = y * scale[None, :, None, None] + bias[None, :, None, None]
+        self.inputs = {
+            "X": [("x", x)],
+            "Scale": [("scale", scale)],
+            "Bias": [("bias", bias)],
+            "Mean": [("mean", mean)],
+            "Variance": [("var", var)],
+        }
+        self.attrs = {"is_test": True, "epsilon": 1e-5}
+        self.outputs = {"Y": [("y", y)]}
+
+    def test_output(self):
+        self.check_output(atol=1e-4)
+
+
+class TestLookupTableV2(OpTest):
+    op_type = "lookup_table_v2"
+
+    def setup(self):
+        w = np.random.rand(10, 4).astype("float32")
+        ids = np.random.randint(0, 10, (3, 5)).astype("int64")
+        self.inputs = {"W": [("w", w)], "Ids": [("ids", ids)]}
+        self.outputs = {"Out": [("out", w[ids])]}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["w"], "Out")
+
+
+class TestDropoutTestMode(OpTest):
+    op_type = "dropout"
+
+    def setup(self):
+        x = np.random.rand(4, 4).astype("float32")
+        self.inputs = {"X": [("x", x)]}
+        self.attrs = {"dropout_prob": 0.35, "is_test": True}
+        self.outputs = {
+            "Out": [("out", x * 0.65)],
+            "Mask": [("mask", np.ones_like(x, dtype=np.uint8))],
+        }
+
+    def test_output(self):
+        self.check_output(no_check_set=["Mask"])
+
+
+class TestTranspose(OpTest):
+    op_type = "transpose2"
+
+    def setup(self):
+        x = np.random.rand(2, 3, 4).astype("float32")
+        self.inputs = {"X": [("x", x)]}
+        self.attrs = {"axis": [1, 0, 2]}
+        self.outputs = {
+            "Out": [("out", x.transpose(1, 0, 2))],
+            "XShape": [("xshape", np.zeros((0, 2, 3, 4), "float32"))],
+        }
+
+    def test_output(self):
+        self.check_output(no_check_set=["XShape"])
+
+    def test_grad(self):
+        self.check_grad(["x"], "Out")
+
+
+class TestReshape(OpTest):
+    op_type = "reshape2"
+
+    def setup(self):
+        x = np.random.rand(2, 6).astype("float32")
+        self.inputs = {"X": [("x", x)]}
+        self.attrs = {"shape": [3, -1]}
+        self.outputs = {
+            "Out": [("out", x.reshape(3, 4))],
+            "XShape": [("xshape", np.zeros((0, 2, 6), "float32"))],
+        }
+
+    def test_output(self):
+        self.check_output(no_check_set=["XShape"])
+
+    def test_grad(self):
+        self.check_grad(["x"], "Out")
+
+
+class TestConcat(OpTest):
+    op_type = "concat"
+
+    def setup(self):
+        a = np.random.rand(2, 3).astype("float32")
+        b = np.random.rand(2, 5).astype("float32")
+        self.inputs = {"X": [("a", a), ("b", b)]}
+        self.attrs = {"axis": 1}
+        self.outputs = {"Out": [("out", np.concatenate([a, b], 1))]}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["a", "b"], "Out")
+
+
+class TestSliceOp(OpTest):
+    op_type = "slice"
+
+    def setup(self):
+        x = np.random.rand(5, 6).astype("float32")
+        self.inputs = {"Input": [("x", x)]}
+        self.attrs = {"axes": [0, 1], "starts": [1, 2], "ends": [4, 6]}
+        self.outputs = {"Out": [("out", x[1:4, 2:6])]}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["x"], "Out")
+
+
+class TestGather(OpTest):
+    op_type = "gather"
+
+    def setup(self):
+        x = np.random.rand(8, 3).astype("float32")
+        idx = np.array([1, 3, 5], dtype="int64")
+        self.inputs = {"X": [("x", x)], "Index": [("idx", idx)]}
+        self.outputs = {"Out": [("out", x[idx])]}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["x"], "Out")
+
+
+class TestTopK(OpTest):
+    op_type = "top_k"
+
+    def setup(self):
+        x = np.random.rand(3, 6).astype("float32")
+        k = 2
+        idx = np.argsort(-x, axis=1)[:, :k]
+        vals = np.take_along_axis(x, idx, 1)
+        self.inputs = {"X": [("x", x)]}
+        self.attrs = {"k": k}
+        self.outputs = {"Out": [("out", vals)], "Indices": [("indices", idx.astype("int64"))]}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestCast(OpTest):
+    op_type = "cast"
+
+    def setup(self):
+        from paddle_tpu.framework import dtypes
+
+        x = np.random.rand(3, 4).astype("float32")
+        self.inputs = {"X": [("x", x)]}
+        self.attrs = {
+            "in_dtype": dtypes.to_enum("float32"),
+            "out_dtype": dtypes.to_enum("int32"),
+        }
+        self.outputs = {"Out": [("out", x.astype("int32"))]}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestScale(OpTest):
+    op_type = "scale"
+
+    def setup(self):
+        x = np.random.rand(3, 4).astype("float32")
+        self.inputs = {"X": [("x", x)]}
+        self.attrs = {"scale": 2.5, "bias": 0.7}
+        self.outputs = {"Out": [("out", x * 2.5 + 0.7)]}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["x"], "Out")
+
+
+class TestSigmoidCrossEntropyWithLogits(OpTest):
+    op_type = "sigmoid_cross_entropy_with_logits"
+
+    def setup(self):
+        x = np.random.randn(4, 5).astype("float32")
+        label = np.random.randint(0, 2, (4, 5)).astype("float32")
+        loss = np.maximum(x, 0) - x * label + np.log1p(np.exp(-np.abs(x)))
+        self.inputs = {"X": [("x", x)], "Label": [("label", label)]}
+        self.outputs = {"Out": [("out", loss)]}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["x"], "Out")
+
+
+class TestActivations(OpTest):
+    """Several activations batch-checked against numpy references."""
+
+    op_type = "activations"
+
+    def setUp(self):
+        pass
+
+    def test_many(self):
+        acts = {
+            "relu": lambda x: np.maximum(x, 0),
+            "sigmoid": lambda x: 1 / (1 + np.exp(-x)),
+            "tanh": np.tanh,
+            "leaky_relu": lambda x: np.where(x > 0, x, 0.02 * x),
+            "softplus": lambda x: np.log1p(np.exp(-np.abs(x))) + np.maximum(x, 0),
+            "silu": lambda x: x / (1 + np.exp(-x)),
+            "square": np.square,
+            "sqrt_abs": None,
+        }
+        for name, ref in acts.items():
+            if ref is None:
+                continue
+
+            class T(OpTest):
+                op_type = name
+
+            t = T(methodName="run")
+            x = np.random.randn(3, 4).astype("float32")
+            t.inputs = {"X": [("x", x)]}
+            t.attrs = {}
+            t.outputs = {"Out": [("out", ref(x).astype("float32"))]}
+            t.check_output(atol=1e-5)
+            t.check_grad(["x"], "Out", max_relative_error=0.02, numeric_delta=1e-3)
