@@ -8,6 +8,10 @@ over a device mesh.
 from . import framework  # noqa: F401
 from . import ops  # noqa: F401
 from . import initializer, layers, optimizer, regularizer  # noqa: F401
+from . import dygraph  # noqa: F401
+from .dygraph import grad, no_grad, to_variable  # noqa: F401
+from .dygraph.base import in_dygraph_mode, seed  # noqa: F401
+from .dygraph.tensor import Tensor  # noqa: F401
 from . import fluid  # noqa: F401
 from .framework.backward import append_backward, calc_gradient  # noqa: F401
 from .param_attr import ParamAttr  # noqa: F401

@@ -1,0 +1,20 @@
+"""Dygraph (eager) runtime.
+
+Role parity: reference paddle/fluid/imperative/ (§2.3 of SURVEY.md) +
+python/paddle/fluid/dygraph/.  Eager execution on jax arrays reusing the
+static path's op lowering rules; autograd by VJP replay.
+"""
+from . import base  # noqa: F401
+from .backward import grad, run_backward  # noqa: F401
+from .base import (  # noqa: F401
+    enable_grad,
+    enabled,
+    guard,
+    in_dygraph_mode,
+    no_grad,
+    seed,
+    to_variable,
+)
+from .eager import Tracer, apply_jax, run_op, tracer  # noqa: F401
+from .layers import Layer  # noqa: F401
+from .tensor import Parameter, Tensor  # noqa: F401

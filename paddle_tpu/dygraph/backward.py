@@ -1,0 +1,159 @@
+"""Dygraph autograd engine: reverse-topological VJP replay over the tape.
+
+Role parity: reference imperative/basic_engine.cc (`Init`:38 seeds the
+root grad, `PrepareDeps`:134 counts consumers, `Execute`:171 walks the
+queue) + gradient_accumulator.cc (leaf grad summation) +
+partial_grad_engine.cc (`paddle.grad` over an input subset).  TPU-native:
+each node's backward is `jax.vjp` of its re-run forward; under `jit` the
+recomputation is CSE'd by XLA, so cost matches hand-written grad kernels.
+"""
+from __future__ import annotations
+
+from collections import deque
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .tensor import Tensor
+
+
+def _reachable_nodes(roots: List[Tensor]):
+    seen = set()
+    order = []
+    stack = [t.grad_node for t in roots if t.grad_node is not None]
+    while stack:
+        node = stack.pop()
+        if node is None or id(node) in seen:
+            continue
+        seen.add(id(node))
+        order.append(node)
+        for t in node.in_tensors:
+            if t.grad_node is not None:
+                stack.append(t.grad_node)
+    return {id(n): n for n in order}
+
+
+def run_backward(roots: List[Tensor], seeds: Optional[List] = None,
+                 inputs: Optional[List[Tensor]] = None,
+                 retain_graph: bool = False,
+                 accumulate_leaf: bool = True) -> Dict[int, object]:
+    """Core engine.  Returns {id(tensor): raw grad} for every tensor touched.
+
+    `seeds[i]` is the cotangent for `roots[i]` (defaults to ones, matching
+    the reference's scalar-loss seeding in BasicEngine::Init).
+    """
+    seeds = seeds or [None] * len(roots)
+    grads: Dict[int, object] = {}
+    keep: Dict[int, Tensor] = {}
+
+    for t, s in zip(roots, seeds):
+        if s is None:
+            if t.size != 1:
+                raise RuntimeError(
+                    f"grad can be implicitly created only for scalar outputs; "
+                    f"got shape {t.shape} (pass grad_tensor)")
+            s = jnp.ones_like(t._value)
+        g = grads.get(id(t))
+        grads[id(t)] = s if g is None else g + s
+        keep[id(t)] = t
+
+    nodes = _reachable_nodes(roots)
+
+    # consumer edge counts within the reachable subgraph (PrepareDeps parity)
+    pending: Dict[int, int] = {nid: 0 for nid in nodes}
+    for node in nodes.values():
+        for t in node.in_tensors:
+            if t.grad_node is not None and id(t.grad_node) in nodes:
+                pending[id(t.grad_node)] += 1
+
+    # a root's node starts ready only once all its reachable consumers ran
+    ready = deque(n for nid, n in nodes.items() if pending[nid] == 0)
+    executed = 0
+    while ready:
+        node = ready.popleft()
+        executed += 1
+        # cotangents for this node's float outputs
+        cots = []
+        for i in node.float_out_idx:
+            t = node.out_tensors[i]
+            g = grads.get(id(t))
+            cots.append(jnp.zeros_like(t._value) if g is None else
+                        jnp.asarray(g, dtype=t._value.dtype))
+
+        primals = [t._value for t in node.in_tensors]
+
+        def fwd_float(*vals, _node=node):
+            outs = _node.fwd(*vals)
+            return tuple(outs[i] for i in _node.float_out_idx)
+
+        _, vjp_fn = jax.vjp(fwd_float, *primals)
+        in_grads = vjp_fn(tuple(cots))
+
+        for t, g in zip(node.in_tensors, in_grads):
+            if t.stop_gradient and t.grad_node is None:
+                pass  # constant input: discard
+            else:
+                prev = grads.get(id(t))
+                grads[id(t)] = g if prev is None else prev + g
+                keep[id(t)] = t
+            if t.grad_node is not None and id(t.grad_node) in nodes:
+                pending[id(t.grad_node)] -= 1
+                if pending[id(t.grad_node)] == 0:
+                    ready.append(t.grad_node)
+
+        if not retain_graph:
+            node.release()
+
+    if executed != len(nodes):
+        # disconnected remainder (e.g. some root unreachable); still correct
+        pass
+
+    if accumulate_leaf:
+        for tid, t in keep.items():
+            if t.grad_node is None and not t.stop_gradient:
+                g = grads.get(tid)
+                if g is None:
+                    continue
+                if t.grad is None:
+                    t.grad = Tensor(g, name=t.name + "@GRAD", stop_gradient=True)
+                else:
+                    t.grad._set_raw(t.grad._value + g)
+
+    if not retain_graph:
+        for t in roots:
+            t.grad_node = None
+    return grads
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None):
+    """`paddle.grad` (reference partial_grad_engine.cc / dygraph base.grad).
+
+    create_graph (double grad) is not supported yet — documented gap.
+    """
+    if create_graph:
+        raise NotImplementedError("create_graph=True (double grad) not yet supported")
+    outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if grad_outputs is not None:
+        grad_outputs = grad_outputs if isinstance(grad_outputs, (list, tuple)) else [grad_outputs]
+        seeds = [None if g is None else g._value for g in grad_outputs]
+    else:
+        seeds = None
+    retain = True if retain_graph is None else retain_graph
+    grads = run_backward(list(outputs), seeds, retain_graph=retain,
+                         accumulate_leaf=False)
+    result = []
+    for t in inputs:
+        g = grads.get(id(t))
+        if g is None:
+            if not allow_unused:
+                raise RuntimeError(
+                    f"input {t.name} is unreachable from outputs "
+                    "(set allow_unused=True to get None)")
+            result.append(None)
+        else:
+            result.append(Tensor(g, stop_gradient=True))
+    return result

@@ -1,0 +1,128 @@
+"""Dygraph mode state: tracer switch, RNG, guard/no_grad contexts.
+
+Role parity: reference paddle/fluid/imperative/tracer.{h,cc} (the global
+tracer + `has_grad` switch) and python/paddle/fluid/dygraph/base.py
+(`guard`, `no_grad`, `to_variable`).  TPU-native: eager execution IS jax
+eager execution on the default backend; "tracing" here only records a
+VJP-replay tape (see tape.py) — kernels are the same lowering rules the
+static XLA path uses, so eager/static parity is by construction.
+"""
+from __future__ import annotations
+
+import contextlib
+import functools
+
+import jax
+import numpy as np
+
+
+class _DygraphState:
+    def __init__(self):
+        self.mode_on = True  # reference defaults to dygraph in 2.0 API
+        self.grad_enabled = True
+        self.rng_key = jax.random.PRNGKey(0)
+
+
+_state = _DygraphState()
+
+
+def in_dygraph_mode() -> bool:
+    return _state.mode_on
+
+
+def enabled() -> bool:
+    return _state.mode_on
+
+
+def _switch_mode(on: bool):
+    _state.mode_on = on
+
+
+@contextlib.contextmanager
+def guard(place=None):
+    """Enter dygraph mode (reference dygraph/base.py `guard`)."""
+    prev = _state.mode_on
+    _state.mode_on = True
+    try:
+        yield
+    finally:
+        _state.mode_on = prev
+
+
+def grad_enabled() -> bool:
+    return _state.grad_enabled
+
+
+class no_grad:
+    """Context manager AND decorator disabling tape recording
+    (reference dygraph/base.py `no_grad`).  Both ``@no_grad`` and
+    ``@no_grad()`` work, as in the reference."""
+
+    def __new__(cls, func=None):
+        self = super().__new__(cls)
+        if func is not None and callable(func):
+            @functools.wraps(func)
+            def wrapper(*args, **kwargs):
+                with cls():
+                    return func(*args, **kwargs)
+
+            return wrapper
+        return self
+
+    def __call__(self, fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with no_grad():
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+    def __enter__(self):
+        self._prev = _state.grad_enabled
+        _state.grad_enabled = False
+        return self
+
+    def __exit__(self, *exc):
+        _state.grad_enabled = self._prev
+        return False
+
+
+@contextlib.contextmanager
+def enable_grad():
+    prev = _state.grad_enabled
+    _state.grad_enabled = True
+    try:
+        yield
+    finally:
+        _state.grad_enabled = prev
+
+
+def seed(value: int):
+    """Seed BOTH execution modes (reference paddle.seed): the eager RNG key
+    and the default programs' random_seed for static-graph runs."""
+    _state.rng_key = jax.random.PRNGKey(int(value))
+    from ..framework import program as prog_mod
+
+    prog_mod.default_main_program().random_seed = int(value)
+    prog_mod.default_startup_program().random_seed = int(value)
+
+
+def next_eager_key():
+    _state.rng_key, k = jax.random.split(_state.rng_key)
+    return k
+
+
+def to_variable(value, name=None, zero_copy=None, dtype=None):
+    """numpy / scalar / Tensor -> eager Tensor (reference dygraph
+    base.to_variable)."""
+    from .tensor import Tensor
+
+    if isinstance(value, Tensor):
+        return value
+    arr = np.asarray(value)
+    if dtype is not None:
+        arr = arr.astype(dtype)
+    elif arr.dtype == np.float64:
+        arr = arr.astype(np.float32)
+    # note: int64 collapses to int32 under jax's default x64-disabled mode
+    return Tensor(jax.numpy.asarray(arr), name=name, stop_gradient=True)

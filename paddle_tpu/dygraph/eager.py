@@ -1,0 +1,278 @@
+"""Eager op dispatch: run a registered lowering rule immediately.
+
+Role parity: reference imperative/tracer.cc `Tracer::TraceOp` +
+prepared_operator.cc `PreparedOp::Run` + the generated `core.ops.*` fast
+path (pybind/op_function_generator.cc:227).  TPU-native: there is no
+kernel choice — the op's lowering rule (the SAME rule the static XLA
+executor traces) runs eagerly on jax arrays, and if gradients are enabled
+a VJP-replay TapeNode is recorded (see backward.py, the BasicEngine
+equivalent).  One op implementation serves both execution modes, which is
+how eager/static parity holds by construction.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.lowering import LoweringContext, get_lowering
+from . import base
+from .tensor import Tensor
+
+# default output slot names per op family; ops not listed produce "Out".
+_OUT_SLOTS: Dict[str, Sequence[str]] = {
+    "batch_norm": ("Y", "MeanOut", "VarianceOut", "SavedMean", "SavedVariance"),
+    "sync_batch_norm": ("Y", "MeanOut", "VarianceOut", "SavedMean", "SavedVariance"),
+    "layer_norm": ("Y", "Mean", "Variance"),
+    "group_norm": ("Y", "Mean", "Variance"),
+    "instance_norm": ("Y", "SavedMean", "SavedVariance"),
+    "softmax_with_cross_entropy": ("Loss", "Softmax"),
+    "top_k": ("Out", "Indices"),
+    "top_k_v2": ("Out", "Indices"),
+    "argsort": ("Out", "Indices"),
+    "dropout": ("Out", "Mask"),
+    "reshape2": ("Out", "XShape"),
+    "transpose2": ("Out", "XShape"),
+    "squeeze2": ("Out", "XShape"),
+    "unsqueeze2": ("Out", "XShape"),
+    "flatten2": ("Out", "XShape"),
+    "unstack": ("Y",),
+    "split": ("Out",),
+    "check_finite_and_unscale": ("Out", "FoundInfinite"),
+    "update_loss_scaling": ("Out", "LossScaling", "OutGoodSteps", "OutBadSteps"),
+    "accuracy": ("Accuracy", "Correct", "Total"),
+    "relu": ("Out",),
+}
+
+# ops whose listed output slot is a LIST with the same length as input list
+_LIST_OUT_OPS = {"split": "Out", "unstack": "Y", "meshgrid": "Out",
+                 "check_finite_and_unscale": "Out"}
+
+
+class _EagerOp:
+    """Duck-typed Operator (framework/program.py:174) for eager dispatch."""
+
+    __slots__ = ("type", "inputs", "outputs", "attrs")
+
+    def __init__(self, type, inputs, outputs, attrs):
+        self.type = type
+        self.inputs = inputs
+        self.outputs = outputs
+        self.attrs = dict(attrs or {})
+
+    def input(self, slot):
+        return list(self.inputs.get(slot, []))
+
+    def output(self, slot):
+        return list(self.outputs.get(slot, []))
+
+    def attr(self, name, default=None):
+        return self.attrs.get(name, default)
+
+    def has_attr(self, name):
+        return name in self.attrs
+
+
+class _EagerBlock:
+    """Minimal Block stand-in so LoweringContext works outside a Program."""
+
+    program = None
+
+    def _find_var_recursive(self, name):
+        return None
+
+
+_EAGER_BLOCK = _EagerBlock()
+
+
+def _is_float(v):
+    return jnp.issubdtype(jnp.asarray(v).dtype, jnp.inexact)
+
+
+class TapeNode:
+    """One recorded op application; replayed through jax.vjp on backward.
+
+    Role parity: reference imperative `OpBase` grad node + the per-op grad
+    kernel; here backward = vjp of the re-run forward (XLA CSEs the
+    recomputation when the surrounding step is jitted).
+    """
+
+    __slots__ = ("op_type", "fwd", "in_tensors", "out_tensors", "float_out_idx")
+
+    def __init__(self, op_type, fwd, in_tensors, out_tensors, float_out_idx):
+        self.op_type = op_type
+        self.fwd = fwd  # fn(*diff_vals) -> tuple of ALL output values
+        self.in_tensors = in_tensors  # differentiable input Tensors
+        self.out_tensors = out_tensors  # produced Tensors (flat)
+        self.float_out_idx = float_out_idx
+
+    def release(self):
+        self.fwd = None
+        self.in_tensors = ()
+        self.out_tensors = ()
+
+
+def _record(op_type, fwd, diff_tensors, out_tensors):
+    float_out_idx = [i for i, t in enumerate(out_tensors) if _is_float(t._value)]
+    node = TapeNode(op_type, fwd, tuple(diff_tensors), tuple(out_tensors), float_out_idx)
+    for i in float_out_idx:
+        out_tensors[i].grad_node = node
+        out_tensors[i].stop_gradient = False
+    return node
+
+
+def apply_jax(fn, *tensors, n_out: int = 1):
+    """Run an arbitrary jax-traceable fn on Tensors with tape recording.
+
+    The eager escape hatch for operations with no IR op (indexing, casts).
+    """
+    record = base.grad_enabled() and any(
+        (not t.stop_gradient) and _is_float(t._value) for t in tensors
+    )
+    diff = [t for t in tensors if _is_float(t._value) and (not t.stop_gradient or record)]
+    # partition: differentiable args are floats; others are captured consts
+    diff_ids = {id(t) for t in diff}
+    const_vals = {id(t): t._value for t in tensors if id(t) not in diff_ids}
+
+    def fwd(*vals):
+        it = iter(vals)
+        args = [next(it) if id(t) in diff_ids else const_vals[id(t)] for t in tensors]
+        out = fn(*args)
+        return out if isinstance(out, tuple) else (out,)
+
+    outs = fwd(*[t._value for t in diff])
+    out_tensors = [Tensor(o, stop_gradient=True) for o in outs]
+    if record and diff:
+        _record(fn.__name__ if hasattr(fn, "__name__") else "apply_jax",
+                fwd, diff, out_tensors)
+    return out_tensors[0] if n_out == 1 and len(out_tensors) == 1 else out_tensors
+
+
+def run_op(op_type: str, inputs: Dict[str, object], attrs: Optional[dict] = None,
+           out_slots: Optional[Sequence[str]] = None,
+           out_counts: Optional[Dict[str, int]] = None) -> Dict[str, object]:
+    """Execute one IR op eagerly.  Returns {slot: Tensor | [Tensor]}.
+
+    `inputs` values may be Tensor, list[Tensor], or None (optional slot).
+    """
+    from ..framework import unique_name
+
+    rule = get_lowering(op_type)
+    attrs = dict(attrs or {})
+    if out_slots is None:
+        out_slots = _OUT_SLOTS.get(op_type, ("Out",))
+
+    in_names: Dict[str, List[str]] = {}
+    const_env: Dict[str, object] = {}
+    diff_tensors: List[Tensor] = []
+    diff_names: List[str] = []
+
+    record = base.grad_enabled()
+    any_diff_input = False
+
+    def add_input(slot, t, i):
+        nonlocal any_diff_input
+        name = f"__ein_{slot}_{i}_{id(t)}"
+        in_names.setdefault(slot, []).append(name)
+        if _is_float(t._value):
+            if not t.stop_gradient:
+                any_diff_input = True
+            diff_tensors.append(t)
+            diff_names.append(name)
+        else:
+            const_env[name] = t._value
+        return name
+
+    tensor_inputs: Dict[str, List[Tensor]] = {}
+    for slot, v in inputs.items():
+        if v is None:
+            continue
+        ts = v if isinstance(v, (list, tuple)) else [v]
+        ts = [t if isinstance(t, Tensor) else Tensor(jnp.asarray(t)) for t in ts]
+        tensor_inputs[slot] = ts
+        for i, t in enumerate(ts):
+            add_input(slot, t, i)
+
+    # output slot sizing
+    out_names: Dict[str, List[str]] = {}
+    flat_out_names: List[str] = []
+    for slot in out_slots:
+        n = (out_counts or {}).get(slot, 1)
+        names = [f"__eout_{slot}_{i}_{unique_name.generate('e')}" for i in range(n)]
+        out_names[slot] = names
+        flat_out_names.extend(names)
+
+    op = _EagerOp(op_type, in_names, out_names, attrs)
+    rng_key = base.next_eager_key()
+
+    def fwd(*vals):
+        env = dict(const_env)
+        env.update(zip(diff_names, vals))
+        ctx = LoweringContext(_EAGER_BLOCK, env, rng_key=rng_key)
+        rule(ctx, op)
+        return tuple(env.get(n) for n in flat_out_names)
+
+    out_vals = fwd(*[t._value for t in diff_tensors])
+
+    produced_idx = [i for i, v in enumerate(out_vals) if v is not None]
+    out_tensors_flat: List[Optional[Tensor]] = [
+        Tensor(out_vals[i], stop_gradient=True) if i in set(produced_idx) else None
+        for i in range(len(out_vals))
+    ]
+
+    if record and any_diff_input and diff_tensors:
+        produced = [t for t in out_tensors_flat if t is not None]
+        if any(_is_float(t._value) for t in produced):
+            # backward closure must return positionally-stable outputs
+            def fwd_stable(*vals):
+                vs = fwd(*vals)
+                return tuple(vs[i] for i in produced_idx)
+
+            _record(op_type, fwd_stable, diff_tensors, produced)
+
+    # reassemble {slot: Tensor | [Tensor]}
+    result: Dict[str, object] = {}
+    k = 0
+    for slot in out_slots:
+        n = len(out_names[slot])
+        ts = out_tensors_flat[k:k + n]
+        k += n
+        if op_type in _LIST_OUT_OPS and _LIST_OUT_OPS[op_type] == slot:
+            result[slot] = [t for t in ts if t is not None]
+        else:
+            result[slot] = ts[0] if n == 1 else ts
+    return result
+
+
+class Tracer:
+    """API-parity shim over the global dygraph state (reference
+    imperative::Tracer)."""
+
+    @property
+    def _has_grad(self):
+        return base.grad_enabled()
+
+    def trace_op(self, type, inputs, outputs, attrs=None):
+        res = run_op(type, inputs, attrs,
+                     out_slots=tuple(outputs.keys()) if outputs else None)
+        for slot, t in res.items():
+            if slot in outputs and isinstance(outputs[slot], Tensor) and t is not None:
+                caller = outputs[slot]
+                caller._set_raw(t._value)
+                caller.grad_node = t.grad_node
+                caller.stop_gradient = t.stop_gradient
+                if t.grad_node is not None:
+                    # the tape must reference the tensor the caller keeps,
+                    # or backward() seeds a cotangent nobody looks up
+                    node = t.grad_node
+                    node.out_tensors = tuple(
+                        caller if o is t else o for o in node.out_tensors)
+        return res
+
+
+_tracer = Tracer()
+
+
+def tracer() -> Tracer:
+    return _tracer

@@ -1,0 +1,270 @@
+"""Eager Tensor: a jax array with autograd metadata.
+
+Role parity: reference paddle/fluid/imperative/layer.h `VarBase` /
+variable_wrapper.h (value + grad slot + stop_gradient) and the
+python-side monkey-patched methods (fluid/dygraph/varbase_patch_methods.py).
+TPU-native: the payload is a `jax.Array` living on the default backend
+(TPU chip when present); ops on it are the same lowering rules as the
+static path, applied eagerly.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import unique_name
+from . import base
+
+
+class Tensor:
+    def __init__(self, value, name: Optional[str] = None, stop_gradient: bool = True,
+                 persistable: bool = False):
+        self._value = value if isinstance(value, jax.Array) else jnp.asarray(value)
+        self.name = name or unique_name.generate("eager_tmp")
+        self.stop_gradient = stop_gradient
+        self.persistable = persistable
+        self.grad: Optional[Tensor] = None
+        self.grad_node = None  # TapeNode that produced this tensor (None = leaf)
+        self.trainable = True
+
+    # -- basic introspection ------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._value.shape)
+
+    @property
+    def dtype(self):
+        return self._value.dtype
+
+    @property
+    def ndim(self):
+        return self._value.ndim
+
+    @property
+    def size(self):
+        return int(np.prod(self._value.shape)) if self._value.shape else 1
+
+    @property
+    def is_leaf(self):
+        return self.grad_node is None
+
+    def numpy(self):
+        return np.asarray(self._value)
+
+    def item(self, *args):
+        return self.numpy().item(*args)
+
+    def __len__(self):
+        return int(self._value.shape[0])
+
+    def __repr__(self):
+        g = ", stop_gradient=False" if not self.stop_gradient else ""
+        return f"Tensor(shape={self.shape}, dtype={self.dtype}{g},\n{self._value})"
+
+    def __bool__(self):
+        return bool(self._value)
+
+    def __float__(self):
+        return float(self._value)
+
+    def __int__(self):
+        return int(self._value)
+
+    def __hash__(self):
+        return id(self)
+
+    # -- autograd -----------------------------------------------------------
+    def backward(self, grad_tensor=None, retain_graph=False):
+        from .backward import run_backward
+
+        seed = None if grad_tensor is None else grad_tensor._value
+        run_backward([self], [seed], retain_graph=retain_graph)
+
+    def clear_grad(self):
+        self.grad = None
+
+    clear_gradient = clear_grad
+
+    def detach(self):
+        t = Tensor(self._value, stop_gradient=True)
+        t.name = self.name
+        return t
+
+    def gradient(self):
+        return None if self.grad is None else self.grad.numpy()
+
+    # in-place value swap (optimizer updates, state dict loading)
+    def set_value(self, value):
+        if isinstance(value, Tensor):
+            value = value._value
+        self._value = jnp.asarray(value).astype(self._value.dtype)
+        return self
+
+    def _set_raw(self, value):
+        self._value = value
+        return self
+
+    def block_until_ready(self):
+        try:
+            self._value.block_until_ready()
+        except AttributeError:
+            pass
+        return self
+
+    # -- jax interop --------------------------------------------------------
+    def __jax_array__(self):
+        return self._value
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._value)
+        return a.astype(dtype) if dtype is not None else a
+
+    # -- op helpers (routed through the eager dispatcher) --------------------
+    def _ew(self, other, op_type, reverse=False):
+        from .eager import run_op
+
+        if not isinstance(other, Tensor):
+            other = Tensor(jnp.asarray(other, dtype=self.dtype), stop_gradient=True)
+        x, y = (other, self) if reverse else (self, other)
+        return run_op(op_type, {"X": x, "Y": y}, {"axis": -1})["Out"]
+
+    def __add__(self, o):
+        return self._ew(o, "elementwise_add")
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._ew(o, "elementwise_sub")
+
+    def __rsub__(self, o):
+        return self._ew(o, "elementwise_sub", reverse=True)
+
+    def __mul__(self, o):
+        return self._ew(o, "elementwise_mul")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._ew(o, "elementwise_div")
+
+    def __rtruediv__(self, o):
+        return self._ew(o, "elementwise_div", reverse=True)
+
+    def __pow__(self, o):
+        return self._ew(o, "elementwise_pow")
+
+    def __mod__(self, o):
+        return self._ew(o, "elementwise_mod")
+
+    def __floordiv__(self, o):
+        return self._ew(o, "elementwise_floordiv")
+
+    def __matmul__(self, o):
+        from .eager import run_op
+
+        return run_op("matmul_v2", {"X": self, "Y": o}, {})["Out"]
+
+    def __neg__(self):
+        from .eager import run_op
+
+        return run_op("scale", {"X": self}, {"scale": -1.0, "bias": 0.0})["Out"]
+
+    def __eq__(self, o):  # noqa: E721 - tensor semantics, like the reference
+        return self._ew(o, "equal")
+
+    def __ne__(self, o):
+        return self._ew(o, "not_equal")
+
+    def __lt__(self, o):
+        return self._ew(o, "less_than")
+
+    def __le__(self, o):
+        return self._ew(o, "less_equal")
+
+    def __gt__(self, o):
+        return self._ew(o, "greater_than")
+
+    def __ge__(self, o):
+        return self._ew(o, "greater_equal")
+
+    def __getitem__(self, idx):
+        from .eager import apply_jax
+
+        return apply_jax(lambda v: v[idx], self)
+
+    # -- common methods -----------------------------------------------------
+    def astype(self, dtype):
+        from .eager import apply_jax
+        from ..framework import dtypes
+
+        jd = dtypes.to_jnp(dtype)
+        return apply_jax(lambda v: v.astype(jd), self)
+
+    cast = astype
+
+    def reshape(self, shape):
+        from .eager import run_op
+
+        if len(shape) == 1 and isinstance(shape[0], (list, tuple)):
+            shape = shape[0]
+        return run_op("reshape2", {"X": self}, {"shape": list(shape)},
+                      out_slots=("Out",))["Out"]
+
+    def transpose(self, perm):
+        from .eager import run_op
+
+        return run_op("transpose2", {"X": self}, {"axis": list(perm)},
+                      out_slots=("Out",))["Out"]
+
+    def sum(self, axis=None, keepdim=False):
+        from .eager import run_op
+
+        attrs = {"dim": [] if axis is None else ([axis] if isinstance(axis, int) else list(axis)),
+                 "keep_dim": keepdim, "reduce_all": axis is None}
+        return run_op("reduce_sum", {"X": self}, attrs)["Out"]
+
+    def mean(self, axis=None, keepdim=False):
+        from .eager import run_op
+
+        if axis is None and not keepdim:
+            return run_op("mean", {"X": self}, {})["Out"]
+        attrs = {"dim": [] if axis is None else ([axis] if isinstance(axis, int) else list(axis)),
+                 "keep_dim": keepdim, "reduce_all": axis is None}
+        return run_op("reduce_mean", {"X": self}, attrs)["Out"]
+
+    def max(self, axis=None, keepdim=False):
+        from .eager import run_op
+
+        attrs = {"dim": [] if axis is None else ([axis] if isinstance(axis, int) else list(axis)),
+                 "keep_dim": keepdim, "reduce_all": axis is None}
+        return run_op("reduce_max", {"X": self}, attrs)["Out"]
+
+    def min(self, axis=None, keepdim=False):
+        from .eager import run_op
+
+        attrs = {"dim": [] if axis is None else ([axis] if isinstance(axis, int) else list(axis)),
+                 "keep_dim": keepdim, "reduce_all": axis is None}
+        return run_op("reduce_min", {"X": self}, attrs)["Out"]
+
+    def clone(self):
+        from .eager import apply_jax
+
+        return apply_jax(lambda v: v + 0, self)
+
+
+class Parameter(Tensor):
+    """Trainable eager tensor (reference framework.ParamBase)."""
+
+    def __init__(self, value, name=None, trainable=True):
+        super().__init__(value, name=name or unique_name.generate("param"),
+                         stop_gradient=not trainable, persistable=True)
+        self.trainable = trainable
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.need_clip = True
+
+    def __repr__(self):
+        return f"Parameter(name={self.name}, shape={self.shape}, dtype={self.dtype},\n{self._value})"

@@ -16,6 +16,12 @@ class Initializer:
     def __call__(self, var, block):
         raise NotImplementedError
 
+    def eager_value(self, shape, dtype, key):
+        """Produce the initial value directly (dygraph parameter creation) —
+        the startup-program path collapsed to one jax call."""
+        raise NotImplementedError(
+            f"{type(self).__name__} has no eager-mode value rule")
+
 
 class ConstantInitializer(Initializer):
     def __init__(self, value: float = 0.0):
@@ -28,6 +34,12 @@ class ConstantInitializer(Initializer):
             {"Out": var.name},
             {"shape": list(var.shape), "dtype": var.dtype, "value": float(self.value)},
         )
+
+
+    def eager_value(self, shape, dtype, key):
+        import jax.numpy as jnp
+
+        return jnp.full(tuple(shape), self.value, dtype=dtypes.to_jnp(dtype))
 
 
 class UniformInitializer(Initializer):
@@ -49,6 +61,13 @@ class UniformInitializer(Initializer):
         )
 
 
+    def eager_value(self, shape, dtype, key):
+        import jax
+
+        return jax.random.uniform(key, tuple(shape), dtypes.to_jnp(dtype),
+                                  float(self.low), float(self.high))
+
+
 class NormalInitializer(Initializer):
     def __init__(self, loc=0.0, scale=1.0, seed=0):
         self.loc, self.scale, self.seed = loc, scale, seed
@@ -66,6 +85,13 @@ class NormalInitializer(Initializer):
                 "seed": self.seed,
             },
         )
+
+
+    def eager_value(self, shape, dtype, key):
+        import jax
+
+        return self.loc + self.scale * jax.random.normal(
+            key, tuple(shape), dtypes.to_jnp(dtype))
 
 
 class TruncatedNormalInitializer(Initializer):
@@ -87,8 +113,16 @@ class TruncatedNormalInitializer(Initializer):
         )
 
 
-def _fans(var):
-    shape = var.shape
+    def eager_value(self, shape, dtype, key):
+        import jax
+
+        return self.loc + self.scale * jax.random.truncated_normal(
+            key, -2.0, 2.0, tuple(shape), dtypes.to_jnp(dtype))
+
+
+def _shape_fans(shape):
+    if len(shape) == 0:
+        return 1, 1
     if len(shape) == 1:
         return shape[0], shape[0]
     if len(shape) == 2:
@@ -97,6 +131,10 @@ def _fans(var):
     for s in shape[2:]:
         rs *= s
     return shape[1] * rs, shape[0] * rs
+
+
+def _fans(var):
+    return _shape_fans(var.shape)
 
 
 class XavierInitializer(Initializer):
@@ -115,6 +153,17 @@ class XavierInitializer(Initializer):
             NormalInitializer(0.0, std, self.seed)(var, block)
 
 
+    def eager_value(self, shape, dtype, key):
+        fi, fo = _shape_fans(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        if self.uniform:
+            limit = math.sqrt(6.0 / (fi + fo))
+            return UniformInitializer(-limit, limit, self.seed).eager_value(shape, dtype, key)
+        std = math.sqrt(2.0 / (fi + fo))
+        return NormalInitializer(0.0, std, self.seed).eager_value(shape, dtype, key)
+
+
 class MSRAInitializer(Initializer):
     def __init__(self, uniform=True, fan_in=None, seed=0):
         self.uniform, self.fan_in, self.seed = uniform, fan_in, seed
@@ -128,6 +177,16 @@ class MSRAInitializer(Initializer):
         else:
             std = math.sqrt(2.0 / fi)
             NormalInitializer(0.0, std, self.seed)(var, block)
+
+
+    def eager_value(self, shape, dtype, key):
+        fi, _ = _shape_fans(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        if self.uniform:
+            limit = math.sqrt(6.0 / fi)
+            return UniformInitializer(-limit, limit, self.seed).eager_value(shape, dtype, key)
+        std = math.sqrt(2.0 / fi)
+        return NormalInitializer(0.0, std, self.seed).eager_value(shape, dtype, key)
 
 
 class NumpyArrayInitializer(Initializer):
@@ -149,6 +208,12 @@ class NumpyArrayInitializer(Initializer):
             {"Out": var.name},
             {"shape": list(self.value.shape), "dtype": var.dtype, key: vals},
         )
+
+
+    def eager_value(self, shape, dtype, key):
+        import jax.numpy as jnp
+
+        return jnp.asarray(self.value, dtype=dtypes.to_jnp(dtype)).reshape(tuple(shape))
 
 
 # reference-compatible aliases

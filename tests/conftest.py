@@ -12,6 +12,14 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 
+# the container's sitecustomize imports jax (registering the axon TPU
+# backend) before this file runs, so env vars alone are too late — force
+# the platform through the live config as well.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert jax.devices()[0].platform == "cpu", jax.devices()
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
