@@ -10,8 +10,35 @@ from . import ops  # noqa: F401
 from . import initializer, layers, optimizer, regularizer  # noqa: F401
 from . import dygraph  # noqa: F401
 from .dygraph import grad, no_grad, to_variable  # noqa: F401
-from .dygraph.base import in_dygraph_mode, seed  # noqa: F401
+from .dygraph.base import (  # noqa: F401
+    disable_static,
+    enable_static,
+    in_dygraph_mode,
+    seed,
+)
 from .dygraph.tensor import Tensor  # noqa: F401
+
+# 2.0 flat namespace (reference python/paddle/__init__.py ~210 imports)
+from . import tensor  # noqa: F401
+from . import nn  # noqa: F401
+from .tensor import (  # noqa: F401
+    abs, add, add_n, all, allclose, any, arange, argmax, argmin, argsort,
+    assign, bmm, broadcast_to, cast, ceil, chunk, clip, concat, cos, cumsum,
+    diag, divide, dot, equal, equal_all, exp, expand, expand_as, eye, flatten,
+    flip, floor, floor_divide, full, full_like, gather, gather_nd,
+    greater_equal, greater_than, increment, index_select, isfinite, isinf,
+    isnan, less_equal, less_than, linspace, log, log1p, log2, log10,
+    logical_and, logical_not, logical_or, logical_xor, logsumexp, masked_select,
+    matmul, max, maximum, mean, meshgrid, min, minimum, mm, mod, multinomial,
+    multiply, nonzero, norm, normal, not_equal, numel, ones, ones_like, pow,
+    prod, rand, randint, randn, randperm, reciprocal, remainder, reshape,
+    roll, round, rsqrt, scale, scatter, scatter_nd_add, sign, sin, slice,
+    sort, split, sqrt, square, squeeze, stack, std, subtract, sum, t,
+    tanh, tile, to_tensor, topk, trace, transpose, tril, triu, uniform,
+    unsqueeze, unstack, var, where, zeros, zeros_like,
+)
+from .tensor.math import kron, neg, stanh  # noqa: F401
+from .tensor.search import index_sample  # noqa: F401
 from . import fluid  # noqa: F401
 from .framework.backward import append_backward, calc_gradient  # noqa: F401
 from .param_attr import ParamAttr  # noqa: F401

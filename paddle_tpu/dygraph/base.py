@@ -38,6 +38,17 @@ def _switch_mode(on: bool):
     _state.mode_on = on
 
 
+def enable_static():
+    """Switch the 2.0 API into static-graph mode (reference
+    paddle.enable_static)."""
+    _state.mode_on = False
+
+
+def disable_static():
+    """Back to dygraph (reference paddle.disable_static)."""
+    _state.mode_on = True
+
+
 @contextlib.contextmanager
 def guard(place=None):
     """Enter dygraph mode (reference dygraph/base.py `guard`)."""

@@ -16,6 +16,8 @@ from typing import Dict, List, Optional, Sequence
 import jax
 import jax.numpy as jnp
 
+from builtins import all as builtins_all
+
 from ..framework.lowering import LoweringContext, get_lowering
 from . import base
 from .tensor import Tensor
@@ -127,6 +129,12 @@ def apply_jax(fn, *tensors, n_out: int = 1):
 
     The eager escape hatch for operations with no IR op (indexing, casts).
     """
+    from ..framework.program import Variable
+
+    if any(isinstance(t, Variable) for t in tensors):
+        raise NotImplementedError(
+            "this operation has no static-graph lowering yet; it only works "
+            "in dygraph mode (got a graph Variable)")
     record = base.grad_enabled() and any(
         (not t.stop_gradient) and _is_float(t._value) for t in tensors
     )
@@ -214,6 +222,10 @@ def run_op(op_type: str, inputs: Dict[str, object], attrs: Optional[dict] = None
         return tuple(env.get(n) for n in flat_out_names)
 
     out_vals = fwd(*[t._value for t in diff_tensors])
+    if out_vals and builtins_all(v is None for v in out_vals):
+        raise RuntimeError(
+            f"op {op_type!r} produced none of the requested output slots "
+            f"{list(out_slots)}; the lowering writes different slot names")
 
     produced_idx = [i for i, v in enumerate(out_vals) if v is not None]
     out_tensors_flat: List[Optional[Tensor]] = [

@@ -517,15 +517,28 @@ def switch_startup_program(program: Program) -> Program:
     return old
 
 
+_guard_depth = 0
+
+
+def in_program_guard() -> bool:
+    """True while user code is inside a program_guard block — used by the
+    2.0 dual-mode dispatch to route input-less ops (creation/random) into
+    the graph instead of executing them eagerly."""
+    return _guard_depth > 0
+
+
 @contextlib.contextmanager
 def program_guard(main_program: Program, startup_program: Optional[Program] = None):
+    global _guard_depth
     old_main = switch_main_program(main_program)
     old_startup = None
     if startup_program is not None:
         old_startup = switch_startup_program(startup_program)
+    _guard_depth += 1
     try:
         yield
     finally:
+        _guard_depth -= 1
         switch_main_program(old_main)
         if old_startup is not None:
             switch_startup_program(old_startup)

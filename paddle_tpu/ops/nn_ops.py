@@ -83,7 +83,7 @@ def _conv2d_transpose(ctx, op):
     dilations = [int(d) for d in op.attr("dilations", [1, 1])]
     groups = int(op.attr("groups", 1) or 1)
     ksize = w.shape[2:]
-    pads = _conv_paddings(
+    fwd_pads = _conv_paddings(
         op.attr("paddings", [0, 0]),
         op.attr("padding_algorithm", "EXPLICIT"),
         ksize,
@@ -91,6 +91,13 @@ def _conv2d_transpose(ctx, op):
         dilations,
         x.shape[2:],
     )
+    # conv_transpose's `padding` refers to the DILATED input: the reference
+    # (and torch) "padding=p" maps to (k-1)*dilation - p on each side
+    pads = [
+        ((k - 1) * d - lo, (k - 1) * d - hi)
+        for k, d, (lo, hi) in zip(ksize, dilations, fwd_pads)
+    ]
+
     def one_group(xg, wg):
         return jax.lax.conv_transpose(
             xg,
@@ -98,7 +105,7 @@ def _conv2d_transpose(ctx, op):
             strides=strides,
             padding=pads,
             rhs_dilation=dilations,
-            dimension_numbers=("NCHW", "IOHW", "NCHW"),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
             transpose_kernel=True,
         )
 

@@ -10,15 +10,15 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional
 
-from .framework import unique_name
-from .framework.backward import append_backward
-from .framework.program import (
+from ..framework import unique_name
+from ..framework.backward import append_backward
+from ..framework.program import (
     Program,
     Variable,
     default_main_program,
     default_startup_program,
 )
-from .initializer import ConstantInitializer
+from ..initializer import ConstantInitializer
 
 
 class Optimizer:
@@ -44,7 +44,7 @@ class Optimizer:
     def _create_global_learning_rate(self, program=None):
         if self._lr_var is not None:
             return self._lr_var
-        from .optimizer_lr import LRScheduler
+        from ..optimizer_lr import LRScheduler
 
         program = program or default_main_program()
         lr_value = self._learning_rate
@@ -70,7 +70,7 @@ class Optimizer:
         no recompile — the LR var is part of the compiled step's state)."""
         import numpy as np
 
-        from .framework.scope import global_scope
+        from ..framework.scope import global_scope
 
         scope = scope or global_scope()
         if self._lr_var is not None:
@@ -79,7 +79,7 @@ class Optimizer:
     def get_lr(self) -> float:
         import numpy as np
 
-        from .framework.scope import global_scope
+        from ..framework.scope import global_scope
 
         if self._lr_var is None:
             lr = self._learning_rate
@@ -148,7 +148,7 @@ class Optimizer:
         raise NotImplementedError
 
     def _apply_regularization(self, params_grads):
-        from .regularizer import append_regularization_ops
+        from ..regularizer import append_regularization_ops
 
         return append_regularization_ops(params_grads, self.regularization)
 

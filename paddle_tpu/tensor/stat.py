@@ -1,0 +1,41 @@
+"""Statistics API (reference python/paddle/tensor/stat.py)."""
+from __future__ import annotations
+
+from . import math as _math
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    return _math.mean(x, axis, keepdim, name)
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    m = _math.mean(x, axis, True)
+    sq = _math.mean(_math.square(_math.subtract(x, m)), axis, keepdim)
+    if unbiased:
+        import numpy as np
+
+        if axis is None:
+            n = int(np.prod(x.shape))
+        else:
+            axes = [axis] if isinstance(axis, int) else list(axis)
+            n = int(np.prod([x.shape[a] for a in axes]))
+        if n > 1:
+            sq = _math.scale(sq, n / (n - 1))
+    return sq
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return _math.sqrt(var(x, axis, unbiased, keepdim))
+
+
+def numel(x, name=None):
+    import numpy as np
+
+    return int(np.prod(x.shape))
+
+
+def median(x, axis=None, keepdim=False, name=None):
+    from ..dygraph.eager import apply_jax
+    import jax.numpy as jnp
+
+    return apply_jax(lambda v: jnp.median(v, axis=axis, keepdims=keepdim), x)

@@ -1,0 +1,318 @@
+"""2.0 API tests: paddle.tensor / paddle.nn / paddle.optimizer.
+
+Parity model: reference unittests for the 2.0 namespaces; numpy is the
+oracle, plus eager-vs-static cross-checks (the same op must produce the
+same numbers through both execution modes).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.nn import functional as F
+
+
+def T(x):
+    return paddle.to_tensor(np.asarray(x, dtype="float32"))
+
+
+class TestTensorAPI:
+    def test_creation(self):
+        np.testing.assert_allclose(paddle.zeros([2, 3]).numpy(), np.zeros((2, 3)))
+        np.testing.assert_allclose(paddle.full([2], 7.0).numpy(), [7.0, 7.0])
+        np.testing.assert_allclose(paddle.arange(5).numpy(), np.arange(5))
+        np.testing.assert_allclose(paddle.eye(3).numpy(), np.eye(3))
+        np.testing.assert_allclose(paddle.linspace(0, 1, 5).numpy(),
+                                   np.linspace(0, 1, 5), rtol=1e-6)
+        np.testing.assert_allclose(paddle.tril(T(np.ones((3, 3)))).numpy(),
+                                   np.tril(np.ones((3, 3))))
+
+    def test_math(self, rng):
+        a, b = rng.randn(3, 4).astype("f4"), rng.randn(3, 4).astype("f4")
+        x, y = T(a), T(b)
+        np.testing.assert_allclose(paddle.add(x, y).numpy(), a + b, rtol=1e-6)
+        np.testing.assert_allclose(paddle.multiply(x, y).numpy(), a * b, rtol=1e-6)
+        np.testing.assert_allclose(paddle.exp(x).numpy(), np.exp(a), rtol=1e-5)
+        np.testing.assert_allclose(paddle.maximum(x, y).numpy(), np.maximum(a, b))
+        np.testing.assert_allclose(paddle.clip(x, -0.5, 0.5).numpy(),
+                                   np.clip(a, -0.5, 0.5))
+        np.testing.assert_allclose(paddle.sum(x, axis=1).numpy(), a.sum(1), rtol=1e-5)
+        np.testing.assert_allclose(paddle.cumsum(x, axis=1).numpy(),
+                                   np.cumsum(a, 1), rtol=1e-5)
+        np.testing.assert_allclose(paddle.std(x).numpy(), a.std(ddof=1), rtol=1e-4)
+
+    def test_manipulation(self, rng):
+        a = rng.randn(2, 3, 4).astype("f4")
+        x = T(a)
+        assert paddle.reshape(x, [6, 4]).shape == [6, 4]
+        assert paddle.transpose(x, [2, 0, 1]).shape == [4, 2, 3]
+        assert paddle.flatten(x, 1).shape == [2, 12]
+        assert paddle.unsqueeze(x, 0).shape == [1, 2, 3, 4]
+        assert paddle.concat([x, x], axis=1).shape == [2, 6, 4]
+        parts = paddle.split(x, [1, 3], axis=2)
+        assert [p.shape for p in parts] == [[2, 3, 1], [2, 3, 3]]
+        assert paddle.tile(x, [1, 2, 1]).shape == [2, 6, 4]
+        assert paddle.stack([x, x]).shape == [2, 2, 3, 4]
+        np.testing.assert_allclose(paddle.flip(x, 0).numpy(), a[::-1], rtol=1e-6)
+
+    def test_linalg_and_search(self, rng):
+        a = rng.randn(5, 6).astype("f4")
+        x = T(a)
+        np.testing.assert_allclose(paddle.matmul(x, x, transpose_y=True).numpy(),
+                                   a @ a.T, rtol=1e-4)
+        np.testing.assert_allclose(paddle.norm(x).numpy(),
+                                   np.linalg.norm(a), rtol=1e-5)
+        np.testing.assert_allclose(paddle.argmax(x, -1).numpy(), a.argmax(-1))
+        vals, idx = paddle.topk(x, 2, axis=1)
+        np.testing.assert_allclose(vals.numpy(),
+                                   np.sort(a, 1)[:, ::-1][:, :2], rtol=1e-6)
+        np.testing.assert_allclose(paddle.sort(x, 1).numpy(), np.sort(a, 1), rtol=1e-6)
+
+    def test_tensor_methods_patched(self, rng):
+        a = rng.randn(3, 3).astype("f4")
+        x = T(a)
+        np.testing.assert_allclose(x.matmul(x).numpy(), a @ a, rtol=1e-4)
+        np.testing.assert_allclose(x.flatten().numpy(), a.ravel(), rtol=1e-6)
+        np.testing.assert_allclose(x.exp().numpy(), np.exp(a), rtol=1e-5)
+        assert x.argmax(-1).numpy().shape == (3,)
+
+    def test_static_mode_tensor_ops(self):
+        """Same functions appended to a Program and executed via XLA."""
+        import paddle_tpu as pt
+        from paddle_tpu import layers
+        from paddle_tpu.framework.program import Program, program_guard
+
+        main, startup = Program(), Program()
+        with program_guard(main, startup):
+            x = layers.data("x", [4])
+            y = paddle.add(paddle.exp(x), paddle.scale(x, 2.0))
+            z = paddle.sum(y)
+        exe = pt.Executor(pt.CPUPlace())
+        exe.run(startup)
+        a = np.random.RandomState(3).randn(2, 4).astype("f4")
+        (zv,) = exe.run(main, feed={"x": a}, fetch_list=[z])
+        np.testing.assert_allclose(zv, (np.exp(a) + 2 * a).sum(), rtol=1e-5)
+
+    def test_variable_operators_static(self):
+        import paddle_tpu as pt
+        from paddle_tpu import layers
+        from paddle_tpu.framework.program import Program, program_guard
+
+        main, startup = Program(), Program()
+        with program_guard(main, startup):
+            x = layers.data("x", [3])
+            y = (x * 2.0 + 1.0) / 2.0
+            z = y.mean()
+        exe = pt.Executor(pt.CPUPlace())
+        exe.run(startup)
+        a = np.random.RandomState(5).randn(2, 3).astype("f4")
+        (zv,) = exe.run(main, feed={"x": a}, fetch_list=[z])
+        np.testing.assert_allclose(zv, ((a * 2 + 1) / 2).mean(), rtol=1e-5)
+
+
+class TestNN:
+    def test_linear_and_activations(self, rng):
+        x = T(rng.randn(4, 8))
+        for layer, ref in [
+            (nn.ReLU(), lambda v: np.maximum(v, 0)),
+            (nn.Sigmoid(), lambda v: 1 / (1 + np.exp(-v))),
+            (nn.Tanh(), np.tanh),
+        ]:
+            np.testing.assert_allclose(layer(x).numpy(), ref(x.numpy()), rtol=1e-5)
+        lin = nn.Linear(8, 2)
+        np.testing.assert_allclose(
+            lin(x).numpy(),
+            x.numpy() @ lin.weight.numpy() + lin.bias.numpy(), rtol=1e-4)
+
+    def test_conv_pool_shapes(self, rng):
+        x = T(rng.randn(2, 3, 16, 16))
+        conv = nn.Conv2D(3, 8, 3, padding=1)
+        y = conv(x)
+        assert y.shape == [2, 8, 16, 16]
+        assert nn.MaxPool2D(2, 2)(y).shape == [2, 8, 8, 8]
+        assert nn.AdaptiveAvgPool2D(1)(y).shape == [2, 8, 1, 1]
+        assert nn.Conv2DTranspose(3, 4, 2, stride=2)(x).shape == [2, 4, 32, 32]
+
+    def test_conv_transpose_matches_torch(self, rng):
+        import torch
+        import torch.nn.functional as tF
+
+        x = rng.randn(2, 3, 8, 8).astype("f4")
+        w = rng.randn(3, 4, 3, 3).astype("f4")
+        for stride, pad in [(1, 0), (2, 1)]:
+            ref = tF.conv_transpose2d(torch.tensor(x), torch.tensor(w),
+                                      stride=stride, padding=pad).numpy()
+            got = F.conv2d_transpose(T(x), T(w), stride=stride, padding=pad)
+            np.testing.assert_allclose(got.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+    def test_batchnorm_updates_stats(self, rng):
+        bn = nn.BatchNorm2D(3)
+        x = T(rng.randn(8, 3, 4, 4) * 2 + 1)
+        bn.train()
+        y = bn(x)
+        assert y.shape == [8, 3, 4, 4]
+        assert not np.allclose(bn._mean.numpy(), np.zeros(3))
+        bn.eval()
+        y2 = bn(x)  # uses running stats, no update
+        m = bn._mean.numpy().copy()
+        bn(x)
+        np.testing.assert_allclose(bn._mean.numpy(), m)
+
+    def test_layernorm_matches_numpy(self, rng):
+        ln = nn.LayerNorm(6)
+        a = rng.randn(3, 6).astype("f4")
+        y = ln(T(a)).numpy()
+        ref = (a - a.mean(-1, keepdims=True)) / np.sqrt(a.var(-1, keepdims=True) + 1e-5)
+        np.testing.assert_allclose(y, ref, rtol=1e-4, atol=1e-5)
+
+    def test_embedding_and_dropout(self, rng):
+        emb = nn.Embedding(10, 4)
+        ids = paddle.to_tensor(np.array([[1, 2], [3, 4]], dtype="int64"))
+        assert emb(ids).shape == [2, 2, 4]
+        d = nn.Dropout(0.5)
+        d.eval()
+        x = T(rng.randn(4, 4))
+        np.testing.assert_allclose(d(x).numpy(), x.numpy())
+
+    def test_losses(self, rng):
+        logits = T(rng.randn(6, 5))
+        labels = paddle.to_tensor(rng.randint(0, 5, (6,)).astype("int64"))
+        loss = nn.CrossEntropyLoss()(logits, labels)
+        lp = logits.numpy() - np.log(np.exp(logits.numpy()).sum(-1, keepdims=True))
+        ref = -lp[np.arange(6), labels.numpy()].mean()
+        np.testing.assert_allclose(loss.numpy(), ref, rtol=1e-4)
+
+        a, b = T(rng.randn(4)), T(rng.randn(4))
+        np.testing.assert_allclose(nn.MSELoss()(a, b).numpy(),
+                                   ((a.numpy() - b.numpy()) ** 2).mean(), rtol=1e-5)
+
+    def test_sequential_and_layerlist(self):
+        m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        assert len(m) == 3
+        x = T(np.random.RandomState(0).randn(2, 4))
+        assert m(x).shape == [2, 2]
+        ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+        assert len(list(ll.parameters())) == 6
+
+    def test_transformer_forward_backward(self, rng):
+        layer = nn.TransformerEncoderLayer(d_model=16, nhead=4, dim_feedforward=32,
+                                           dropout=0.0)
+        enc = nn.TransformerEncoder(layer, 2)
+        x = T(rng.randn(2, 6, 16))
+        y = enc(x)
+        assert y.shape == [2, 6, 16]
+        loss = paddle.mean(paddle.square(y))
+        loss.backward()
+        grads = [p.grad for p in enc.parameters()]
+        assert all(g is not None for g in grads)
+        assert all(np.isfinite(g.numpy()).all() for g in grads)
+
+    def test_attention_mask(self, rng):
+        mha = nn.MultiHeadAttention(8, 2, dropout=0.0)
+        x = T(rng.randn(1, 4, 8))
+        mask = paddle.to_tensor(np.tril(np.ones((1, 1, 4, 4))).astype("bool"))
+        y = mha(x, x, x, attn_mask=mask)
+        assert y.shape == [1, 4, 8]
+
+
+class TestOptimizer2:
+    def _loss(self, w):
+        return paddle.mean(paddle.square(w))
+
+    def test_sgd_matches_closed_form(self):
+        w = nn.Parameter(np.ones(4, dtype="f4") * 2.0)
+        opt = paddle.optimizer.SGD(learning_rate=0.5, parameters=[w])
+        self._loss(w).backward()
+        opt.step()
+        # dL/dw = 2w/4 = w/2 -> w' = w - 0.5*w/2 = 1.5
+        np.testing.assert_allclose(w.numpy(), np.full(4, 1.5), rtol=1e-6)
+
+    def test_adam_matches_reference_formula(self):
+        a = np.array([1.0, -2.0, 3.0], dtype="f4")
+        w = nn.Parameter(a.copy())
+        opt = paddle.optimizer.Adam(learning_rate=0.1, parameters=[w])
+        paddle.sum(w * w).backward()
+        opt.step()
+        g = 2 * a
+        m = 0.1 * g
+        v = 0.001 * g * g
+        lr_t = 0.1 * np.sqrt(1 - 0.999) / (1 - 0.9)
+        ref = a - lr_t * m / (np.sqrt(v) + 1e-8)
+        np.testing.assert_allclose(w.numpy(), ref, rtol=1e-4)
+
+    def test_adamw_decay(self):
+        a = np.ones(3, dtype="f4")
+        w = nn.Parameter(a.copy())
+        opt = paddle.optimizer.AdamW(learning_rate=0.1, weight_decay=0.1,
+                                     parameters=[w])
+        paddle.sum(w).backward()
+        opt.step()
+        assert (w.numpy() < 1.0).all()
+
+    def test_momentum_and_clear(self):
+        w = nn.Parameter(np.ones(2, dtype="f4"))
+        opt = paddle.optimizer.Momentum(0.1, 0.9, parameters=[w])
+        self._loss(w).backward()
+        opt.step()
+        opt.clear_grad()
+        assert w.grad is None
+
+    def test_grad_clip_global_norm(self):
+        w = nn.Parameter(np.ones(4, dtype="f4"))
+        clip = nn.ClipGradByGlobalNorm(0.1)
+        opt = paddle.optimizer.SGD(1.0, parameters=[w], grad_clip=clip)
+        paddle.sum(w * w * 100).backward()  # big grads
+        opt.step()
+        # ||update|| == lr * clip_norm
+        delta = np.linalg.norm(1.0 - w.numpy())
+        np.testing.assert_allclose(delta, 0.1, rtol=1e-4)
+
+    def test_lr_scheduler(self):
+        sched = paddle.optimizer.lr.StepDecay(learning_rate=0.1, step_size=2, gamma=0.5)
+        w = nn.Parameter(np.ones(2, dtype="f4"))
+        opt = paddle.optimizer.SGD(learning_rate=sched, parameters=[w])
+        assert abs(opt.get_lr() - 0.1) < 1e-8
+        sched.step()
+        sched.step()
+        assert abs(opt.get_lr() - 0.05) < 1e-8
+
+    def test_eager_static_adam_parity(self):
+        """Same init + same data: dygraph Adam trajectory == static Adam."""
+        import paddle_tpu as pt
+        from paddle_tpu import layers
+        from paddle_tpu.framework.program import Program, program_guard
+
+        w0 = np.random.RandomState(0).randn(4, 1).astype("f4")
+        xd = np.random.RandomState(1).randn(16, 4).astype("f4")
+        yd = (xd @ w0).astype("f4")
+
+        # static
+        main, startup = Program(), Program()
+        with program_guard(main, startup):
+            x = layers.data("x", [4])
+            y = layers.data("y", [1])
+            from paddle_tpu.param_attr import ParamAttr
+            from paddle_tpu.initializer import NumpyArrayInitializer
+
+            pred = layers.fc(x, 1, param_attr=ParamAttr(
+                name="w", initializer=NumpyArrayInitializer(np.ones((4, 1), "f4"))),
+                bias_attr=False)
+            loss = layers.mean(layers.square_error_cost(pred, y))
+            pt.optimizer.AdamOptimizer(0.1).minimize(loss)
+        exe = pt.Executor(pt.CPUPlace())
+        exe.run(startup)
+        static_losses = [float(exe.run(main, feed={"x": xd, "y": yd},
+                                       fetch_list=[loss])[0]) for _ in range(5)]
+
+        # dygraph
+        w = nn.Parameter(np.ones((4, 1), dtype="f4"))
+        opt = paddle.optimizer.Adam(0.1, parameters=[w])
+        dy_losses = []
+        for _ in range(5):
+            pred = paddle.matmul(paddle.to_tensor(xd), w)
+            l = paddle.mean(paddle.square(paddle.subtract(pred, paddle.to_tensor(yd))))
+            l.backward()
+            opt.step()
+            opt.clear_grad()
+            dy_losses.append(float(l.numpy()))
+        np.testing.assert_allclose(static_losses, dy_losses, rtol=1e-4, atol=1e-6)
