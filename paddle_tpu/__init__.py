@@ -21,6 +21,7 @@ from .dygraph.tensor import Tensor  # noqa: F401
 # 2.0 flat namespace (reference python/paddle/__init__.py ~210 imports)
 from . import tensor  # noqa: F401
 from . import nn  # noqa: F401
+from . import distributed  # noqa: F401
 from .tensor import (  # noqa: F401
     abs, add, add_n, all, allclose, any, arange, argmax, argmin, argsort,
     assign, bmm, broadcast_to, cast, ceil, chunk, clip, concat, cos, cumsum,

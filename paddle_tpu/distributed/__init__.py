@@ -1,0 +1,26 @@
+"""`paddle.distributed` equivalent (reference python/paddle/distributed/).
+
+SURVEY §2.8/2.9: collective functions, fleet facade, parallel env (mesh),
+launcher.  The communication backend is XLA collectives over ICI/DCN —
+see ops/collective.py for the c_* lowerings.
+"""
+from . import fleet  # noqa: F401
+from .collective import (  # noqa: F401
+    ReduceOp,
+    all_gather,
+    all_reduce,
+    barrier,
+    broadcast,
+    get_rank,
+    get_world_size,
+    reduce,
+    scatter,
+)
+from .parallel import DataParallel, prepare_context, spawn  # noqa: F401
+from .parallel_env import (  # noqa: F401
+    ParallelEnv,
+    get_mesh,
+    init_parallel_env,
+    reset_mesh,
+    set_mesh,
+)

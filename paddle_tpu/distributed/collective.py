@@ -1,0 +1,99 @@
+"""paddle.distributed collective functions.
+
+Role parity: reference python/paddle/distributed/collective.py:89-444 —
+broadcast/all_reduce/reduce/all_gather/scatter/barrier emitting c_* ops.
+Dual-mode like the rest of the 2.0 API: on graph Variables they append
+the c_* op (lowered to XLA collectives under the mesh); on eager Tensors
+with a single process they are the world-size-1 identity semantics.
+"""
+from __future__ import annotations
+
+from ..dispatch import op_call
+
+
+class ReduceOp:
+    SUM = 0
+    MAX = 1
+    MIN = 2
+    PROD = 3
+
+
+_RED_SUFFIX = {ReduceOp.SUM: "sum", ReduceOp.MAX: "max",
+               ReduceOp.MIN: "min", ReduceOp.PROD: "prod"}
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=0, use_calc_stream=True):
+    out = op_call(f"c_allreduce_{_RED_SUFFIX[op]}", {"X": tensor},
+                  {"ring_id": int(group), "use_calc_stream": use_calc_stream})
+    _write_back(tensor, out)
+    return out
+
+
+def reduce(tensor, dst, op=ReduceOp.SUM, group=0, use_calc_stream=True):
+    out = op_call(f"c_reduce_{_RED_SUFFIX[op]}", {"X": tensor},
+                  {"ring_id": int(group), "root_id": int(dst),
+                   "use_calc_stream": use_calc_stream})
+    _write_back(tensor, out)
+    return out
+
+
+def broadcast(tensor, src, group=0, use_calc_stream=True):
+    out = op_call("c_broadcast", {"X": tensor},
+                  {"ring_id": int(group), "root": int(src),
+                   "use_calc_stream": use_calc_stream})
+    _write_back(tensor, out)
+    return out
+
+
+def all_gather(tensor_list, tensor, group=0, use_calc_stream=True):
+    out = op_call("c_allgather", {"X": tensor},
+                  {"ring_id": int(group), "use_calc_stream": use_calc_stream})
+    if isinstance(tensor_list, list):
+        from ..tensor.manipulation import split
+
+        from .parallel_env import get_world_size
+
+        n = max(get_world_size(), 1)
+        tensor_list.extend(split(out, n, axis=0) if n > 1 else [out])
+    return out
+
+
+def scatter(tensor, tensor_list=None, src=0, group=0, use_calc_stream=True):
+    src_val = tensor
+    if tensor_list:
+        from ..tensor.manipulation import concat
+
+        src_val = concat(list(tensor_list), axis=0)
+    out = op_call("c_scatter", {"X": src_val},
+                  {"ring_id": int(group), "root": int(src),
+                   "use_calc_stream": use_calc_stream})
+    _write_back(tensor, out)
+    return out
+
+
+def barrier(group=0):
+    # process-level rendezvous outside compiled programs
+    import jax
+
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(f"pd_barrier_{group}")
+
+
+def get_rank():
+    from .parallel_env import get_rank as _r
+
+    return _r()
+
+
+def get_world_size():
+    from .parallel_env import get_world_size as _w
+
+    return max(_w(), 1)
+
+
+def _write_back(tensor, out):
+    """Reference collective funcs mutate their input tensor in place."""
+    if hasattr(tensor, "_set_raw") and hasattr(out, "_value"):
+        tensor._set_raw(out._value)

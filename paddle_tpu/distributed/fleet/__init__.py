@@ -1,0 +1,129 @@
+"""Fleet: the distributed-training facade.
+
+Role parity: reference python/paddle/distributed/fleet/base/fleet_base.py —
+fleet.init:125, worker_num/worker_index, distributed_optimizer:554,
+minimize:946 (meta-optimizer selection), barrier_worker.  TPU-native:
+init builds the device mesh (parallel_env) instead of NCCL rings; minimize
+runs the meta-optimizer chain and the collective transpile; the executor
+runs the result SPMD over the mesh.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ..parallel_env import get_mesh, get_rank, get_world_size, init_parallel_env
+from .base.distributed_strategy import DistributedStrategy
+from .base.role_maker import PaddleCloudRoleMaker, RoleMakerBase, UserDefinedRoleMaker
+from .meta_optimizers import compile_strategy
+
+
+class Fleet:
+    def __init__(self):
+        self._role_maker: Optional[RoleMakerBase] = None
+        self._strategy: Optional[DistributedStrategy] = None
+        self._user_optimizer = None
+        self._is_collective = True
+        self._inited = False
+
+    # -- lifecycle --------------------------------------------------------
+    def init(self, role_maker=None, is_collective=True, strategy=None):
+        self._role_maker = role_maker or PaddleCloudRoleMaker(
+            is_collective=is_collective)
+        self._is_collective = is_collective
+        self._strategy = strategy or DistributedStrategy()
+        if is_collective and get_mesh() is None:
+            init_parallel_env()
+        self._inited = True
+        return self
+
+    # -- topology queries -------------------------------------------------
+    def is_first_worker(self) -> bool:
+        return self.worker_index() == 0
+
+    def worker_index(self) -> int:
+        return get_rank()
+
+    def worker_num(self) -> int:
+        return max(get_world_size(), 1)
+
+    def is_worker(self) -> bool:
+        return self._role_maker is None or self._role_maker._is_worker()
+
+    def worker_endpoints(self, to_string=False):
+        eps = (self._role_maker._get_trainer_endpoints()
+               if self._role_maker else [])
+        return ",".join(eps) if to_string else eps
+
+    def is_server(self) -> bool:
+        return bool(self._role_maker and getattr(
+            self._role_maker, "_is_server", lambda: False)())
+
+    def barrier_worker(self):
+        if self._role_maker:
+            self._role_maker._barrier("worker")
+
+    # PS-mode API parity stubs (documented N/A on TPU: SURVEY §2.8 —
+    # the north star is collective mode; these keep user scripts importable)
+    def init_worker(self):
+        pass
+
+    def init_server(self, *args, **kwargs):
+        pass
+
+    def run_server(self):
+        raise NotImplementedError(
+            "parameter-server mode is N/A on the TPU collective runtime "
+            "(SURVEY §2.8); use is_collective=True")
+
+    def stop_worker(self):
+        pass
+
+    # -- optimizer --------------------------------------------------------
+    def distributed_optimizer(self, optimizer, strategy=None):
+        if strategy is not None:
+            self._strategy = strategy
+        self._user_optimizer = optimizer
+        return self
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        if self._user_optimizer is None:
+            raise RuntimeError("call fleet.distributed_optimizer(opt) first")
+        chain = compile_strategy(loss, self._role_maker,
+                                 self._user_optimizer, self._strategy)
+        return chain.minimize(loss, startup_program, parameter_list, no_grad_set)
+
+    # dygraph path: return the optimizer wrapped for DP (grads psum'd by
+    # DataParallel.apply_collective_grads before step)
+    @property
+    def user_defined_optimizer(self):
+        return self._user_optimizer
+
+    @property
+    def distributed_strategy(self):
+        return self._strategy
+
+
+_fleet_singleton = Fleet()
+
+init = _fleet_singleton.init
+is_first_worker = _fleet_singleton.is_first_worker
+worker_index = _fleet_singleton.worker_index
+worker_num = _fleet_singleton.worker_num
+is_worker = _fleet_singleton.is_worker
+worker_endpoints = _fleet_singleton.worker_endpoints
+is_server = _fleet_singleton.is_server
+barrier_worker = _fleet_singleton.barrier_worker
+init_worker = _fleet_singleton.init_worker
+init_server = _fleet_singleton.init_server
+run_server = _fleet_singleton.run_server
+stop_worker = _fleet_singleton.stop_worker
+distributed_optimizer = _fleet_singleton.distributed_optimizer
+minimize = _fleet_singleton.minimize
+
+__all__ = [
+    "DistributedStrategy", "Fleet", "PaddleCloudRoleMaker",
+    "UserDefinedRoleMaker", "init", "is_first_worker", "worker_index",
+    "worker_num", "is_worker", "barrier_worker", "distributed_optimizer",
+    "minimize",
+]

@@ -1,0 +1,121 @@
+"""DistributedStrategy: python façade over the strategy proto.
+
+Role parity: reference
+python/paddle/distributed/fleet/base/distributed_strategy.py:101 backed
+by framework/distributed_strategy.proto:110 — same property names, same
+serializability.
+"""
+from __future__ import annotations
+
+from ... import distributed_strategy_pb2 as pb
+
+
+def _config_to_dict(msg):
+    out = {}
+    for field in msg.DESCRIPTOR.fields:
+        v = getattr(msg, field.name)
+        if field.label == field.LABEL_REPEATED:
+            v = list(v)
+        out[field.name] = v
+    return out
+
+
+def _dict_to_config(msg, configs: dict):
+    for k, v in (configs or {}).items():
+        field = msg.DESCRIPTOR.fields_by_name.get(k)
+        if field is None:
+            raise ValueError(
+                f"unknown config key {k!r} for {msg.DESCRIPTOR.name}; valid: "
+                f"{sorted(msg.DESCRIPTOR.fields_by_name)}")
+        if field.label == field.LABEL_REPEATED:
+            del getattr(msg, k)[:]
+            getattr(msg, k).extend(v)
+        else:
+            setattr(msg, k, v)
+
+
+def _bool_prop(name):
+    def get(self):
+        return getattr(self._proto, name)
+
+    def set(self, v):
+        setattr(self._proto, name, bool(v))
+
+    return property(get, set)
+
+
+def _config_prop(name):
+    def get(self):
+        return _config_to_dict(getattr(self._proto, name))
+
+    def set(self, configs):
+        _dict_to_config(getattr(self._proto, name), configs)
+
+    return property(get, set)
+
+
+class DistributedStrategy:
+    def __init__(self):
+        self._proto = pb.DistributedStrategy()
+
+    # serialization parity (reference save_to_prototxt/load_from_prototxt)
+    def save_to_prototxt(self, path):
+        from google.protobuf import text_format
+
+        with open(path, "w") as f:
+            f.write(text_format.MessageToString(self._proto))
+
+    def load_from_prototxt(self, path):
+        from google.protobuf import text_format
+
+        with open(path) as f:
+            text_format.Parse(f.read(), self._proto)
+
+    def serialize_to_string(self) -> bytes:
+        return self._proto.SerializeToString()
+
+    def parse_from_string(self, data: bytes):
+        self._proto.ParseFromString(data)
+
+    amp = _bool_prop("amp")
+    recompute = _bool_prop("recompute")
+    localsgd = _bool_prop("localsgd")
+    dgc = _bool_prop("dgc")
+    gradient_merge = _bool_prop("gradient_merge")
+    lars = _bool_prop("lars")
+    lamb = _bool_prop("lamb")
+    pipeline = _bool_prop("pipeline")
+    elastic = _bool_prop("elastic")
+    auto = _bool_prop("auto")
+    a_sync = _bool_prop("a_sync")
+    sync_batch_norm = _bool_prop("sync_batch_norm")
+    fuse_all_reduce_ops = _bool_prop("fuse_all_reduce_ops")
+    fp16_allreduce = _bool_prop("fp16_allreduce")
+    sharding = _bool_prop("sharding")
+    tensor_parallel = _bool_prop("tensor_parallel")
+    sequence_parallel = _bool_prop("sequence_parallel")
+
+    recompute_configs = _config_prop("recompute_configs")
+    amp_configs = _config_prop("amp_configs")
+    localsgd_configs = _config_prop("localsgd_configs")
+    gradient_merge_configs = _config_prop("gradient_merge_configs")
+    dgc_configs = _config_prop("dgc_configs")
+    lars_configs = _config_prop("lars_configs")
+    lamb_configs = _config_prop("lamb_configs")
+    pipeline_configs = _config_prop("pipeline_configs")
+    sharding_configs = _config_prop("sharding_configs")
+    a_sync_configs = _config_prop("a_sync_configs")
+    tensor_parallel_configs = _config_prop("tensor_parallel_configs")
+
+    @property
+    def nccl_comm_num(self):
+        return self._proto.nccl_comm_num
+
+    @nccl_comm_num.setter
+    def nccl_comm_num(self, v):
+        self._proto.nccl_comm_num = int(v)
+
+    def __repr__(self):
+        on = [f.name for f in self._proto.DESCRIPTOR.fields
+              if f.type == f.TYPE_BOOL and getattr(self._proto, f.name)]
+        return f"DistributedStrategy(enabled={on})"

@@ -1,0 +1,82 @@
+"""RoleMaker: cluster topology from env vars.
+
+Role parity: reference fleet/base/role_maker.py:33 (PaddleCloudRoleMaker
+env parsing :363) — PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM /
+PADDLE_TRAINER_ENDPOINTS.  The reference's embedded Gloo rendezvous
+(:172) is replaced by jax.distributed's coordination service, which
+init_parallel_env stands up; the barrier/all_reduce helpers here are
+host-level conveniences over it.
+"""
+from __future__ import annotations
+
+import os
+
+
+class Role:
+    WORKER = 1
+    SERVER = 2
+
+
+class RoleMakerBase:
+    def _is_worker(self):
+        raise NotImplementedError
+
+    def _worker_num(self):
+        raise NotImplementedError
+
+    def _worker_index(self):
+        raise NotImplementedError
+
+    def _is_first_worker(self):
+        return self._is_worker() and self._worker_index() == 0
+
+
+class PaddleCloudRoleMaker(RoleMakerBase):
+    def __init__(self, is_collective=True, **kwargs):
+        self._is_collective = is_collective
+        self._rank = int(os.environ.get("PADDLE_TRAINER_ID", "0") or 0)
+        self._size = int(os.environ.get("PADDLE_TRAINERS_NUM", "1") or 1)
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        self._endpoints = [e for e in eps.split(",") if e]
+        self._role = Role.WORKER
+
+    def _is_worker(self):
+        return self._role == Role.WORKER
+
+    def _is_server(self):
+        return self._role == Role.SERVER
+
+    def _worker_num(self):
+        return self._size
+
+    def _worker_index(self):
+        return self._rank
+
+    def _get_trainer_endpoints(self):
+        return list(self._endpoints)
+
+    def _barrier(self, comm_world="worker"):
+        # the coordination service barrier (process level)
+        import jax
+
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices("fleet_barrier")
+
+    def _all_gather(self, obj, comm_world="worker"):
+        import jax
+
+        if jax.process_count() <= 1:
+            return [obj]
+        from jax.experimental import multihost_utils
+
+        return list(multihost_utils.broadcast_one_to_all(obj))
+
+
+class UserDefinedRoleMaker(PaddleCloudRoleMaker):
+    def __init__(self, current_id=0, worker_num=1, role=Role.WORKER, **kwargs):
+        super().__init__(is_collective=True)
+        self._rank = current_id
+        self._size = worker_num
+        self._role = role

@@ -1,0 +1,116 @@
+"""Collective program transpile: insert grad-allreduce into a program.
+
+Role parity: reference python/paddle/fluid/transpiler/collective.py —
+`GradAllReduce` (:244) scales the loss grad by 1/nranks
+(_insert_scale_loss_grad_ops) and inserts `c_allreduce_sum` after each
+parameter gradient; `LocalSGD` (:270) periodically averages params.
+TPU-native: no comm-init ops are inserted (the mesh already exists);
+the c_allreduce_sum ops lower to lax.psum inside the one compiled
+train-step program.
+"""
+from __future__ import annotations
+
+from ...framework.program import GRAD_SUFFIX, Program
+
+
+def _grad_param_pairs(block, params_grads=None):
+    if params_grads:
+        return [(p.name if hasattr(p, "name") else p,
+                 g.name if hasattr(g, "name") else g) for p, g in params_grads]
+    pairs = []
+    for var in block.vars.values():
+        if getattr(var, "is_parameter", False):
+            gname = var.name + GRAD_SUFFIX
+            if block._find_var_recursive(gname) is not None:
+                pairs.append((var.name, gname))
+    return pairs
+
+
+class GradAllReduce:
+    def __init__(self, nranks, ring_id=0, fuse_all_reduce=True):
+        self.nranks = nranks
+        self.ring_id = ring_id
+
+    def transpile(self, main_program: Program, params_grads=None,
+                  loss_grad_name=None):
+        if self.nranks <= 1:
+            return main_program
+        block = main_program.global_block
+        pairs = _grad_param_pairs(block, params_grads)
+        grad_names = {g for _, g in pairs}
+
+        new_ops = []
+        for op in block.ops:
+            new_ops.append(op)
+            # scale the loss grad once (reference _insert_scale_loss_grad_ops)
+            if loss_grad_name and loss_grad_name in op.output_arg_names() \
+                    and op.type == "fill_constant":
+                from ...framework.program import Operator
+
+                new_ops.append(Operator(
+                    block, "scale", {"X": [loss_grad_name]},
+                    {"Out": [loss_grad_name]},
+                    {"scale": 1.0 / self.nranks, "bias": 0.0,
+                     "bias_after_scale": True}))
+            # allreduce each grad right after the op that produces it last
+            produced = [g for g in op.output_arg_names() if g in grad_names]
+            for g in produced:
+                if self._is_last_def(block, op, g):
+                    from ...framework.program import Operator
+
+                    new_ops.append(Operator(
+                        block, "c_allreduce_sum", {"X": [g]}, {"Out": [g]},
+                        {"ring_id": self.ring_id, "use_calc_stream": True}))
+        block.ops[:] = new_ops
+        main_program._bump()  # direct ops[] rewrite: invalidate fingerprint
+        return main_program
+
+    @staticmethod
+    def _is_last_def(block, op, name):
+        seen = False
+        for other in block.ops:
+            if other is op:
+                seen = True
+                continue
+            if seen and name in other.output_arg_names() \
+                    and other.type != "c_allreduce_sum":
+                return False
+        return True
+
+
+class LocalSGD:
+    """Periodic parameter averaging (reference transpiler/collective.py:270).
+
+    On TPU the step-K averaging is driven host-side: call
+    ``average_step(exe, scope)`` once per train step; every k_steps-th
+    call runs a tiny compiled program psum-averaging the params.
+    """
+
+    def __init__(self, nranks, k_steps=1, ring_id=0):
+        self.nranks, self.k_steps, self.ring_id = nranks, k_steps, ring_id
+        self._avg_program = None
+        self._step = 0
+
+    def build_average_program(self, main_program: Program) -> Program:
+        from ...framework.program import Program as P
+
+        avg = P()
+        block = avg.global_block
+        for var in main_program.global_block.vars.values():
+            if getattr(var, "is_parameter", False):
+                block.create_var(name=var.name, shape=var.shape,
+                                 dtype=var.dtype, persistable=True)
+                block.append_op("c_allreduce_sum", {"X": var.name},
+                                {"Out": var.name}, {"ring_id": self.ring_id})
+                block.append_op("scale", {"X": var.name}, {"Out": var.name},
+                                {"scale": 1.0 / self.nranks, "bias": 0.0})
+        self._avg_program = avg
+        return avg
+
+    def average_step(self, exe, scope=None):
+        """Call once per train step; averages params every k_steps calls."""
+        self._step += 1
+        if self._avg_program is None or self._step % self.k_steps:
+            return False
+        exe.run(self._avg_program, scope=scope)
+        return True

@@ -1,0 +1,193 @@
+"""Meta-optimizers: strategy-driven optimizer/program rewrites.
+
+Role parity: reference fleet/meta_optimizers/ (13 classes) + the
+StrategyCompiler chain (fleet/base/strategy_compiler.py:89,112).  Each
+meta-optimizer declares _can_apply() against the DistributedStrategy and
+wraps minimize; the compiler orders the applicable ones and the last
+graph-level one performs the collective transpile.
+"""
+from __future__ import annotations
+
+from ...framework.program import GRAD_SUFFIX
+from .collective_transpiler import GradAllReduce, LocalSGD
+
+
+class MetaOptimizerBase:
+    can_be_last = False
+
+    def __init__(self, inner_opt):
+        self.inner_opt = inner_opt
+        self.role_maker = None
+        self.user_strategy = None
+
+    def _set_basic_info(self, loss, role_maker, user_opt, user_strategy):
+        self.loss = loss
+        self.role_maker = role_maker
+        self.user_opt = user_opt
+        self.user_strategy = user_strategy
+
+    def _can_apply(self) -> bool:
+        return False
+
+    def _nranks(self):
+        from ..parallel_env import get_world_size
+
+        return get_world_size()
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        return self.inner_opt.minimize(loss, startup_program, parameter_list,
+                                       no_grad_set)
+
+
+class LarsMetaOptimizer(MetaOptimizerBase):
+    """Swap Momentum for LARS (reference lars_optimizer.py)."""
+
+    def _can_apply(self):
+        from ...optimizer.static_opt import MomentumOptimizer
+
+        return (self.user_strategy.lars
+                and isinstance(self.inner_opt, MomentumOptimizer))
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        from ...optimizer.static_opt import LarsMomentumOptimizer
+
+        cfg = self.user_strategy.lars_configs
+        opt = LarsMomentumOptimizer(
+            learning_rate=self.inner_opt._learning_rate,
+            momentum=getattr(self.inner_opt, "_momentum", 0.9),
+            lars_coeff=cfg["lars_coeff"],
+            lars_weight_decay=cfg["lars_weight_decay"],
+            regularization=self.inner_opt.regularization,
+            grad_clip=self.inner_opt._grad_clip)
+        return opt.minimize(loss, startup_program, parameter_list, no_grad_set)
+
+
+class LambMetaOptimizer(MetaOptimizerBase):
+    """Swap Adam for LAMB (reference lamb_optimizer.py)."""
+
+    def _can_apply(self):
+        from ...optimizer.static_opt import AdamOptimizer
+
+        return (self.user_strategy.lamb
+                and isinstance(self.inner_opt, AdamOptimizer))
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        from ...optimizer.static_opt import LambOptimizer
+
+        cfg = self.user_strategy.lamb_configs
+        opt = LambOptimizer(
+            learning_rate=self.inner_opt._learning_rate,
+            beta1=getattr(self.inner_opt, "_beta1", 0.9),
+            beta2=getattr(self.inner_opt, "_beta2", 0.999),
+            epsilon=getattr(self.inner_opt, "_epsilon", 1e-6),
+            lamb_weight_decay=cfg["lamb_weight_decay"],
+            regularization=self.inner_opt.regularization,
+            grad_clip=self.inner_opt._grad_clip)
+        return opt.minimize(loss, startup_program, parameter_list, no_grad_set)
+
+
+class RecomputeMetaOptimizer(MetaOptimizerBase):
+    """Activation recompute (reference recompute_optimizer.py).
+
+    TPU note: the XLA path's generic grad lowering already re-emits the
+    forward under vjp, so memory-for-compute here means marking segments
+    for jax.checkpoint; wired through program._recompute_checkpoints and
+    honored by the scan-based pipeline executor (milestone: pipeline).
+    """
+
+    def _can_apply(self):
+        return self.user_strategy.recompute
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        prog = loss.block.program
+        prog._recompute_checkpoints = list(
+            self.user_strategy.recompute_configs.get("checkpoints", []))
+        return self.inner_opt.minimize(loss, startup_program, parameter_list,
+                                       no_grad_set)
+
+
+class FP16AllReduceMetaOptimizer(MetaOptimizerBase):
+    """Cast grads to fp16/bf16 around the allreduce
+    (reference fp16_allreduce_optimizer.py)."""
+
+    def _can_apply(self):
+        return self.user_strategy.fp16_allreduce
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        ops, params_grads = self.inner_opt.minimize(
+            loss, startup_program, parameter_list, no_grad_set)
+        loss.block.program._fp16_allreduce = True
+        return ops, params_grads
+
+
+class LocalSGDMetaOptimizer(MetaOptimizerBase):
+    """Periodic param averaging instead of per-step allreduce
+    (reference localsgd_optimizer.py)."""
+
+    can_be_last = True
+
+    def _can_apply(self):
+        return self.user_strategy.localsgd
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        ops, params_grads = self.inner_opt.minimize(
+            loss, startup_program, parameter_list, no_grad_set)
+        cfg = self.user_strategy.localsgd_configs
+        prog = loss.block.program
+        prog._localsgd = LocalSGD(self._nranks(), k_steps=cfg["k_steps"])
+        prog._localsgd_avg_program = prog._localsgd.build_average_program(prog)
+        return ops, params_grads
+
+
+class GraphExecutionMetaOptimizer(MetaOptimizerBase):
+    """The default collective DP transpile (reference
+    graph_execution_optimizer.py:92 + transpiler/collective.py:244)."""
+
+    can_be_last = True
+
+    def _can_apply(self):
+        return self._nranks() > 1
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        ops, params_grads = self.inner_opt.minimize(
+            loss, startup_program, parameter_list, no_grad_set)
+        GradAllReduce(self._nranks()).transpile(
+            loss.block.program, params_grads,
+            loss_grad_name=loss.name + GRAD_SUFFIX)
+        return ops, params_grads
+
+
+META_OPTIMIZERS = [
+    LarsMetaOptimizer,
+    LambMetaOptimizer,
+    RecomputeMetaOptimizer,
+    FP16AllReduceMetaOptimizer,
+    LocalSGDMetaOptimizer,
+    GraphExecutionMetaOptimizer,
+]
+
+
+def compile_strategy(loss, role_maker, inner_opt, strategy):
+    """Longest-compatible-chain ordering (reference strategy_compiler.py:89):
+    each applicable meta-optimizer wraps the previous; graph-level ones
+    (can_be_last) are mutually exclusive — the first applicable wins."""
+    chain = inner_opt
+    last_used = False
+    for cls in META_OPTIMIZERS:
+        mo = cls(chain)
+        mo._set_basic_info(loss, role_maker, inner_opt, strategy)
+        if not mo._can_apply():
+            continue
+        if mo.can_be_last:
+            if last_used:
+                continue
+            last_used = True
+        chain = mo
+    return chain

@@ -1,0 +1,75 @@
+"""Dygraph DataParallel + spawn.
+
+Role parity: reference python/paddle/fluid/dygraph/parallel.py
+(`DataParallel`:335, `scale_loss`:432, `apply_collective_grads`:441) and
+distributed/spawn.py:231.  TPU-native: within one host the mesh/SPMD
+path (to_static or the fleet static flow) is the performant route; this
+wrapper keeps eager multi-process semantics — grads are psum'd across
+processes via a tiny pjit'd all-reduce when jax.distributed is live, and
+it is the world-size-1 identity otherwise.
+"""
+from __future__ import annotations
+
+from ..dygraph.layers import Layer
+from .parallel_env import ParallelEnv, get_world_size, init_parallel_env
+
+
+def prepare_context(strategy=None):
+    init_parallel_env()
+    return ParallelEnv()
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1):
+        super().__init__()
+        self._layers = layers
+        self._nranks = max(get_world_size(),
+                           ParallelEnv().world_size)
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def scale_loss(self, loss):
+        if self._nranks <= 1:
+            return loss
+        from ..tensor.math import scale
+
+        return scale(loss, 1.0 / self._nranks)
+
+    def apply_collective_grads(self):
+        if self._nranks <= 1:
+            return
+        import jax
+
+        if jax.process_count() <= 1:
+            return  # single process drives all devices; grads already global
+        from jax.experimental import multihost_utils
+
+        for p in self._layers.parameters():
+            if p.grad is not None:
+                summed = multihost_utils.process_allgather(p.grad._value)
+                p.grad._set_raw(summed.sum(axis=0))
+
+    # delegation so DataParallel looks like the wrapped layer
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)
+
+    def state_dict(self, *a, **kw):
+        return self._layers.state_dict(*a, **kw)
+
+    def set_state_dict(self, *a, **kw):
+        return self._layers.set_state_dict(*a, **kw)
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """Reference distributed/spawn.py: one process per device.  On TPU one
+    process drives every local chip, so spawn runs func in THIS process
+    with the parallel env initialized (nprocs>1 across hosts is the
+    launcher's job)."""
+    init_parallel_env()
+    result = func(*args)
+    return result

@@ -1,0 +1,129 @@
+"""Parallel environment: mesh construction + process bootstrap.
+
+Role parity: reference comm bootstrap — c_gen_nccl_id's TCP id-exchange +
+c_comm_init's ring setup (operators/collective/) and the Gloo rendezvous
+in fleet RoleMaker (role_maker.py:172).  TPU-native: one process per
+HOST drives all its local chips; `jax.distributed.initialize` is the
+rendezvous (coordinator address from the launcher's env), and a
+`jax.sharding.Mesh` over all devices replaces every ring.  Collectives
+ride ICI within a slice and DCN across hosts, scheduled by XLA.
+
+Env contract (same names the reference launcher exports, SURVEY §2.9):
+  PADDLE_TRAINER_ID        process (host) index
+  PADDLE_TRAINERS_NUM      number of processes
+  PADDLE_COORDINATOR       coordinator ip:port (ours; reference derives it
+                           from PADDLE_TRAINER_ENDPOINTS[0])
+  PADDLE_TRAINER_ENDPOINTS comma list, used as coordinator fallback
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional, Sequence
+
+_mesh = None
+_ring_axes: Dict[int, object] = {}
+
+
+def init_parallel_env(mesh_shape: Optional[Sequence[int]] = None,
+                      axis_names: Sequence[str] = ("dp",)):
+    """Bootstrap multi-process (if env says so) and build the global mesh.
+
+    Single process: mesh over all visible devices.  Multi process: after
+    jax.distributed.initialize, jax.devices() spans all hosts.
+    """
+    import jax
+
+    global _mesh
+    nproc = int(os.environ.get("PADDLE_TRAINERS_NUM", "1") or 1)
+    if nproc > 1 and not _distributed_initialized():
+        coord = os.environ.get("PADDLE_COORDINATOR")
+        if not coord:
+            eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+            coord = eps.split(",")[0] if eps else None
+        rank = int(os.environ.get("PADDLE_TRAINER_ID", "0") or 0)
+        jax.distributed.initialize(coordinator_address=coord,
+                                   num_processes=nproc, process_id=rank)
+
+    devices = jax.devices()
+    if mesh_shape is None:
+        mesh_shape = [len(devices)]
+        axis_names = tuple(axis_names)[:1] or ("dp",)
+    import numpy as np
+
+    n = int(np.prod(mesh_shape))
+    if n != len(devices):
+        raise ValueError(
+            f"mesh shape {tuple(mesh_shape)} needs {n} devices, "
+            f"have {len(devices)}")
+    dev_array = np.asarray(devices).reshape(mesh_shape)
+    _mesh = jax.sharding.Mesh(dev_array, tuple(axis_names))
+    return _mesh
+
+
+def _distributed_initialized() -> bool:
+    import jax
+
+    try:
+        return jax.process_count() > 1
+    except Exception:
+        return False
+
+
+def get_mesh():
+    return _mesh
+
+
+def set_mesh(mesh, ring_axes: Optional[Dict[int, object]] = None):
+    global _mesh, _ring_axes
+    _mesh = mesh
+    if ring_axes is not None:
+        _ring_axes = dict(ring_axes)
+    return _mesh
+
+
+def reset_mesh():
+    global _mesh, _ring_axes
+    _mesh = None
+    _ring_axes = {}
+
+
+def ring_axes() -> Dict[int, object]:
+    return dict(_ring_axes)
+
+
+def get_world_size() -> int:
+    """Data-parallel world size (reference nranks): size of the dp axis,
+    else the whole mesh, else 1."""
+    if _mesh is None:
+        return 1
+    if "dp" in _mesh.axis_names:
+        return int(_mesh.shape["dp"])
+    return _mesh.size
+
+
+def get_rank() -> int:
+    import jax
+
+    # host-level rank (reference trainer_id is per device; on TPU the
+    # process drives all local devices, so rank == process index)
+    return int(os.environ.get("PADDLE_TRAINER_ID", jax.process_index()))
+
+
+class ParallelEnv:
+    """Reference fluid.dygraph.ParallelEnv parity."""
+
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return max(get_world_size(),
+                   int(os.environ.get("PADDLE_TRAINERS_NUM", "1") or 1))
+
+    @property
+    def device_id(self):
+        return 0
+
+    local_rank = rank
+    nranks = world_size

@@ -62,11 +62,22 @@ def _feed_spec(block, feed: Dict[str, np.ndarray]):
 
 
 class Executor:
-    def __init__(self, place: Optional[Place] = None):
+    def __init__(self, place: Optional[Place] = None, mesh=None):
         self.place = place if place is not None else _default_place()
         self._cache: Dict[tuple, _Compiled] = {}
         # (program fingerprint, feed names, scope id) -> (state_in, state_out)
         self._analysis_cache: Dict[tuple, tuple] = {}
+        self._mesh = mesh  # explicit mesh wins over the global parallel env
+
+    def _active_mesh(self):
+        if self._mesh is not None:
+            return self._mesh
+        try:
+            from ..distributed.parallel_env import get_mesh
+
+            return get_mesh()
+        except ImportError:
+            return None
 
     # ------------------------------------------------------------------
     def run(
@@ -106,6 +117,7 @@ class Executor:
             for n in state_in
         )
 
+        mesh = self._active_mesh()
         key = (
             program.fingerprint(),
             spec,
@@ -113,10 +125,12 @@ class Executor:
             state_spec,
             type(self.place).__name__,
             self.place.device_id,
+            id(mesh),
         )
         entry = self._cache.get(key)
         if entry is None:
-            entry = self._compile(program, spec, state_in, state_out, fetch_names)
+            entry = self._compile(program, spec, state_in, state_out, fetch_names,
+                                  mesh=mesh)
             self._cache[key] = entry
 
         # rng key lives in the scope so runs are deterministic/resumable
@@ -187,7 +201,8 @@ class Executor:
         return tuple(state_in), tuple(state_out)
 
     # ------------------------------------------------------------------
-    def _compile(self, program, feed_spec, state_in, state_out, fetch_names) -> _Compiled:
+    def _compile(self, program, feed_spec, state_in, state_out, fetch_names,
+                 mesh=None) -> _Compiled:
         import jax
 
         feed_names = tuple(n for n, _, _ in feed_spec)
@@ -196,15 +211,9 @@ class Executor:
         state_mut = tuple(n for n in state_in if n in out_set)
         state_const = tuple(n for n in state_in if n not in out_set)
 
-        def fn(feed_vals, mut_vals, const_vals, rng):
-            env = {}
-            for n, v in zip(state_mut, mut_vals):
-                env[n] = v
-            for n, v in zip(state_const, const_vals):
-                env[n] = v
-            for n, v in zip(feed_names, feed_vals):
-                env[n] = v
-            ctx = LoweringContext(block, env, rng_key=rng)
+        def trace_block(env, rng, axis_env=(), ring_axes=None):
+            ctx = LoweringContext(block, env, rng_key=rng, mesh=mesh,
+                                  axis_env=axis_env, ring_axes=ring_axes)
             for op in block.ops:
                 if op.type in PSEUDO_OPS:
                     continue
@@ -218,18 +227,34 @@ class Executor:
             missing = [n for n in fetch_names if n not in env]
             if missing:
                 raise KeyError(f"fetch vars not produced by program: {missing}")
-            fetches = tuple(env[n] for n in fetch_names)
-            new_state = tuple(env[n] for n in state_out)
-            return fetches, new_state, ctx.rng_key
+            return ctx
+
+        if mesh is None:
+            def fn(feed_vals, mut_vals, const_vals, rng):
+                env = {}
+                env.update(zip(state_mut, mut_vals))
+                env.update(zip(state_const, const_vals))
+                env.update(zip(feed_names, feed_vals))
+                ctx = trace_block(env, rng)
+                fetches = tuple(env[n] for n in fetch_names)
+                new_state = tuple(env[n] for n in state_out)
+                return fetches, new_state, ctx.rng_key
+        else:
+            fn = self._build_sharded_fn(
+                program, mesh, feed_spec, feed_names, state_mut, state_const,
+                state_out, fetch_names, trace_block)
 
         # jit traces lazily on first call; donating the mutable state gives
         # in-place parameter-update memory behavior (buffers alias outputs).
         jfn = jax.jit(fn, donate_argnums=(1,))
         device = self.place.jax_device()
 
-        def run_on_device(feed_vals, mut_vals, const_vals, rng):
-            with jax.default_device(device):
-                return jfn(feed_vals, mut_vals, const_vals, rng)
+        if mesh is None:
+            def run_on_device(feed_vals, mut_vals, const_vals, rng):
+                with jax.default_device(device):
+                    return jfn(feed_vals, mut_vals, const_vals, rng)
+        else:
+            run_on_device = jfn  # placement is the mesh's job
 
         compiled = _Compiled(
             fn=run_on_device,
@@ -241,6 +266,106 @@ class Executor:
             uses_rng=True,
         )
         return compiled
+
+    def _build_sharded_fn(self, program, mesh, feed_spec, feed_names, state_mut,
+                          state_const, state_out, fetch_names, trace_block):
+        """SPMD execution over the mesh (reference ParallelExecutor role).
+
+        The whole block runs inside shard_map: feeds are split on their
+        batch dim over the 'dp' axis, state (params/opt accumulators) is
+        replicated, and the program's own c_* collective ops become real
+        XLA collectives.  Fetch semantics match the reference's
+        all-workers view: scalars come back as the cross-replica mean
+        (== full-batch loss for mean losses), batched tensors are
+        re-assembled by all_gather on dim 0.
+        """
+        import jax
+        from jax import lax
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        axis_names = tuple(mesh.axis_names)
+        dp_axis = "dp" if "dp" in axis_names else axis_names[0]
+        dp_size = int(mesh.shape[dp_axis])
+        try:
+            from ..distributed.parallel_env import ring_axes as _ring_axes
+
+            rings = _ring_axes()
+        except ImportError:
+            rings = {}
+
+        feed_in_specs = []
+        sharded_feeds = set()
+        for name, shape, _ in feed_spec:
+            if len(shape) == 0 or shape[0] <= 1:
+                feed_in_specs.append(P())  # scalars/broadcast feeds replicate
+            elif shape[0] % dp_size == 0:
+                feed_in_specs.append(P(dp_axis))
+                sharded_feeds.add(name)
+            else:
+                raise ValueError(
+                    f"feed {name!r} batch dim {shape[0]} is not divisible by "
+                    f"the data-parallel degree {dp_size}; pad the batch or "
+                    f"resize the mesh (silent replication would waste "
+                    f"{dp_size}x compute)")
+        feed_in_specs = tuple(feed_in_specs)
+
+        # static dp-variance analysis: which vars differ across dp shards?
+        # feeds sharded on dp are varying; ops propagate variance from
+        # inputs to outputs; allreduce/broadcast/allgather make values
+        # replica-invariant again.  Drives the fetch re-assembly below.
+        _CLEARING = {"c_allreduce_sum", "c_allreduce_max", "c_allreduce_min",
+                     "c_allreduce_prod", "c_broadcast", "c_allgather",
+                     "allreduce"}
+        varying = set(sharded_feeds)
+        for op in program.global_block.ops:
+            if op.type in PSEUDO_OPS:
+                continue
+            if op.type in _CLEARING:
+                for n in op.output_arg_names():
+                    varying.discard(n)
+                continue
+            if any(n in varying for n in op.input_arg_names()):
+                varying.update(op.output_arg_names())
+
+        def traced(feed_vals, mut_vals, const_vals, rng):
+            env = {}
+            env.update(zip(state_mut, mut_vals))
+            env.update(zip(state_const, const_vals))
+            env.update(zip(feed_names, feed_vals))
+            # per-shard randomness: fold the dp index into the key; the
+            # carried key advances identically on every shard
+            local_rng = jax.random.fold_in(rng, lax.axis_index(dp_axis))
+            ctx = trace_block(env, local_rng, axis_env=axis_names,
+                              ring_axes=rings)
+            new_rng = jax.random.split(rng, 2)[0] if ctx.rng_consumed else rng
+            fetches = []
+            for n in fetch_names:
+                v = env[n]
+                if n not in varying:
+                    fetches.append(v)  # replica-invariant: local copy is it
+                elif getattr(v, "ndim", 0) == 0 or v.size == 1:
+                    # dp-varying scalars (losses, metrics): cross-replica
+                    # mean == the full-batch value for mean-reduced losses
+                    fetches.append(lax.pmean(v, axis_names))
+                else:
+                    # dp-varying batched values: re-assemble the full batch
+                    fetches.append(lax.all_gather(v, dp_axis, axis=0, tiled=True))
+            new_state = tuple(env[n] for n in state_out)
+            return tuple(fetches), new_state, new_rng
+
+        return shard_map(
+            traced,
+            mesh=mesh,
+            in_specs=(feed_in_specs,
+                      tuple(P() for _ in state_mut),
+                      tuple(P() for _ in state_const),
+                      P()),
+            out_specs=(tuple(P() for _ in fetch_names),
+                       tuple(P() for _ in state_out),
+                       P()),
+            check_vma=False,
+        )
 
     def close(self):
         self._cache.clear()

@@ -56,7 +56,8 @@ class LoweringContext:
     program — SURVEY.md §7 'In-place/aliasing').
     """
 
-    def __init__(self, block, env: dict, rng_key=None, mesh=None, axis_env=()):
+    def __init__(self, block, env: dict, rng_key=None, mesh=None, axis_env=(),
+                 ring_axes=None):
         self.block = block
         self.program = block.program
         self.env = env
@@ -64,7 +65,20 @@ class LoweringContext:
         self.mesh = mesh
         # names of spmd axes currently in scope (inside shard_map)
         self.axis_env = tuple(axis_env)
+        # ring_id -> mesh axis name (collective ops; see ops/collective.py)
+        self.ring_axes = dict(ring_axes or {})
         self.rng_consumed = False
+
+    def axis_size(self, axis) -> int:
+        """Static size of a mesh axis (or product over several)."""
+        if self.mesh is None:
+            return 1
+        if isinstance(axis, (tuple, list)):
+            n = 1
+            for a in axis:
+                n *= int(self.mesh.shape[a])
+            return n
+        return int(self.mesh.shape[axis])
 
     # -- values -----------------------------------------------------------
     def get(self, name: str):

@@ -1,0 +1,208 @@
+"""Collective op lowerings: c_* ops -> XLA collectives.
+
+Role parity: reference paddle/fluid/operators/collective/ —
+c_allreduce_{sum,max,min,prod} (c_allreduce_op.h:55/109 -> ncclAllReduce
+:157), c_broadcast, c_allgather, c_reducescatter, c_reduce_*, barrier,
+c_gen_nccl_id / c_comm_init / c_sync_*_stream.
+
+TPU-native redesign (SURVEY.md §5 'Distributed communication backend'):
+there are no comm rings, id exchanges, or stream-sync ops — the mesh IS
+the communicator.  Each op lowers to the matching `jax.lax` collective
+(psum/pmax/pmin/all_gather/psum_scatter/ppermute) INSIDE the compiled
+program; XLA schedules them over ICI/DCN.  When no mesh axis is in scope
+(single device), every collective degenerates to identity, which is also
+the reference's nranks==1 behavior.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..framework.lowering import LoweringContext, register_lower
+
+
+def _axis(ctx: LoweringContext, op):
+    """Resolve the mesh axis (or axes) this op's ring_id maps to.
+
+    Reference ring_id selects an NCCL communicator
+    (collective_helper.h:50); here it selects a mesh axis by convention:
+    ring 0 = the data-parallel axis (all axes named 'dp', else all in
+    scope).  Returns None when no axis is in scope -> identity.
+    """
+    if not ctx.axis_env:
+        return None
+    ring = int(op.attr("ring_id", 0) or 0)
+    mapping = getattr(ctx, "ring_axes", None) or {}
+    if ring in mapping:
+        return mapping[ring]
+    if "dp" in ctx.axis_env:
+        return "dp"
+    return tuple(ctx.axis_env)
+
+
+@register_lower("c_allreduce_sum", "allreduce", "mp_allreduce_sum")
+def _c_allreduce_sum(ctx, op):
+    x = ctx.in1(op, "X")
+    ax = _axis(ctx, op)
+    ctx.set_out(op, "Out", x if ax is None else lax.psum(x, ax))
+
+
+@register_lower("c_allreduce_max")
+def _c_allreduce_max(ctx, op):
+    x = ctx.in1(op, "X")
+    ax = _axis(ctx, op)
+    ctx.set_out(op, "Out", x if ax is None else lax.pmax(x, ax))
+
+
+@register_lower("c_allreduce_min")
+def _c_allreduce_min(ctx, op):
+    x = ctx.in1(op, "X")
+    ax = _axis(ctx, op)
+    ctx.set_out(op, "Out", x if ax is None else lax.pmin(x, ax))
+
+
+@register_lower("c_allreduce_prod")
+def _c_allreduce_prod(ctx, op):
+    x = ctx.in1(op, "X")
+    ax = _axis(ctx, op)
+    if ax is None:
+        ctx.set_out(op, "Out", x)
+        return
+    # no lax.pprod: exp(psum(log)) breaks for negatives; use all_gather+prod
+    g = lax.all_gather(x, ax)
+    ctx.set_out(op, "Out", jnp.prod(g, axis=0))
+
+
+@register_lower("c_broadcast")
+def _c_broadcast(ctx, op):
+    x = ctx.in1(op, "X")
+    ax = _axis(ctx, op)
+    if ax is None:
+        ctx.set_out(op, "Out", x)
+        return
+    root = int(op.attr("root", 0) or 0)
+    idx = lax.axis_index(ax)
+    masked = jnp.where(idx == root, x, jnp.zeros_like(x))
+    ctx.set_out(op, "Out", lax.psum(masked, ax))
+
+
+@register_lower("c_allgather")
+def _c_allgather(ctx, op):
+    x = ctx.in1(op, "X")
+    ax = _axis(ctx, op)
+    if ax is None:
+        ctx.set_out(op, "Out", x)
+        return
+    ctx.set_out(op, "Out", lax.all_gather(x, ax, axis=0, tiled=True))
+
+
+@register_lower("c_reducescatter")
+def _c_reducescatter(ctx, op):
+    x = ctx.in1(op, "X")
+    ax = _axis(ctx, op)
+    if ax is None:
+        ctx.set_out(op, "Out", x)
+        return
+    ctx.set_out(op, "Out", lax.psum_scatter(x, ax, scatter_dimension=0, tiled=True))
+
+
+def _c_reduce(reduce_fn):
+    def rule(ctx, op):
+        x = ctx.in1(op, "X")
+        ax = _axis(ctx, op)
+        if ax is None:
+            ctx.set_out(op, "Out", x)
+            return
+        root = int(op.attr("root_id", op.attr("root", 0)) or 0)
+        red = reduce_fn(x, ax)
+        idx = lax.axis_index(ax)
+        # result lands on root; other ranks keep their input (reference
+        # leaves non-root outputs untouched)
+        ctx.set_out(op, "Out", jnp.where(idx == root, red, x))
+
+    return rule
+
+
+register_lower("c_reduce_sum")(_c_reduce(lax.psum))
+register_lower("c_reduce_max")(_c_reduce(lax.pmax))
+register_lower("c_reduce_min")(_c_reduce(lax.pmin))
+
+
+@register_lower("c_scatter")
+def _c_scatter(ctx, op):
+    x = ctx.in1(op, "X")
+    ax = _axis(ctx, op)
+    if ax is None:
+        ctx.set_out(op, "Out", x)
+        return
+    root = int(op.attr("root", 0) or 0)
+    # root's tensor is [nranks*shard, ...]; every rank takes its slice of
+    # the broadcasted value
+    idx = lax.axis_index(ax)
+    masked = jnp.where(idx == root, x, jnp.zeros_like(x))
+    full = lax.psum(masked, ax)
+    shard = full.shape[0] // int(ctx.axis_size(ax))
+    ctx.set_out(op, "Out", lax.dynamic_slice_in_dim(full, idx * shard, shard, 0))
+
+
+@register_lower("c_concat")
+def _c_concat(ctx, op):
+    x = ctx.in1(op, "X")
+    ax = _axis(ctx, op)
+    out = x if ax is None else lax.all_gather(x, ax, axis=-1, tiled=True)
+    ctx.set_out(op, "Out", out)
+
+
+@register_lower("c_split")
+def _c_split(ctx, op):
+    x = ctx.in1(op, "X")
+    ax = _axis(ctx, op)
+    if ax is None:
+        ctx.set_out(op, "Out", x)
+        return
+    idx = lax.axis_index(ax)
+    shard = x.shape[-1] // int(ctx.axis_size(ax))
+    ctx.set_out(op, "Out", lax.dynamic_slice_in_dim(x, idx * shard, shard, -1))
+
+
+@register_lower("c_identity")
+def _c_identity(ctx, op):
+    ctx.set_out(op, "Out", ctx.in1(op, "X"))
+
+
+@register_lower("barrier")
+def _barrier(ctx, op):
+    # inside one XLA program ordering is data-flow: a barrier is a psum of
+    # a dummy scalar (forces a rendezvous point, like gloo Barrier)
+    x = ctx.in1(op, "X")
+    ax = _axis(ctx, op)
+    if ax is not None:
+        lax.psum(jnp.zeros((), jnp.float32), ax)
+    if x is not None:
+        ctx.set_out(op, "Out", x)
+
+
+# comm-bootstrap ops survive as no-ops: mesh construction replaced them
+@register_lower("c_gen_nccl_id", "c_comm_init", "c_comm_init_all",
+                "c_sync_calc_stream", "c_sync_comm_stream", "c_wait_comm",
+                "c_wait_compute")
+def _c_noop(ctx, op):
+    # pass X through if the op has the in/out slots
+    x = ctx.in1(op, "X")
+    if x is not None:
+        ctx.set_out(op, "Out", x)
+
+
+@register_lower("send_v2", "partial_send")
+def _send_v2(ctx, op):
+    # p2p send: value is moved by the matching recv's ppermute; nothing to
+    # emit here (SPMD: both peers run the same program)
+    pass
+
+
+@register_lower("recv_v2", "partial_recv")
+def _recv_v2(ctx, op):
+    raise NotImplementedError(
+        "p2p recv_v2 lowers via ppermute inside the pipeline executor; "
+        "use paddle_tpu.distributed.pipeline utilities")

@@ -1,0 +1,209 @@
+"""Distributed tests on the 8-virtual-device CPU mesh.
+
+Parity model: reference unittests/test_dist_base.py `TestDistBase`
+(:578/:1007) — the dist run's per-step losses must match the
+single-process run within tolerance — and test_collective_base.py
+(:34/:212) — each c_* op verified numerically.  Multi-node is modeled by
+the 8-device mesh exactly as the reference models it with localhost
+subprocesses (SURVEY §4 lesson).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.distributed as dist
+from paddle_tpu import layers
+from paddle_tpu.framework.program import Program, program_guard
+from paddle_tpu.distributed.parallel_env import init_parallel_env, reset_mesh
+
+
+@pytest.fixture
+def mesh8():
+    mesh = init_parallel_env()
+    yield mesh
+    reset_mesh()
+
+
+def _build_mlp(lr=0.05, use_fleet=False, strategy=None):
+    from paddle_tpu.initializer import ConstantInitializer
+    from paddle_tpu.optimizer import MomentumOptimizer
+    from paddle_tpu.param_attr import ParamAttr
+
+    main, startup = Program(), Program()
+    main.random_seed = 1
+    with program_guard(main, startup):
+        x = layers.data("x", [8])
+        y = layers.data("y", [1])
+        h = layers.fc(x, 16, act="relu", param_attr=ParamAttr(
+            initializer=ConstantInitializer(0.1)), bias_attr=False)
+        pred = layers.fc(h, 1, param_attr=ParamAttr(
+            initializer=ConstantInitializer(0.2)), bias_attr=False)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        opt = MomentumOptimizer(lr, 0.9)
+        if use_fleet:
+            from paddle_tpu.distributed import fleet
+
+            fleet.init(is_collective=True, strategy=strategy)
+            fleet.distributed_optimizer(opt)
+            fleet.minimize(loss)
+        else:
+            opt.minimize(loss)
+    return main, startup, loss
+
+
+def _train(main, startup, loss, X, Y, steps=5, mesh=None):
+    scope = pt.framework.Scope()
+    exe = pt.Executor(pt.CPUPlace(), mesh=mesh)
+    exe.run(startup, scope=scope)
+    return [float(np.asarray(exe.run(main, feed={"x": X, "y": Y},
+                                     fetch_list=[loss], scope=scope)[0]).item())
+            for _ in range(steps)]
+
+
+class TestDistLossParity:
+    def test_dp_matches_single_process(self, mesh8):
+        """The reference's core oracle (test_dist_base.py:1007): dist loss
+        trajectory == local loss trajectory."""
+        rs = np.random.RandomState(0)
+        X = rs.randn(32, 8).astype("f4")
+        Y = rs.randn(32, 1).astype("f4")
+
+        reset_mesh()
+        m, s, l = _build_mlp()
+        base = _train(m, s, l, X, Y)
+
+        mesh = init_parallel_env()
+        m2, s2, l2 = _build_mlp(use_fleet=True)
+        dist_losses = _train(m2, s2, l2, X, Y, mesh=mesh)
+        np.testing.assert_allclose(base, dist_losses, rtol=1e-4, atol=1e-6)
+
+    def test_fleet_world_size(self, mesh8):
+        from paddle_tpu.distributed import fleet
+
+        fleet.init(is_collective=True)
+        assert fleet.worker_num() == 8
+        assert fleet.is_first_worker()
+
+
+class TestCollectiveOps:
+    """Each c_* op verified numerically on the mesh
+    (reference test_collective_base.py pattern)."""
+
+    def _run_collective(self, op_type, x_np, attrs=None, mesh=None):
+        main, startup = Program(), Program()
+        with program_guard(main, startup):
+            x = layers.data("x", list(x_np.shape[1:]))
+            out = main.current_block().create_var(name="out")
+            main.current_block().append_op(op_type, {"X": x.name},
+                                           {"Out": "out"}, attrs or {})
+        scope = pt.framework.Scope()
+        exe = pt.Executor(pt.CPUPlace(), mesh=mesh)
+        return exe.run(main, feed={"x": x_np}, fetch_list=["out"],
+                       scope=scope)[0]
+
+    def test_c_allreduce_sum(self, mesh8):
+        # each shard holds 1 row; psum -> every shard has the column sums;
+        # the result is replica-invariant so the fetch is the local copy
+        # (the reference fetch likewise returns the rank-local tensor)
+        x = np.arange(16, dtype="f4").reshape(8, 2) + 1
+        out = self._run_collective("c_allreduce_sum", x, mesh=mesh8)
+        np.testing.assert_allclose(out, x.sum(0, keepdims=True), rtol=1e-6)
+
+    def test_c_allreduce_max(self, mesh8):
+        x = np.arange(16, dtype="f4").reshape(8, 2)
+        out = self._run_collective("c_allreduce_max", x, mesh=mesh8)
+        np.testing.assert_allclose(out, x.max(0, keepdims=True))
+
+    def test_c_broadcast(self, mesh8):
+        x = np.arange(16, dtype="f4").reshape(8, 2)
+        out = self._run_collective("c_broadcast", x, {"root": 3}, mesh=mesh8)
+        np.testing.assert_allclose(out, x[3:4])
+
+    def test_c_allgather(self, mesh8):
+        x = np.arange(16, dtype="f4").reshape(8, 2)
+        out = self._run_collective("c_allgather", x, mesh=mesh8)
+        # every shard gathers all rows -> the full batch, replica-invariant
+        assert out.shape == (8, 2)
+        np.testing.assert_allclose(out, x)
+
+    def test_c_reducescatter(self, mesh8):
+        # shard r holds X[r*16:(r+1)*16]; psum_scatter gives shard r slice
+        # r of the elementwise sum; the fetch re-gathers -> column sums
+        x = np.arange(128, dtype="f4")
+        out = self._run_collective("c_reducescatter", x, mesh=mesh8)
+        np.testing.assert_allclose(out, x.reshape(8, 16).sum(0), rtol=1e-6)
+
+    def test_identity_without_mesh(self):
+        reset_mesh()
+        x = np.arange(4, dtype="f4").reshape(4, 1)
+        out = self._run_collective("c_allreduce_sum", x, mesh=None)
+        np.testing.assert_allclose(out, x)
+
+
+class TestCollectiveAPI:
+    def test_eager_single_process_semantics(self):
+        t = pt.to_tensor(np.ones(4, dtype="f4"))
+        dist.all_reduce(t)
+        np.testing.assert_allclose(t.numpy(), np.ones(4))
+        assert dist.get_world_size() >= 1
+        assert dist.get_rank() == 0
+        dist.barrier()
+
+
+class TestDistributedStrategy:
+    def test_proto_roundtrip(self, tmp_path):
+        from paddle_tpu.distributed.fleet import DistributedStrategy
+
+        s = DistributedStrategy()
+        s.amp = True
+        s.localsgd = True
+        s.localsgd_configs = {"k_steps": 4}
+        s.amp_configs = {"init_loss_scaling": 1024.0}
+        data = s.serialize_to_string()
+        s2 = DistributedStrategy()
+        s2.parse_from_string(data)
+        assert s2.amp and s2.localsgd
+        assert s2.localsgd_configs["k_steps"] == 4
+        assert s2.amp_configs["init_loss_scaling"] == 1024.0
+
+        p = tmp_path / "strategy.prototxt"
+        s.save_to_prototxt(str(p))
+        s3 = DistributedStrategy()
+        s3.load_from_prototxt(str(p))
+        assert s3.localsgd_configs["k_steps"] == 4
+
+    def test_unknown_config_key_rejected(self):
+        from paddle_tpu.distributed.fleet import DistributedStrategy
+
+        s = DistributedStrategy()
+        with pytest.raises(ValueError):
+            s.localsgd_configs = {"bogus": 1}
+
+
+class TestMetaOptimizers:
+    def test_lamb_swap(self, mesh8):
+        """strategy.lamb=True swaps Adam for LAMB (reference
+        lamb_optimizer.py)."""
+        from paddle_tpu.distributed import fleet
+        from paddle_tpu.optimizer import AdamOptimizer
+
+        main, startup = Program(), Program()
+        with program_guard(main, startup):
+            x = layers.data("x", [4])
+            loss = layers.mean(layers.fc(x, 1))
+            strat = fleet.DistributedStrategy()
+            strat.lamb = True
+            fleet.init(is_collective=True, strategy=strat)
+            fleet.distributed_optimizer(AdamOptimizer(0.01))
+            fleet.minimize(loss)
+        assert any(op.type == "lamb" for op in main.global_block.ops)
+
+    def test_gradallreduce_inserted(self, mesh8):
+        m, s, l = _build_mlp(use_fleet=True)
+        types = [op.type for op in m.global_block.ops]
+        assert "c_allreduce_sum" in types
+        # loss grad scaled by 1/nranks right after its fill_constant seed
+        i_fill = next(i for i, op in enumerate(m.global_block.ops)
+                      if op.type == "fill_constant"
+                      and l.name + "@GRAD" in op.output_arg_names())
+        assert m.global_block.ops[i_fill + 1].type == "scale"
