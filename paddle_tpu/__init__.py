@@ -22,6 +22,13 @@ from .dygraph.tensor import Tensor  # noqa: F401
 from . import tensor  # noqa: F401
 from . import nn  # noqa: F401
 from . import distributed  # noqa: F401
+from . import io  # noqa: F401
+from . import metric  # noqa: F401
+from . import vision  # noqa: F401
+from . import hapi  # noqa: F401
+from .hapi import Model  # noqa: F401
+from .hapi.model import InputSpec  # noqa: F401
+from .hapi import callbacks  # noqa: F401
 from .tensor import (  # noqa: F401
     abs, add, add_n, all, allclose, any, arange, argmax, argmin, argsort,
     assign, bmm, broadcast_to, cast, ceil, chunk, clip, concat, cos, cumsum,

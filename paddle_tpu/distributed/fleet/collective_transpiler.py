@@ -27,9 +27,13 @@ def _grad_param_pairs(block, params_grads=None):
 
 
 class GradAllReduce:
-    def __init__(self, nranks, ring_id=0, fuse_all_reduce=True):
+    def __init__(self, nranks, ring_id=0, fuse_all_reduce=True, fp16=False):
         self.nranks = nranks
         self.ring_id = ring_id
+        # fp16_allreduce strategy: halve allreduce bytes by casting grads
+        # to bf16 around the collective (reference
+        # fp16_allreduce_optimizer.py; bf16 is the TPU-native low-precision)
+        self.fp16 = fp16
 
     def transpile(self, main_program: Program, params_grads=None,
                   loss_grad_name=None):
@@ -56,11 +60,20 @@ class GradAllReduce:
             produced = [g for g in op.output_arg_names() if g in grad_names]
             for g in produced:
                 if self._is_last_def(block, op, g):
+                    from ...framework import dtypes
                     from ...framework.program import Operator
 
+                    if self.fp16:
+                        new_ops.append(Operator(
+                            block, "cast", {"X": [g]}, {"Out": [g]},
+                            {"out_dtype": dtypes.to_enum("bfloat16")}))
                     new_ops.append(Operator(
                         block, "c_allreduce_sum", {"X": [g]}, {"Out": [g]},
                         {"ring_id": self.ring_id, "use_calc_stream": True}))
+                    if self.fp16:
+                        new_ops.append(Operator(
+                            block, "cast", {"X": [g]}, {"Out": [g]},
+                            {"out_dtype": dtypes.to_enum("float32")}))
         block.ops[:] = new_ops
         main_program._bump()  # direct ops[] rewrite: invalidate fingerprint
         return main_program
@@ -89,6 +102,7 @@ class LocalSGD:
     def __init__(self, nranks, k_steps=1, ring_id=0):
         self.nranks, self.k_steps, self.ring_id = nranks, k_steps, ring_id
         self._avg_program = None
+        self._param_names = []
         self._step = 0
 
     def build_average_program(self, main_program: Program) -> Program:
@@ -98,6 +112,7 @@ class LocalSGD:
         block = avg.global_block
         for var in main_program.global_block.vars.values():
             if getattr(var, "is_parameter", False):
+                self._param_names.append(var.name)
                 block.create_var(name=var.name, shape=var.shape,
                                  dtype=var.dtype, persistable=True)
                 block.append_op("c_allreduce_sum", {"X": var.name},
@@ -108,9 +123,29 @@ class LocalSGD:
         return avg
 
     def average_step(self, exe, scope=None):
-        """Call once per train step; averages params every k_steps calls."""
+        """Call once per train step; averages params every k_steps calls.
+
+        Multi-process deployment (one process per host, private params):
+        the average crosses processes via the coordination service.  The
+        Executor invokes this automatically after each main-program run.
+        """
         self._step += 1
-        if self._avg_program is None or self._step % self.k_steps:
+        if self._step % self.k_steps:
             return False
-        exe.run(self._avg_program, scope=scope)
+        import jax
+
+        if jax.process_count() > 1:
+            import numpy as np
+
+            from ...framework.scope import global_scope
+            from jax.experimental import multihost_utils
+
+            scope = scope or global_scope()
+            for name in self._param_names:
+                v = np.asarray(scope.get_var(name))
+                gathered = multihost_utils.process_allgather(v)
+                scope.set_var(name, gathered.mean(axis=0).astype(v.dtype))
+            return True
+        if self._avg_program is not None:
+            exe.run(self._avg_program, scope=scope)
         return True

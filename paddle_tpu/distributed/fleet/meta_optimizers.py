@@ -136,12 +136,24 @@ class LocalSGDMetaOptimizer(MetaOptimizerBase):
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):
+        import jax
+
+        if jax.process_count() == 1 and self._nranks() > 1:
+            # single-process SPMD keeps params replicated across the mesh,
+            # so per-replica divergence between averages cannot exist —
+            # localsgd would silently train on shard 0's data only.
+            raise NotImplementedError(
+                "strategy.localsgd needs per-replica parameter state: run "
+                "one process per host (paddle_tpu.distributed.launch) so "
+                "each process holds its own params, or use "
+                "strategy.gradient_merge for step-K synchronization in the "
+                "single-process SPMD runtime")
         ops, params_grads = self.inner_opt.minimize(
             loss, startup_program, parameter_list, no_grad_set)
         cfg = self.user_strategy.localsgd_configs
         prog = loss.block.program
-        prog._localsgd = LocalSGD(self._nranks(), k_steps=cfg["k_steps"])
-        prog._localsgd_avg_program = prog._localsgd.build_average_program(prog)
+        prog._localsgd = LocalSGD(jax.process_count(), k_steps=cfg["k_steps"])
+        prog._localsgd.build_average_program(prog)
         return ops, params_grads
 
 
@@ -158,9 +170,12 @@ class GraphExecutionMetaOptimizer(MetaOptimizerBase):
                  no_grad_set=None):
         ops, params_grads = self.inner_opt.minimize(
             loss, startup_program, parameter_list, no_grad_set)
-        GradAllReduce(self._nranks()).transpile(
-            loss.block.program, params_grads,
-            loss_grad_name=loss.name + GRAD_SUFFIX)
+        prog = loss.block.program
+        GradAllReduce(
+            self._nranks(),
+            fp16=bool(getattr(prog, "_fp16_allreduce", False)),
+        ).transpile(prog, params_grads,
+                    loss_grad_name=loss.name + GRAD_SUFFIX)
         return ops, params_grads
 
 

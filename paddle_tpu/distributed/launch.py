@@ -93,8 +93,14 @@ def terminate_local_procs(procs):
 def launch(argv=None):
     args = _parse_args(argv)
     ips = [h for h in args.ips.split(",") if h]
-    me = os.environ.get("POD_IP", ips[0])
-    if me not in ips:
+    me = os.environ.get("POD_IP")
+    if len(ips) > 1:
+        if me is None or me not in ips:
+            raise SystemExit(
+                "multi-host launch needs POD_IP set to this host's entry in "
+                f"--ips (got POD_IP={me!r}, ips={ips}); otherwise every host "
+                "would claim node rank 0 and the rendezvous fails")
+    else:
         me = ips[0]
     node_rank = ips.index(me)
     coordinator = f"{ips[0]}:{args.coordinator_port}"

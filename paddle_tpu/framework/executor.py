@@ -151,6 +151,12 @@ class Executor:
         if entry.uses_rng:
             scope.set_var(RNG_VAR, new_rng)
 
+        # localsgd strategy: periodic cross-replica parameter averaging
+        # (set by LocalSGDMetaOptimizer; see fleet/collective_transpiler.py)
+        localsgd = getattr(program, "_localsgd", None)
+        if localsgd is not None:
+            localsgd.average_step(self, scope=scope)
+
         if return_numpy:
             return [np.asarray(v) for v in fetches]
         return list(fetches)

@@ -1,0 +1,10 @@
+"""High-level API (reference python/paddle/hapi/)."""
+from . import callbacks  # noqa: F401
+from .callbacks import (  # noqa: F401
+    Callback,
+    EarlyStopping,
+    LRScheduler,
+    ModelCheckpoint,
+    ProgBarLogger,
+)
+from .model import InputSpec, Model  # noqa: F401

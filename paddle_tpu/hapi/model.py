@@ -1,0 +1,258 @@
+"""`paddle.Model`: the high-level train/eval/predict loop.
+
+Role parity: reference python/paddle/hapi/model.py:819 — prepare:1250,
+fit:1306, evaluate:1516, predict:1617, save/load, train_batch/eval_batch.
+TPU-native: runs the dygraph path (eager ops on the chip); batches
+should keep static shapes so XLA caches compiles (drop_last=True is the
+friendly setting).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..dygraph import no_grad, to_variable
+from ..metric import Metric
+from .callbacks import config_callbacks
+
+
+class InputSpec:
+    """Reference paddle.static.InputSpec parity (shape/dtype/name)."""
+
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.name = name
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List[Metric] = []
+        self.stop_training = False
+
+    # -- setup -----------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        if metrics is None:
+            self._metrics = []
+        else:
+            self._metrics = metrics if isinstance(metrics, (list, tuple)) else [metrics]
+        for m in self._metrics:
+            assert isinstance(m, Metric), "metrics must be paddle.metric.Metric"
+        return self
+
+    # -- single-batch steps ----------------------------------------------
+    def _to_vars(self, data):
+        if isinstance(data, (list, tuple)):
+            return [to_variable(np.asarray(d)) for d in data]
+        return [to_variable(np.asarray(data))]
+
+    def train_batch(self, inputs, labels=None):
+        self.network.train()
+        ins = self._to_vars(inputs)
+        outs = self.network(*ins)
+        outs_list = outs if isinstance(outs, (list, tuple)) else [outs]
+        logs = {}
+        if labels is not None and self._loss is not None:
+            lbs = self._to_vars(labels)
+            loss = self._loss(*outs_list, *lbs)
+            loss.backward()
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+            logs["loss"] = float(np.asarray(loss.numpy()).ravel()[0])
+            for m in self._metrics:
+                _metric_update(m, outs_list[0], lbs[0])
+        return logs
+
+    @no_grad()
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        ins = self._to_vars(inputs)
+        outs = self.network(*ins)
+        outs_list = outs if isinstance(outs, (list, tuple)) else [outs]
+        logs = {}
+        if labels is not None:
+            lbs = self._to_vars(labels)
+            if self._loss is not None:
+                loss = self._loss(*outs_list, *lbs)
+                logs["loss"] = float(np.asarray(loss.numpy()).ravel()[0])
+            for m in self._metrics:
+                _metric_update(m, outs_list[0], lbs[0])
+        return logs
+
+    @no_grad()
+    def predict_batch(self, inputs):
+        self.network.eval()
+        outs = self.network(*self._to_vars(inputs))
+        outs_list = outs if isinstance(outs, (list, tuple)) else [outs]
+        return [np.asarray(o.numpy()) for o in outs_list]
+
+    # -- loops -----------------------------------------------------------
+    def _as_loader(self, data, batch_size, shuffle, drop_last=False):
+        from ..io import DataLoader, Dataset
+
+        if data is None or isinstance(data, DataLoader):
+            return data
+        if isinstance(data, Dataset):
+            return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                              drop_last=drop_last)
+        return data  # any iterable of batches
+
+    @staticmethod
+    def _split_batch(batch):
+        """(x, y) convention: last element is the label."""
+        if isinstance(batch, (list, tuple)) and len(batch) >= 2:
+            return list(batch[:-1]), [batch[-1]]
+        return [batch], None
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None):
+        loader = self._as_loader(train_data, batch_size, shuffle,
+                                 drop_last=drop_last)
+        steps = None
+        try:
+            steps = len(loader)
+        except TypeError:
+            pass
+        cbks = config_callbacks(callbacks, model=self, epochs=epochs,
+                                steps=steps, verbose=verbose,
+                                log_freq=log_freq, save_dir=save_dir,
+                                save_freq=save_freq,
+                                metrics=[n for m in self._metrics
+                                         for n in _as_list(m.name())])
+        self.stop_training = False
+        cbks.on_train_begin()
+        history = {"loss": []}
+        for epoch in range(epochs):
+            cbks.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            for step, batch in enumerate(loader):
+                cbks.on_train_batch_begin(step)
+                xs, ys = self._split_batch(batch)
+                logs = self.train_batch(xs, ys)
+                for m in self._metrics:
+                    for n, v in zip(_as_list(m.name()), _as_list(m.accumulate())):
+                        logs[n] = v
+                cbks.on_train_batch_end(step, logs)
+            history["loss"].append(logs.get("loss"))
+            cbks.on_epoch_end(epoch, logs)
+            if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self.evaluate(eval_data, batch_size=batch_size,
+                                          verbose=0, _callbacks=cbks)
+                for k, v in eval_logs.items():
+                    history.setdefault("eval_" + k, []).append(v)
+            if self.stop_training:
+                break
+        cbks.on_train_end()
+        return history
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, _callbacks=None):
+        loader = self._as_loader(eval_data, batch_size, False)
+        cbks = _callbacks or config_callbacks(callbacks, model=self,
+                                              verbose=verbose)
+        for m in self._metrics:
+            m.reset()
+        cbks.on_eval_begin()
+        logs = {}
+        losses = []
+        for step, batch in enumerate(loader):
+            cbks.on_eval_batch_begin(step)
+            xs, ys = self._split_batch(batch)
+            logs = self.eval_batch(xs, ys)
+            if "loss" in logs:
+                losses.append(logs["loss"])
+            cbks.on_eval_batch_end(step, logs)
+        if losses:
+            logs["loss"] = float(np.mean(losses))
+        for m in self._metrics:
+            for n, v in zip(_as_list(m.name()), _as_list(m.accumulate())):
+                logs[n] = v
+        cbks.on_eval_end(logs)
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,
+                verbose=1, callbacks=None):
+        loader = self._as_loader(test_data, batch_size, False)
+        outputs = []
+        for batch in loader:
+            xs, _ = self._split_batch(batch)
+            outputs.append(self.predict_batch(xs))
+        if stack_outputs and outputs:
+            n_out = len(outputs[0])
+            return [np.concatenate([o[i] for o in outputs]) for i in range(n_out)]
+        return outputs
+
+    # -- persistence -----------------------------------------------------
+    def save(self, path, training=True):
+        """state-dict save (reference Model.save; `training=False` export
+        is the jit.save path, milestone: inference)."""
+        dirname = os.path.dirname(path)
+        if dirname:
+            os.makedirs(dirname, exist_ok=True)
+        sd = {k: np.asarray(v.numpy())
+              for k, v in self.network.state_dict().items()}
+        with open(path + ".pdparams", "wb") as f:
+            pickle.dump(sd, f)
+        if training and self._optimizer is not None \
+                and hasattr(self._optimizer, "state_dict"):
+            od = {k: np.asarray(v) for k, v in self._optimizer.state_dict().items()
+                  if not isinstance(v, dict)}
+            with open(path + ".pdopt", "wb") as f:
+                pickle.dump(od, f)
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        with open(path + ".pdparams", "rb") as f:
+            sd = pickle.load(f)
+        missing, unexpected = self.network.set_state_dict(sd)
+        if not skip_mismatch and (missing or unexpected):
+            raise RuntimeError(
+                f"state dict mismatch: missing={missing}, "
+                f"unexpected={unexpected} (pass skip_mismatch=True to ignore)")
+        if not reset_optimizer and self._optimizer is not None \
+                and os.path.exists(path + ".pdopt"):
+            with open(path + ".pdopt", "rb") as f:
+                od = pickle.load(f)
+            if hasattr(self._optimizer, "set_state_dict"):
+                self._optimizer.set_state_dict(od)
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        lines = [f"Model: {type(self.network).__name__}"]
+        total = 0
+        for name, p in self.network.named_parameters():
+            n = int(np.prod(p.shape))
+            total += n
+            lines.append(f"  {name:40s} {str(p.shape):20s} {n}")
+        lines.append(f"Total params: {total}")
+        s = "\n".join(lines)
+        print(s)
+        return {"total_params": total}
+
+
+def _as_list(x):
+    return x if isinstance(x, (list, tuple)) else [x]
+
+
+def _metric_update(metric, pred, label):
+    """compute() may return one value or a (pred, label)-style tuple; the
+    reference unpacks it into update() (hapi/model.py metric handling)."""
+    res = metric.compute(pred, label)
+    if isinstance(res, tuple):
+        metric.update(*res)
+    else:
+        metric.update(res)

@@ -1,0 +1,313 @@
+"""`paddle.io` equivalent: Dataset / Sampler / DataLoader.
+
+Role parity: reference python/paddle/fluid/reader.py (`DataLoader`:147)
++ fluid/dataloader/ (dataloader_iter.py:262 single-process / :467
+multi-process workers, batch_sampler.py, dataset.py).  TPU-native notes:
+the loader feeds a host-side pipeline; batches should be padded to
+static shapes (XLA recompiles per new shape) — `DataLoader` keeps the
+reference's drop_last/shuffle/collate semantics and adds background
+prefetch so host IO overlaps device compute (the reference's
+buffered_reader double-buffering role).
+"""
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+from typing import Iterable, List, Optional, Sequence
+
+import numpy as np
+
+
+class Dataset:
+    """Map-style dataset (reference fluid/dataloader/dataset.py)."""
+
+    def __getitem__(self, idx):
+        raise NotImplementedError
+
+    def __len__(self):
+        raise NotImplementedError
+
+
+class IterableDataset(Dataset):
+    def __iter__(self):
+        raise NotImplementedError
+
+    def __getitem__(self, idx):
+        raise TypeError("IterableDataset is not subscriptable")
+
+    def __len__(self):
+        raise TypeError("IterableDataset has no len()")
+
+
+class TensorDataset(Dataset):
+    def __init__(self, tensors: Sequence):
+        arrays = [np.asarray(t) if not hasattr(t, "numpy") else t.numpy()
+                  for t in tensors]
+        n = len(arrays[0])
+        assert all(len(a) == n for a in arrays), "tensors must share dim 0"
+        self.tensors = arrays
+
+    def __getitem__(self, idx):
+        return tuple(a[idx] for a in self.tensors)
+
+    def __len__(self):
+        return len(self.tensors[0])
+
+
+class Subset(Dataset):
+    def __init__(self, dataset, indices):
+        self.dataset, self.indices = dataset, list(indices)
+
+    def __getitem__(self, idx):
+        return self.dataset[self.indices[idx]]
+
+    def __len__(self):
+        return len(self.indices)
+
+
+def random_split(dataset, lengths, generator=None):
+    assert sum(lengths) == len(dataset)
+    rng = np.random.RandomState(generator if isinstance(generator, int) else None)
+    perm = rng.permutation(len(dataset))
+    out, ofs = [], 0
+    for ln in lengths:
+        out.append(Subset(dataset, perm[ofs:ofs + ln].tolist()))
+        ofs += ln
+    return out
+
+
+class Sampler:
+    def __init__(self, data_source=None):
+        self.data_source = data_source
+
+    def __iter__(self):
+        raise NotImplementedError
+
+    def __len__(self):
+        return len(self.data_source)
+
+
+class SequenceSampler(Sampler):
+    def __iter__(self):
+        return iter(range(len(self.data_source)))
+
+
+class RandomSampler(Sampler):
+    def __init__(self, data_source, replacement=False, num_samples=None,
+                 generator=None):
+        super().__init__(data_source)
+        self.replacement = replacement
+        self.num_samples = num_samples or len(data_source)
+        self.generator = generator
+
+    def __iter__(self):
+        n = len(self.data_source)
+        rng = np.random.RandomState(
+            self.generator if isinstance(self.generator, int) else None)
+        if self.replacement:
+            return iter(rng.randint(0, n, self.num_samples).tolist())
+        return iter(rng.permutation(n)[:self.num_samples].tolist())
+
+    def __len__(self):
+        return self.num_samples
+
+
+class DistributedBatchSampler(Sampler):
+    """Shards batches across ranks (reference
+    fluid/dataloader/batch_sampler.py DistributedBatchSampler)."""
+
+    def __init__(self, dataset, batch_size, num_replicas=None, rank=None,
+                 shuffle=False, drop_last=False):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        from ..distributed import get_rank, get_world_size
+
+        self.nranks = num_replicas if num_replicas is not None else get_world_size()
+        self.local_rank = rank if rank is not None else get_rank()
+        self.epoch = 0
+        n = len(dataset)
+        import math
+
+        self.num_samples = int(math.ceil(n / self.nranks))
+        self.total_size = self.num_samples * self.nranks
+
+    def set_epoch(self, epoch):
+        self.epoch = epoch
+
+    def __iter__(self):
+        n = len(self.dataset)
+        indices = list(range(n))
+        if self.shuffle:
+            rng = np.random.RandomState(self.epoch)
+            rng.shuffle(indices)
+        indices += indices[: self.total_size - n]
+        local = indices[self.local_rank::self.nranks]
+        batch = []
+        for i in local:
+            batch.append(i)
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+    def __len__(self):
+        import math
+
+        if self.drop_last:
+            return self.num_samples // self.batch_size
+        return int(math.ceil(self.num_samples / self.batch_size))
+
+
+class BatchSampler(Sampler):
+    def __init__(self, dataset=None, sampler=None, shuffle=False,
+                 batch_size=1, drop_last=False):
+        if sampler is None:
+            sampler = (RandomSampler(dataset) if shuffle
+                       else SequenceSampler(dataset))
+        self.sampler = sampler
+        self.batch_size = batch_size
+        self.drop_last = drop_last
+
+    def __iter__(self):
+        batch = []
+        for idx in self.sampler:
+            batch.append(idx)
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+    def __len__(self):
+        n = len(self.sampler)
+        if self.drop_last:
+            return n // self.batch_size
+        return (n + self.batch_size - 1) // self.batch_size
+
+
+def default_collate_fn(batch: List):
+    """Stack samples into batch arrays (reference
+    fluid/dataloader/collate.py)."""
+    sample = batch[0]
+    if isinstance(sample, (list, tuple)):
+        return tuple(default_collate_fn([b[i] for b in batch])
+                     for i in range(len(sample)))
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([b[k] for b in batch]) for k in sample}
+    if hasattr(sample, "numpy"):
+        return np.stack([np.asarray(b.numpy()) for b in batch])
+    arr = np.asarray(batch)
+    if arr.dtype == np.float64:
+        arr = arr.astype(np.float32)
+    return arr
+
+
+class _PrefetchIterator:
+    """Background-thread prefetch (the reference buffered_reader /
+    multiprocess worker role; threads suffice because workers mostly wait
+    on IO and numpy releases the GIL)."""
+
+    _END = object()
+
+    def __init__(self, make_batches, num_workers, prefetch_factor=2):
+        self._q = queue.Queue(maxsize=max(2, num_workers * prefetch_factor))
+        self._exc = None
+        self._thread = threading.Thread(target=self._fill, args=(make_batches,),
+                                        daemon=True)
+        self._thread.start()
+
+    def _fill(self, make_batches):
+        try:
+            for b in make_batches():
+                self._q.put(b)
+        except BaseException as e:  # surfaced on the consumer side
+            self._exc = e
+        finally:
+            self._q.put(self._END)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = self._q.get()
+        if item is self._END:
+            if self._exc is not None:
+                raise self._exc
+            raise StopIteration
+        return item
+
+
+class DataLoader:
+    def __init__(self, dataset, feed_list=None, places=None, return_list=True,
+                 batch_sampler=None, batch_size=1, shuffle=False,
+                 drop_last=False, collate_fn=None, num_workers=0,
+                 use_buffer_reader=True, prefetch_factor=2, use_shared_memory=True,
+                 timeout=0, worker_init_fn=None):
+        self.dataset = dataset
+        self.return_list = return_list
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = num_workers
+        self.prefetch_factor = prefetch_factor
+        self.use_buffer_reader = use_buffer_reader
+        self._iterable_mode = isinstance(dataset, IterableDataset)
+        if self._iterable_mode:
+            self.batch_sampler = None
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+        else:
+            self.batch_sampler = BatchSampler(dataset, shuffle=shuffle,
+                                              batch_size=batch_size,
+                                              drop_last=drop_last)
+
+    def _batches(self):
+        if self._iterable_mode:
+            it = iter(self.dataset)
+            while True:
+                chunk = list(itertools.islice(it, self.batch_size))
+                if not chunk:
+                    return
+                if len(chunk) < self.batch_size and self.drop_last:
+                    return
+                yield self.collate_fn(chunk)
+        elif self.num_workers > 0:
+            # parallel sample fetch: a worker pool maps batches in order
+            # with bounded in-flight batches (the reference's multiprocess
+            # worker role; threads because loading is IO/numpy-bound)
+            from concurrent.futures import ThreadPoolExecutor
+
+            def load(idxs):
+                return self.collate_fn([self.dataset[i] for i in idxs])
+
+            in_flight = []
+            max_in_flight = self.num_workers * self.prefetch_factor
+            with ThreadPoolExecutor(self.num_workers) as pool:
+                for idxs in self.batch_sampler:
+                    in_flight.append(pool.submit(load, idxs))
+                    while len(in_flight) >= max_in_flight:
+                        yield in_flight.pop(0).result()
+                for f in in_flight:
+                    yield f.result()
+        else:
+            for idxs in self.batch_sampler:
+                yield self.collate_fn([self.dataset[i] for i in idxs])
+
+    def __iter__(self):
+        if self.use_buffer_reader:
+            return _PrefetchIterator(self._batches, max(self.num_workers, 1),
+                                     self.prefetch_factor)
+        return self._batches()
+
+    def __len__(self):
+        if self._iterable_mode:
+            raise TypeError("length of an IterableDataset loader is unknown")
+        return len(self.batch_sampler)
+
+
+def get_worker_info():
+    return None  # single-process host pipeline (workers are threads)

@@ -1,0 +1,22 @@
+"""`paddle.vision` equivalent (reference python/paddle/vision/)."""
+from . import datasets, transforms  # noqa: F401
+from . import models  # noqa: F401
+from .datasets import Cifar10, DatasetFolder, FakeData, ImageFolder, MNIST  # noqa: F401
+from .models import (  # noqa: F401
+    LeNet,
+    MobileNetV1,
+    MobileNetV2,
+    ResNet,
+    VGG,
+    mobilenet_v1,
+    mobilenet_v2,
+    resnet18,
+    resnet34,
+    resnet50,
+    resnet101,
+    resnet152,
+    vgg11,
+    vgg13,
+    vgg16,
+    vgg19,
+)

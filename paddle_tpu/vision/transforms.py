@@ -1,0 +1,148 @@
+"""Vision transforms (reference python/paddle/vision/transforms/).
+
+Numpy-based host-side transforms (HWC uint8/float in, CHW float out via
+ToTensor) — the data pipeline runs on host CPU, batches go to the chip.
+"""
+from __future__ import annotations
+
+import numbers
+
+import numpy as np
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def __call__(self, data):
+        for t in self.transforms:
+            data = t(data)
+        return data
+
+
+class BaseTransform:
+    def __call__(self, img):
+        return self._apply_image(img)
+
+
+class ToTensor(BaseTransform):
+    """HWC [0,255] -> CHW float32 [0,1]."""
+
+    def __init__(self, data_format="CHW"):
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        if arr.dtype == np.uint8:
+            arr = arr.astype("float32") / 255.0
+        else:
+            arr = arr.astype("float32")
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        if self.data_format == "CHW":
+            arr = arr.transpose(2, 0, 1)
+        return arr
+
+
+class Normalize(BaseTransform):
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False):
+        # scalars stay scalar so the channel count comes from the image
+        self.mean = (float(mean) if isinstance(mean, numbers.Number)
+                     else np.asarray(mean, dtype="float32"))
+        self.std = (float(std) if isinstance(std, numbers.Number)
+                    else np.asarray(std, dtype="float32"))
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        img = np.asarray(img, dtype="float32")
+        shape = (-1, 1, 1) if self.data_format == "CHW" else (1, 1, -1)
+        mean = (self.mean if isinstance(self.mean, float)
+                else self.mean.reshape(shape))
+        std = (self.std if isinstance(self.std, float)
+               else self.std.reshape(shape))
+        return (img - mean) / std
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation="bilinear"):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        import jax
+
+        arr = np.asarray(img, dtype="float32")
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3) and arr.shape[0] < arr.shape[-1]
+        if chw:
+            out_shape = (arr.shape[0],) + self.size
+        elif arr.ndim == 3:
+            out_shape = self.size + (arr.shape[2],)
+        else:
+            out_shape = self.size
+        method = {"bilinear": "bilinear", "nearest": "nearest",
+                  "bicubic": "cubic"}[self.interpolation]
+        return np.asarray(jax.image.resize(arr, out_shape, method=method))
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        h, w = arr.shape[-3:-1] if arr.ndim == 3 and arr.shape[-1] <= 4 else arr.shape[-2:]
+        th, tw = self.size
+        i, j = max((h - th) // 2, 0), max((w - tw) // 2, 0)
+        if arr.ndim == 3 and arr.shape[-1] <= 4:  # HWC
+            return arr[i:i + th, j:j + tw]
+        if arr.ndim == 3:  # CHW
+            return arr[:, i:i + th, j:j + tw]
+        return arr[i:i + th, j:j + tw]
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if np.random.rand() < self.prob:
+            arr = np.asarray(img)
+            return arr[..., ::-1].copy() if arr.ndim == 3 and arr.shape[0] <= 4 \
+                else arr[:, ::-1].copy() if arr.ndim == 2 else arr[:, ::-1, :].copy()
+        return img
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=0):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.padding = padding
+
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        hwc = arr.ndim == 2 or arr.shape[-1] <= 4
+        if self.padding:
+            p = self.padding
+            pads = ((p, p), (p, p), (0, 0)) if (arr.ndim == 3 and hwc) else \
+                   ((0, 0), (p, p), (p, p)) if arr.ndim == 3 else ((p, p), (p, p))
+            arr = np.pad(arr, pads)
+        h, w = (arr.shape[0], arr.shape[1]) if hwc else (arr.shape[1], arr.shape[2])
+        th, tw = self.size
+        i = np.random.randint(0, h - th + 1)
+        j = np.random.randint(0, w - tw + 1)
+        if arr.ndim == 2:
+            return arr[i:i + th, j:j + tw]
+        if hwc:
+            return arr[i:i + th, j:j + tw]
+        return arr[:, i:i + th, j:j + tw]
+
+
+def to_tensor(img, data_format="CHW"):
+    return ToTensor(data_format)(img)
+
+
+def normalize(img, mean, std, data_format="CHW"):
+    return Normalize(mean, std, data_format)(img)
+
+
+def resize(img, size, interpolation="bilinear"):
+    return Resize(size, interpolation)(img)

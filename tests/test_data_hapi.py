@@ -1,0 +1,228 @@
+"""Data pipeline + high-level API + vision model tests.
+
+Parity model: reference unittests test_dataloader_*.py, test_metrics.py,
+test_model.py, test_vision_models.py.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.io import (
+    BatchSampler, DataLoader, Dataset, DistributedBatchSampler,
+    IterableDataset, RandomSampler, TensorDataset, random_split,
+)
+from paddle_tpu.metric import Accuracy, Auc, Precision, Recall
+from paddle_tpu.vision.datasets import FakeData
+
+
+class TestDataLoader:
+    def test_tensor_dataset_batching(self):
+        X = np.arange(40, dtype="f4").reshape(10, 4)
+        Y = np.arange(10, dtype="int64")
+        ds = TensorDataset([X, Y])
+        loader = DataLoader(ds, batch_size=4, drop_last=True)
+        batches = list(loader)
+        assert len(batches) == 2
+        xb, yb = batches[0]
+        assert xb.shape == (4, 4) and yb.shape == (4,)
+        np.testing.assert_allclose(xb, X[:4])
+
+    def test_shuffle_covers_all(self):
+        ds = TensorDataset([np.arange(16, dtype="f4")])
+        loader = DataLoader(ds, batch_size=4, shuffle=True)
+        seen = np.concatenate([b[0] for b in loader])
+        assert sorted(seen.tolist()) == list(range(16))
+
+    def test_iterable_dataset(self):
+        class Stream(IterableDataset):
+            def __iter__(self):
+                for i in range(10):
+                    yield np.asarray([i], dtype="f4")
+
+        loader = DataLoader(Stream(), batch_size=3, drop_last=False)
+        batches = list(loader)
+        assert [len(b) for b in batches] == [3, 3, 3, 1]
+
+    def test_batch_sampler_and_random_split(self):
+        ds = TensorDataset([np.arange(10, dtype="f4")])
+        bs = BatchSampler(ds, batch_size=3)
+        assert len(bs) == 4
+        a, b = random_split(ds, [7, 3], generator=0)
+        assert len(a) == 7 and len(b) == 3
+
+    def test_distributed_batch_sampler_shards(self):
+        ds = TensorDataset([np.arange(16, dtype="f4")])
+        shards = []
+        for rank in range(4):
+            s = DistributedBatchSampler(ds, batch_size=2, num_replicas=4,
+                                        rank=rank)
+            shards.append([i for batch in s for i in batch])
+        flat = sorted(i for s in shards for i in s)
+        assert flat == list(range(16))
+
+    def test_prefetch_propagates_errors(self):
+        class Bad(Dataset):
+            def __len__(self):
+                return 4
+
+            def __getitem__(self, i):
+                raise RuntimeError("boom")
+
+        with pytest.raises(RuntimeError, match="boom"):
+            list(DataLoader(Bad(), batch_size=2))
+
+    def test_collate_dict(self):
+        class D(Dataset):
+            def __len__(self):
+                return 4
+
+            def __getitem__(self, i):
+                return {"x": np.ones(2, dtype="f4") * i, "y": i}
+
+        batch = next(iter(DataLoader(D(), batch_size=4)))
+        assert batch["x"].shape == (4, 2) and batch["y"].shape == (4,)
+
+
+class TestMetrics:
+    def test_accuracy(self):
+        m = Accuracy()
+        pred = np.asarray([[0.9, 0.1], [0.2, 0.8], [0.6, 0.4]], dtype="f4")
+        label = np.asarray([[0], [1], [1]], dtype="int64")
+        m.update(m.compute(paddle.to_tensor(pred), paddle.to_tensor(label)))
+        assert abs(m.accumulate() - 2 / 3) < 1e-6
+
+    def test_accuracy_topk(self):
+        m = Accuracy(topk=(1, 2))
+        pred = np.asarray([[0.5, 0.3, 0.2], [0.1, 0.2, 0.7]], dtype="f4")
+        label = np.asarray([[1], [1]], dtype="int64")
+        m.update(m.compute(paddle.to_tensor(pred), paddle.to_tensor(label)))
+        top1, top2 = m.accumulate()
+        assert abs(top1 - 0.0) < 1e-6 and abs(top2 - 1.0) < 1e-6
+
+    def test_precision_recall(self):
+        p, r = Precision(), Recall()
+        preds = np.asarray([0.9, 0.8, 0.2, 0.7], dtype="f4")
+        labels = np.asarray([1, 0, 1, 1], dtype="int64")
+        p.update(preds, labels)
+        r.update(preds, labels)
+        assert abs(p.accumulate() - 2 / 3) < 1e-6  # tp=2 fp=1
+        assert abs(r.accumulate() - 2 / 3) < 1e-6  # tp=2 fn=1
+
+    def test_auc_perfect_separation(self):
+        auc = Auc()
+        preds = np.asarray([0.1, 0.2, 0.8, 0.9])
+        labels = np.asarray([0, 0, 1, 1])
+        auc.update(preds, labels)
+        assert abs(auc.accumulate() - 1.0) < 1e-3
+
+
+class MLPNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(16, 32)
+        self.act = nn.ReLU()
+        self.fc2 = nn.Linear(32, 4)
+
+    def forward(self, x):
+        from paddle_tpu.tensor.manipulation import flatten
+
+        return self.fc2(self.act(self.fc1(flatten(x, 1))))
+
+
+class TestHapiModel:
+    def _fake(self, n=64):
+        return FakeData(num_samples=n, image_shape=(1, 4, 4), num_classes=4)
+
+    def test_fit_reduces_loss(self):
+        model = paddle.Model(MLPNet())
+        model.prepare(paddle.optimizer.Adam(0.01, parameters=model.parameters()),
+                      nn.CrossEntropyLoss(),
+                      Accuracy())
+        hist = model.fit(self._fake(), epochs=3, batch_size=16, verbose=0,
+                         shuffle=False)
+        assert hist["loss"][-1] < hist["loss"][0] / 2
+
+    def test_evaluate_and_predict(self):
+        model = paddle.Model(MLPNet())
+        model.prepare(paddle.optimizer.Adam(0.01, parameters=model.parameters()),
+                      nn.CrossEntropyLoss(), Accuracy())
+        model.fit(self._fake(), epochs=2, batch_size=16, verbose=0)
+        logs = model.evaluate(self._fake(32), batch_size=16, verbose=0)
+        assert logs["acc"] > 0.5
+        preds = model.predict(self._fake(32), batch_size=16, stack_outputs=True)
+        assert preds[0].shape == (32, 4)
+
+    def test_save_load_roundtrip(self, tmp_path):
+        model = paddle.Model(MLPNet())
+        model.prepare(paddle.optimizer.Adam(0.01, parameters=model.parameters()),
+                      nn.CrossEntropyLoss())
+        model.fit(self._fake(32), epochs=1, batch_size=16, verbose=0)
+        path = str(tmp_path / "ckpt")
+        model.save(path)
+
+        model2 = paddle.Model(MLPNet())
+        model2.prepare(paddle.optimizer.Adam(0.01, parameters=model2.parameters()),
+                       nn.CrossEntropyLoss())
+        model2.load(path)
+        x = np.random.RandomState(0).randn(4, 1, 4, 4).astype("f4")
+        np.testing.assert_allclose(model.predict_batch([x])[0],
+                                   model2.predict_batch([x])[0], rtol=1e-5)
+
+    def test_early_stopping(self):
+        from paddle_tpu.hapi.callbacks import EarlyStopping
+
+        model = paddle.Model(MLPNet())
+        model.prepare(paddle.optimizer.Adam(0.0, parameters=model.parameters()),
+                      nn.CrossEntropyLoss())
+        es = EarlyStopping(monitor="loss", patience=1, mode="min")
+        hist = model.fit(self._fake(32), eval_data=self._fake(16), epochs=10,
+                         batch_size=16, verbose=0, callbacks=[es])
+        assert len(hist["loss"]) < 10  # stopped early (lr=0 -> no improvement)
+
+
+class TestVisionModels:
+    def test_lenet_forward_backward(self):
+        net = paddle.vision.LeNet()
+        x = paddle.to_tensor(np.random.RandomState(0).randn(2, 1, 28, 28).astype("f4"))
+        out = net(x)
+        assert out.shape == [2, 10]
+        paddle.mean(paddle.square(out)).backward()
+        assert all(p.grad is not None for p in net.parameters())
+
+    def test_resnet18_shapes(self):
+        net = paddle.vision.resnet18(num_classes=7)
+        net.eval()
+        x = paddle.to_tensor(np.random.RandomState(0).randn(2, 3, 64, 64).astype("f4"))
+        assert net(x).shape == [2, 7]
+
+    def test_resnet50_bottleneck(self):
+        net = paddle.vision.resnet50(num_classes=5)
+        net.eval()
+        x = paddle.to_tensor(np.random.RandomState(0).randn(1, 3, 64, 64).astype("f4"))
+        assert net(x).shape == [1, 5]
+
+    def test_mobilenet_v2(self):
+        net = paddle.vision.mobilenet_v2(num_classes=6)
+        net.eval()
+        x = paddle.to_tensor(np.random.RandomState(0).randn(1, 3, 64, 64).astype("f4"))
+        assert net(x).shape == [1, 6]
+
+    def test_vgg11(self):
+        net = paddle.vision.vgg11(num_classes=3)
+        net.eval()
+        x = paddle.to_tensor(np.random.RandomState(0).randn(1, 3, 224, 224).astype("f4"))
+        assert net(x).shape == [1, 3]
+
+    def test_transforms(self):
+        from paddle_tpu.vision.transforms import (
+            Compose, Normalize, Resize, ToTensor,
+        )
+
+        img = (np.random.RandomState(0).rand(28, 28, 3) * 255).astype("uint8")
+        t = Compose([ToTensor(), Normalize([0.5] * 3, [0.5] * 3)])
+        out = t(img)
+        assert out.shape == (3, 28, 28)
+        assert out.min() >= -1.001 and out.max() <= 1.001
+        r = Resize((14, 14))(out)
+        assert r.shape == (3, 14, 14)
