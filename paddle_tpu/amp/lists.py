@@ -1,0 +1,36 @@
+"""AMP op lists (reference fluid/contrib/mixed_precision/fp16_lists.py +
+imperative/amp_auto_cast.cc AmpOperators).
+
+White = compute-bound, run in low precision (MXU ops).  Black = numerically
+sensitive, keep fp32.  Gray = follow their inputs.
+"""
+
+WHITE_LIST = {
+    "conv2d", "depthwise_conv2d", "conv2d_transpose", "matmul", "matmul_v2",
+    "mul", "bmm", "fc",
+}
+
+BLACK_LIST = {
+    "exp", "square", "log", "mean", "sum", "cos_sim", "softmax",
+    "softmax_with_cross_entropy", "sigmoid_cross_entropy_with_logits",
+    "cross_entropy", "cross_entropy2", "log_softmax",
+    "reduce_sum", "reduce_mean", "p_norm", "frobenius_norm",
+    "layer_norm", "batch_norm", "sync_batch_norm", "group_norm",
+    "instance_norm", "update_loss_scaling", "check_finite_and_unscale",
+}
+
+# everything else is gray: it runs in whatever dtype its inputs carry
+
+
+class AutoMixedPrecisionLists:
+    def __init__(self, custom_white_list=None, custom_black_list=None,
+                 custom_black_varnames=None):
+        self.white_list = set(WHITE_LIST)
+        self.black_list = set(BLACK_LIST)
+        self.black_varnames = set(custom_black_varnames or [])
+        if custom_white_list:
+            self.white_list |= set(custom_white_list)
+            self.black_list -= set(custom_white_list)
+        if custom_black_list:
+            self.black_list |= set(custom_black_list)
+            self.white_list -= set(custom_black_list)
