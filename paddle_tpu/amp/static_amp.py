@@ -28,9 +28,11 @@ def _cast_slot(block, op_idx, op, slot, names_to_cast, dest_dtype, cache):
             continue
         key = (name, dest_dtype)
         if key not in cache:
+            # NOT stop_gradient: casts sit on the differentiable path and
+            # must pass gradients through to the fp32 master params
             out = block.create_var(
                 name=unique_name.generate(name + ".cast"),
-                dtype=dest_dtype, stop_gradient=True)
+                dtype=dest_dtype, stop_gradient=False)
             from ..framework.program import Operator
 
             cast_op = Operator(block, "cast", {"X": [name]}, {"Out": [out.name]},
@@ -178,6 +180,8 @@ class OptimizerWithMixedPrecision:
         return self._optimizer.apply_gradients(params_grads)
 
     def __getattr__(self, name):
+        if name == "_optimizer":  # not yet set (unpickling/deepcopy)
+            raise AttributeError(name)
         return getattr(self._optimizer, name)
 
 

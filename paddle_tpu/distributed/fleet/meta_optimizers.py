@@ -39,6 +39,19 @@ class MetaOptimizerBase:
         return self.inner_opt.minimize(loss, startup_program, parameter_list,
                                        no_grad_set)
 
+    # delegation so meta-optimizers compose (a wrapping meta-opt may call
+    # backward/apply_gradients on its inner chain)
+    def backward(self, *args, **kwargs):
+        return self.inner_opt.backward(*args, **kwargs)
+
+    def apply_gradients(self, params_grads):
+        return self.inner_opt.apply_gradients(params_grads)
+
+    def __getattr__(self, name):
+        if name == "inner_opt":  # not yet set (unpickling/deepcopy)
+            raise AttributeError(name)
+        return getattr(self.inner_opt, name)
+
 
 class LarsMetaOptimizer(MetaOptimizerBase):
     """Swap Momentum for LARS (reference lars_optimizer.py)."""
@@ -89,25 +102,217 @@ class LambMetaOptimizer(MetaOptimizerBase):
         return opt.minimize(loss, startup_program, parameter_list, no_grad_set)
 
 
-class RecomputeMetaOptimizer(MetaOptimizerBase):
-    """Activation recompute (reference recompute_optimizer.py).
+class AMPMetaOptimizer(MetaOptimizerBase):
+    """Mixed precision (reference amp_optimizer.py): wrap the inner
+    optimizer with the static AMP decorator — program rewrite inserting
+    bf16/fp16 casts per white/black lists, plus dynamic loss scaling in
+    fp16 mode (amp/static_amp.py)."""
 
-    TPU note: the XLA path's generic grad lowering already re-emits the
-    forward under vjp, so memory-for-compute here means marking segments
-    for jax.checkpoint; wired through program._recompute_checkpoints and
-    honored by the scan-based pipeline executor (milestone: pipeline).
-    """
+    def _can_apply(self):
+        return self.user_strategy.amp
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        from ...amp.lists import AutoMixedPrecisionLists
+        from ...amp.static_amp import decorate
+
+        cfg = self.user_strategy.amp_configs
+        lists = AutoMixedPrecisionLists(
+            custom_white_list=cfg.get("custom_white_list") or None,
+            custom_black_list=cfg.get("custom_black_list") or None,
+            custom_black_varnames=cfg.get("custom_black_varnames") or None)
+        wrapped = decorate(
+            self.inner_opt,
+            amp_lists=lists,
+            init_loss_scaling=float(cfg.get("init_loss_scaling", 2.0 ** 15)),
+            incr_every_n_steps=int(cfg.get("incr_every_n_steps", 1000)),
+            decr_every_n_nan_or_inf=int(cfg.get("decr_every_n_nan_or_inf", 2)),
+            incr_ratio=float(cfg.get("incr_ratio", 2.0)),
+            decr_ratio=float(cfg.get("decr_ratio", 0.5)),
+            use_dynamic_loss_scaling=bool(
+                cfg.get("use_dynamic_loss_scaling", True)),
+            # TPU-native default: bf16, no loss scaling
+            use_bf16=bool(cfg.get("use_bf16", True)))
+        if not wrapped._use_bf16:
+            # fp16 mode drives backward/apply_gradients directly, which
+            # would silently bypass a gradient-merge inner chain
+            o = self.inner_opt
+            while isinstance(o, MetaOptimizerBase):
+                if isinstance(o, GradientMergeMetaOptimizer):
+                    raise NotImplementedError(
+                        "amp (fp16 + loss scaling) composed with "
+                        "gradient_merge is not supported; use bf16 amp "
+                        "(amp_configs={'use_bf16': True}, the TPU default)")
+                o = o.inner_opt
+        return wrapped.minimize(loss, startup_program, parameter_list,
+                                no_grad_set)
+
+
+class RecomputeMetaOptimizer(MetaOptimizerBase):
+    """Activation recompute (reference recompute_optimizer.py +
+    backward.py:689): user-marked checkpoint vars partition the forward;
+    append_backward re-emits each segment behind a `recompute_barrier`
+    (lax.optimization_barrier CSE fence) so XLA recomputes activations in
+    the backward instead of keeping them alive."""
 
     def _can_apply(self):
         return self.user_strategy.recompute
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):
+        ckpts = list(self.user_strategy.recompute_configs.get(
+            "checkpoints", []))
+        if not ckpts:
+            raise ValueError(
+                "strategy.recompute=True needs "
+                "strategy.recompute_configs={'checkpoints': [var_names]}")
         prog = loss.block.program
-        prog._recompute_checkpoints = list(
-            self.user_strategy.recompute_configs.get("checkpoints", []))
+        prog._recompute_checkpoints = ckpts
         return self.inner_opt.minimize(loss, startup_program, parameter_list,
                                        no_grad_set)
+
+
+class GradientMergeMetaOptimizer(MetaOptimizerBase):
+    """Accumulate grads K steps, apply the update on every K-th step
+    (reference GradientMergeOptimizer, fluid/optimizer.py:5025).
+
+    TPU-native: no conditional_block — the update runs every step but is
+    masked: merged_grad = acc * mask (mask = 1 on the K-th step, else 0),
+    and every state var written by the optimizer ops is snapshot before /
+    select-restored after, so momentum/adam state only advances on real
+    update steps.  XLA fuses the selects; there is no control-flow
+    divergence on device."""
+
+    def _can_apply(self):
+        return self.user_strategy.gradient_merge
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        from ...framework.program import default_startup_program
+        from ...initializer import ConstantInitializer
+        from ...framework import unique_name
+
+        cfg = self.user_strategy.gradient_merge_configs
+        k = int(cfg.get("k_steps", 1))
+        avg = bool(cfg.get("avg", True))
+        if k <= 1:
+            return self.inner_opt.minimize(loss, startup_program,
+                                           parameter_list, no_grad_set)
+
+        params_grads = self.inner_opt.backward(
+            loss, startup_program, parameter_list, no_grad_set)
+        block = loss.block.program.global_block
+        startup = startup_program or default_startup_program()
+
+        def persistent(name, shape, value):
+            v = block.create_var(name=name, shape=list(shape),
+                                 dtype="float32", persistable=True,
+                                 stop_gradient=True)
+            sv = startup.global_block.create_var(
+                name=name, shape=list(shape), dtype="float32",
+                persistable=True)
+            ConstantInitializer(value)(sv, startup.global_block)
+            return v
+
+        step = persistent(unique_name.generate("gm_step"), [1], 0.0)
+        block.append_op("increment", {"X": [step.name]},
+                        {"Out": [step.name]}, {"step": 1.0})
+        k_const = block.create_var(name=unique_name.generate("gm_k"),
+                                   shape=[1], dtype="float32",
+                                   stop_gradient=True)
+        block.append_op("fill_constant", {}, {"Out": [k_const.name]},
+                        {"shape": [1], "dtype": "float32", "value": float(k)})
+        cond = block.create_var(name=unique_name.generate("gm_cond"),
+                                shape=[1], dtype="bool", stop_gradient=True)
+        block.append_op("equal", {"X": [step.name], "Y": [k_const.name]},
+                        {"Out": [cond.name]})
+        mask = block.create_var(name=unique_name.generate("gm_mask"),
+                                shape=[1], dtype="float32",
+                                stop_gradient=True)
+        block.append_op("cast", {"X": [cond.name]}, {"Out": [mask.name]},
+                        {"out_dtype": "float32"})
+        # step wraps back to 0 on update steps: step *= (1 - mask)
+        inv = block.create_var(name=unique_name.generate("gm_inv"),
+                               shape=[1], dtype="float32",
+                               stop_gradient=True)
+        block.append_op("scale", {"X": [mask.name]}, {"Out": [inv.name]},
+                        {"scale": -1.0, "bias": 1.0, "bias_after_scale": True})
+        block.append_op("elementwise_mul",
+                        {"X": [step.name], "Y": [inv.name]},
+                        {"Out": [step.name]}, {"axis": -1})
+
+        merged = []
+        acc_names = []
+        for p, g in params_grads:
+            acc = persistent(unique_name.generate(p.name + "_gm_acc"),
+                             p.shape, 0.0)
+            acc_names.append(acc.name)
+            block.append_op("elementwise_add",
+                            {"X": [acc.name], "Y": [g.name]},
+                            {"Out": [acc.name]}, {"axis": -1})
+            mg = block.create_var(name=unique_name.generate(g.name + ".gm"),
+                                  shape=list(p.shape), dtype="float32",
+                                  stop_gradient=True)
+            block.append_op("elementwise_mul",
+                            {"X": [acc.name], "Y": [mask.name]},
+                            {"Out": [mg.name]}, {"axis": -1})
+            if avg:
+                block.append_op("scale", {"X": [mg.name]}, {"Out": [mg.name]},
+                                {"scale": 1.0 / k, "bias": 0.0,
+                                 "bias_after_scale": True})
+            merged.append((p, block.var(mg.name)))
+
+        # optimizer ops run every step on the masked grad; snapshot every
+        # state var they overwrite and select-restore on non-update steps
+        mark = len(block.ops)
+        opt_ops = self.inner_opt.apply_gradients(merged)
+        appended = block.ops[mark:]
+        state_names = []
+        seen = set()
+        for op in appended:
+            for n in op.output_arg_names():
+                if n in seen:
+                    continue
+                var = block._find_var_recursive(n)
+                if var is not None and var.persistable:
+                    seen.add(n)
+                    state_names.append(n)
+        backups = {}
+        insert_at = mark
+        for n in state_names:
+            b = n + ".gm_backup"
+            var = block._find_var_recursive(n)
+            block.create_var(name=b, shape=list(var.shape), dtype=var.dtype,
+                             stop_gradient=True)
+            from ...framework.program import Operator
+
+            bop = Operator(block, "assign", {"X": [n]}, {"Out": [b]})
+            block.ops.insert(insert_at, bop)
+            insert_at += 1
+            backups[n] = b
+        for n, b in backups.items():
+            # n = mask*n_updated + (1-mask)*backup
+            upd = n + ".gm_upd"
+            var = block._find_var_recursive(n)
+            block.create_var(name=upd, shape=list(var.shape),
+                             dtype=var.dtype, stop_gradient=True)
+            block.append_op("elementwise_mul", {"X": [n], "Y": [mask.name]},
+                            {"Out": [upd]}, {"axis": -1})
+            keep = b + ".keep"
+            block.create_var(name=keep, shape=list(var.shape),
+                             dtype=var.dtype, stop_gradient=True)
+            block.append_op("elementwise_mul", {"X": [b], "Y": [inv.name]},
+                            {"Out": [keep]}, {"axis": -1})
+            block.append_op("elementwise_add", {"X": [upd], "Y": [keep]},
+                            {"Out": [n]}, {"axis": -1})
+
+        # accumulators reset after an applied update: acc *= (1 - mask)
+        for acc_name in acc_names:
+            block.append_op("elementwise_mul",
+                            {"X": [acc_name], "Y": [inv.name]},
+                            {"Out": [acc_name]}, {"axis": -1})
+        loss.block.program._bump()
+        return opt_ops, params_grads
 
 
 class FP16AllReduceMetaOptimizer(MetaOptimizerBase):
@@ -182,17 +387,33 @@ class GraphExecutionMetaOptimizer(MetaOptimizerBase):
 META_OPTIMIZERS = [
     LarsMetaOptimizer,
     LambMetaOptimizer,
+    # GradientMerge innermost of the wrappers: it drives backward/apply
+    # directly, so program-rewrite metas (AMP) must run outside it
+    GradientMergeMetaOptimizer,
+    AMPMetaOptimizer,
     RecomputeMetaOptimizer,
     FP16AllReduceMetaOptimizer,
     LocalSGDMetaOptimizer,
     GraphExecutionMetaOptimizer,
 ]
 
+# strategy flags with no implementation yet: refuse loudly rather than
+# silently training without the requested behavior (the reference raises
+# when a meta-optimizer is unavailable too)
+_UNSUPPORTED_FLAGS = ("dgc", "a_sync", "elastic", "tensor_parallel",
+                      "sequence_parallel", "pipeline", "sharding")
+
 
 def compile_strategy(loss, role_maker, inner_opt, strategy):
     """Longest-compatible-chain ordering (reference strategy_compiler.py:89):
     each applicable meta-optimizer wraps the previous; graph-level ones
     (can_be_last) are mutually exclusive — the first applicable wins."""
+    for flag in _UNSUPPORTED_FLAGS:
+        if getattr(strategy, flag, False):
+            raise NotImplementedError(
+                f"DistributedStrategy.{flag} is not implemented in the TPU "
+                f"runtime; unset it (silently ignoring it would train "
+                f"without the requested behavior)")
     chain = inner_opt
     last_used = False
     for cls in META_OPTIMIZERS:

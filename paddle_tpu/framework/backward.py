@@ -170,6 +170,87 @@ def _finalize_out_grads(bctx, pending, op) -> Dict[str, str]:
     return out_grads
 
 
+RECOMPUTE_SUFFIX = "@RECOMPUTE"
+
+# ops whose outputs must NOT be recomputed (re-running them yields different
+# values): keep their stored outputs in the backward instead
+_NONDETERMINISTIC_OPS = {
+    "dropout", "gaussian_random", "uniform_random",
+    "truncated_gaussian_random", "randint", "randperm",
+}
+
+
+def _emit_recompute_segments(bctx, block, fwd_ops, checkpoints, keep_names):
+    """Activation recompute (reference backward.py:689
+    `_append_backward_ops_with_checkpoints_`): re-emit forward ops so the
+    backward reads fresh copies of non-checkpoint activations instead of
+    keeping them alive from the forward pass.
+
+    TPU-native twist: forward+backward are ONE XLA computation, so naive
+    duplication would be CSE'd straight back.  Each checkpoint/param/feed
+    entering a recomputed segment is routed through a `recompute_barrier`
+    op (lowered to lax.optimization_barrier) which blocks CSE — XLA then
+    truly recomputes the segment in the backward and frees the original
+    activations after the forward.
+
+    Returns {activation_name -> recomputed_name} for the grad emission to
+    rename against.
+    """
+    ckpt = set(checkpoints)
+    keep = set(keep_names) | ckpt
+    rc_map: Dict[str, str] = {}
+    barriered: Dict[str, str] = {}
+
+    def barrier(name: str) -> str:
+        if name not in barriered:
+            bname = name + "@RCBAR"
+            bctx.ensure_grad_var(bname, name)
+            bctx.append("recompute_barrier", {"X": [name]}, {"Out": [bname]})
+            barriered[name] = bname
+        return barriered[name]
+
+    for op in fwd_ops:
+        if op.type in _NONDETERMINISTIC_OPS or op.type in NO_GRAD_OPS:
+            continue
+        outs = [n for n in op.output_arg_names() if n and n not in keep]
+        if not outs:
+            continue
+        var_ok = True
+        for n in outs:
+            v = block._find_var_recursive(n)
+            if v is not None and v.persistable:
+                var_ok = False
+        if not var_ok:
+            continue
+        new_inputs = {}
+        for slot, names in op.inputs.items():
+            renamed = []
+            for n in names:
+                if n in rc_map:
+                    renamed.append(rc_map[n])
+                else:
+                    # EVERY external input (checkpoint, param, feed) enters
+                    # through the barrier — otherwise the re-emitted ops
+                    # have byte-identical inputs to the originals and XLA
+                    # CSEs the duplicate away, keeping activations alive
+                    renamed.append(barrier(n))
+            new_inputs[slot] = renamed
+        new_outputs = {}
+        for slot, names in op.outputs.items():
+            renamed = []
+            for n in names:
+                if n and n not in keep:
+                    rn = n + RECOMPUTE_SUFFIX
+                    bctx.ensure_grad_var(rn, n)
+                    rc_map[n] = rn
+                    renamed.append(rn)
+                else:
+                    renamed.append(n)
+            new_outputs[slot] = renamed
+        bctx.append(op.type, new_inputs, new_outputs, dict(op.attrs))
+    return rc_map
+
+
 def append_backward(
     loss: Variable,
     parameter_list=None,
@@ -204,6 +285,15 @@ def append_backward(
     pending: Dict[str, List[str]] = defaultdict(list)
     pending[loss.name].append(loss_grad)
 
+    # activation recompute: re-emit forward segments behind a CSE fence and
+    # point grad ops at the recomputed copies (reference backward.py:689)
+    rc_map: Dict[str, str] = {}
+    if checkpoints:
+        keep = {p.name for p in program.all_parameters()}
+        rc_map = _emit_recompute_segments(
+            bctx, block, fwd_ops, [getattr(c, "name", c) for c in checkpoints],
+            keep)
+
     for op in reversed(fwd_ops):
         if op.type in NO_GRAD_OPS:
             continue
@@ -217,6 +307,14 @@ def append_backward(
         if gop is None:
             continue
         gops = gop if isinstance(gop, (list, tuple)) else [gop]
+        if rc_map:
+            # forward-value slots read the recomputed copies; @GRAD slots
+            # keep original-derived names (the grad graph's own wiring)
+            for g in gops:
+                for slot, names in list(g.inputs.items()):
+                    if slot.endswith(GRAD_SUFFIX):
+                        continue
+                    g.inputs[slot] = [rc_map.get(n, n) for n in names]
         for g in gops:
             # resolve placeholder grad names to (possibly renamed) real ones
             for slot, names in list(g.outputs.items()):

@@ -34,6 +34,44 @@ logger = logging.getLogger(__name__)
 RNG_VAR = "@RNG_KEY@"
 
 
+def _make_scan_fn(step_fn, state_mut, state_const, state_out, feed_names,
+                  scan_steps):
+    """Wrap a single-step `step_fn(env, rng) -> (fetches, new_rng)` into the
+    K-step lax.scan harness shared by the single-device and sharded paths.
+
+    scan_steps=None: feeds are stacked with a leading step dim (scan xs).
+    scan_steps=K: single-step feeds reused every iteration (xs=None).
+    Write-only persistent outputs (not read back each step) are stacked and
+    the last step's value wins.
+    """
+    from jax import lax
+
+    mut_set = set(state_mut)
+    write_only = tuple(n for n in state_out if n not in mut_set)
+
+    def fn(feed_stacks, mut_vals, const_vals, rng):
+        def body(carry, xs):
+            mut, key = carry
+            env = {}
+            env.update(zip(state_mut, mut))
+            env.update(zip(state_const, const_vals))
+            env.update(zip(feed_names, feed_stacks if xs is None else xs))
+            fetches, new_key = step_fn(env, key)
+            wo = tuple(env[n] for n in write_only)
+            new_mut = tuple(env[n] for n in state_mut)
+            return (new_mut, new_key), (fetches, wo)
+
+        xs = None if scan_steps is not None else feed_stacks
+        (final_mut, final_rng), (fetch_stacks, wo_stacks) = lax.scan(
+            body, (mut_vals, rng), xs, length=scan_steps)
+        final = dict(zip(state_mut, final_mut))
+        final.update({n: s[-1] for n, s in zip(write_only, wo_stacks)})
+        new_state = tuple(final[n] for n in state_out)
+        return fetch_stacks, new_state, final_rng
+
+    return fn
+
+
 @dataclass
 class _Compiled:
     fn: object
@@ -50,14 +88,16 @@ def _feed_spec(block, feed: Dict[str, np.ndarray]):
     spec = []
     arrays = {}
     for name in sorted(feed):
-        val = np.asarray(feed[name])
-        var = block._find_var_recursive(name)
-        if var is not None and var.dtype:
-            want = dtypes.to_np(var.dtype)
-            if val.dtype != want:
-                val = val.astype(want)
+        val = feed[name]
+        if not _is_jax_array(val):  # device arrays pass through untouched
+            val = np.asarray(val)
+            var = block._find_var_recursive(name)
+            if var is not None and var.dtype:
+                want = dtypes.to_np(var.dtype)
+                if val.dtype != want:
+                    val = val.astype(want)
         arrays[name] = val
-        spec.append((name, val.shape, str(val.dtype)))
+        spec.append((name, tuple(val.shape), str(val.dtype)))
     return tuple(spec), arrays
 
 
@@ -101,6 +141,107 @@ class Executor:
         block = program.global_block
         spec, feed_arrays = _feed_spec(block, feed)
 
+        fetches = self._dispatch(program, feed, feed_arrays, spec,
+                                 fetch_names, scope, multi_step=False,
+                                 scan_steps=None)
+
+        # localsgd strategy: periodic cross-replica parameter averaging
+        # (set by LocalSGDMetaOptimizer; see fleet/collective_transpiler.py)
+        localsgd = getattr(program, "_localsgd", None)
+        if localsgd is not None:
+            localsgd.average_step(self, scope=scope)
+
+        if return_numpy:
+            return [np.asarray(v) for v in fetches]
+        return list(fetches)
+
+    # ------------------------------------------------------------------
+    def run_steps(
+        self,
+        program: Optional[Program] = None,
+        feed: Optional[Dict[str, np.ndarray]] = None,
+        fetch_list: Optional[Sequence] = None,
+        scope: Optional[Scope] = None,
+        return_numpy: bool = False,
+        steps: Optional[int] = None,
+    ):
+        """Run the program K times in ONE XLA executable call.
+
+        Two feed modes:
+        - ``steps=None``: every feed carries a leading step dimension of
+          equal extent K (one batch per step).
+        - ``steps=K``: feeds are single-step shaped and the SAME batch is
+          reused for all K steps without re-transfer (synthetic-data /
+          warm-cache benchmarking mode).
+
+        The whole block is wrapped in ``lax.scan`` over the step dim, so
+        the K steps run back-to-back on device with zero host round-trips —
+        the TPU-native replacement for the reference's
+        ``train_from_dataset`` C++ loop (executor.cc:166) + buffered_reader
+        double-buffering.  Fetches come back stacked with a leading K dim,
+        as device arrays by default (jax arrays are async: no sync until
+        the caller converts/reads them).
+        """
+        import jax
+
+        program = program if program is not None else default_main_program()
+        feed = dict(feed or {})
+        if not feed:
+            raise ValueError("run_steps requires at least one feed")
+        scope = scope if scope is not None else global_scope()
+        fetch_names = tuple(
+            v.name if isinstance(v, Variable) else str(v) for v in (fetch_list or [])
+        )
+        if getattr(program, "_localsgd", None) is not None:
+            raise NotImplementedError(
+                "run_steps does not support localsgd programs: the periodic "
+                "parameter averaging hook runs between executor calls and "
+                "would be skipped inside the on-device scan; use exe.run")
+        block = program.global_block
+        if steps is None:
+            step_dims = {np.shape(v)[0] for v in feed.values()}
+            if len(step_dims) != 1:
+                raise ValueError(
+                    f"all run_steps feeds must share the same leading step "
+                    f"dim; got {sorted(step_dims)}")
+            if 0 in step_dims:
+                raise ValueError("run_steps needs at least one step")
+            # spec over the per-step shapes (leading dim stripped); device
+            # arrays are sliced lazily — no host transfer
+            per_step_feed = {
+                k: (v[0] if _is_jax_array(v) else np.asarray(v)[0])
+                for k, v in feed.items()
+            }
+            spec, _ = _feed_spec(block, per_step_feed)
+        else:
+            if steps < 1:
+                raise ValueError(f"steps must be >= 1, got {steps}")
+            spec, _ = _feed_spec(block, feed)
+        feed_arrays = {}
+        for name, _, dt in spec:
+            arr = feed[name]
+            if _is_jax_array(arr):  # device arrays pass through untouched
+                feed_arrays[name] = arr
+                continue
+            arr = np.asarray(arr)
+            if str(arr.dtype) != dt:
+                arr = arr.astype(dt)
+            feed_arrays[name] = arr
+
+        fetches = self._dispatch(program, feed, feed_arrays, spec,
+                                 fetch_names, scope, multi_step=True,
+                                 scan_steps=steps)
+        if return_numpy:
+            return [np.asarray(v) for v in fetches]
+        return list(fetches)
+
+    # ------------------------------------------------------------------
+    def _dispatch(self, program, feed, feed_arrays, spec, fetch_names, scope,
+                  multi_step, scan_steps):
+        """Shared run/run_steps tail: state analysis, compile-cache lookup,
+        RNG seeding, the executable call, and scope write-back."""
+        import jax
+
         # state the program will read from the scope (the full op walk is
         # cached; cache hits only re-check that the state vars still exist)
         akey = (program.fingerprint(), frozenset(feed), id(scope))
@@ -119,6 +260,7 @@ class Executor:
 
         mesh = self._active_mesh()
         key = (
+            ("multi_step", scan_steps) if multi_step else None,
             program.fingerprint(),
             spec,
             fetch_names,
@@ -129,8 +271,9 @@ class Executor:
         )
         entry = self._cache.get(key)
         if entry is None:
-            entry = self._compile(program, spec, state_in, state_out, fetch_names,
-                                  mesh=mesh)
+            entry = self._compile(program, spec, state_in, state_out,
+                                  fetch_names, mesh=mesh,
+                                  multi_step=multi_step, scan_steps=scan_steps)
             self._cache[key] = entry
 
         # rng key lives in the scope so runs are deterministic/resumable
@@ -150,16 +293,7 @@ class Executor:
             scope.set_var(n, v)
         if entry.uses_rng:
             scope.set_var(RNG_VAR, new_rng)
-
-        # localsgd strategy: periodic cross-replica parameter averaging
-        # (set by LocalSGDMetaOptimizer; see fleet/collective_transpiler.py)
-        localsgd = getattr(program, "_localsgd", None)
-        if localsgd is not None:
-            localsgd.average_step(self, scope=scope)
-
-        if return_numpy:
-            return [np.asarray(v) for v in fetches]
-        return list(fetches)
+        return fetches
 
     # ------------------------------------------------------------------
     def _analyze_state(self, program: Program, feed_names: set, scope: Scope):
@@ -208,7 +342,7 @@ class Executor:
 
     # ------------------------------------------------------------------
     def _compile(self, program, feed_spec, state_in, state_out, fetch_names,
-                 mesh=None) -> _Compiled:
+                 mesh=None, multi_step=False, scan_steps=None) -> _Compiled:
         import jax
 
         feed_names = tuple(n for n, _, _ in feed_spec)
@@ -235,7 +369,7 @@ class Executor:
                 raise KeyError(f"fetch vars not produced by program: {missing}")
             return ctx
 
-        if mesh is None:
+        if mesh is None and not multi_step:
             def fn(feed_vals, mut_vals, const_vals, rng):
                 env = {}
                 env.update(zip(state_mut, mut_vals))
@@ -245,10 +379,18 @@ class Executor:
                 fetches = tuple(env[n] for n in fetch_names)
                 new_state = tuple(env[n] for n in state_out)
                 return fetches, new_state, ctx.rng_key
+        elif mesh is None and multi_step:
+            def step_fn(env, key):
+                ctx = trace_block(env, key)
+                return tuple(env[n] for n in fetch_names), ctx.rng_key
+
+            fn = _make_scan_fn(step_fn, state_mut, state_const, state_out,
+                               feed_names, scan_steps)
         else:
             fn = self._build_sharded_fn(
                 program, mesh, feed_spec, feed_names, state_mut, state_const,
-                state_out, fetch_names, trace_block)
+                state_out, fetch_names, trace_block, multi_step=multi_step,
+                scan_steps=scan_steps)
 
         # jit traces lazily on first call; donating the mutable state gives
         # in-place parameter-update memory behavior (buffers alias outputs).
@@ -274,7 +416,8 @@ class Executor:
         return compiled
 
     def _build_sharded_fn(self, program, mesh, feed_spec, feed_names, state_mut,
-                          state_const, state_out, fetch_names, trace_block):
+                          state_const, state_out, fetch_names, trace_block,
+                          multi_step=False, scan_steps=None):
         """SPMD execution over the mesh (reference ParallelExecutor role).
 
         The whole block runs inside shard_map: feeds are split on their
@@ -334,11 +477,7 @@ class Executor:
             if any(n in varying for n in op.input_arg_names()):
                 varying.update(op.output_arg_names())
 
-        def traced(feed_vals, mut_vals, const_vals, rng):
-            env = {}
-            env.update(zip(state_mut, mut_vals))
-            env.update(zip(state_const, const_vals))
-            env.update(zip(feed_names, feed_vals))
+        def step_once(env, rng):
             # per-shard randomness: fold the dp index into the key; the
             # carried key advances identically on every shard
             local_rng = jax.random.fold_in(rng, lax.axis_index(dp_axis))
@@ -357,13 +496,39 @@ class Executor:
                 else:
                     # dp-varying batched values: re-assemble the full batch
                     fetches.append(lax.all_gather(v, dp_axis, axis=0, tiled=True))
-            new_state = tuple(env[n] for n in state_out)
-            return tuple(fetches), new_state, new_rng
+            return tuple(fetches), new_rng
+
+        if not multi_step:
+            def traced(feed_vals, mut_vals, const_vals, rng):
+                env = {}
+                env.update(zip(state_mut, mut_vals))
+                env.update(zip(state_const, const_vals))
+                env.update(zip(feed_names, feed_vals))
+                fetches, new_rng = step_once(env, rng)
+                new_state = tuple(env[n] for n in state_out)
+                return fetches, new_state, new_rng
+
+            feed_specs_final = feed_in_specs
+        else:
+            traced = _make_scan_fn(step_once, state_mut, state_const,
+                                   state_out, feed_names, scan_steps)
+
+            if scan_steps is not None:
+                # single-step-shaped feeds reused every iteration: the
+                # batch dim is dim 0, same sharding as the per-step path
+                feed_specs_final = feed_in_specs
+            else:
+                # feeds carry a leading step dim: replicate it, shard the
+                # per-step batch dim (now dim 1) over dp
+                feed_specs_final = tuple(
+                    P(*((None,) + tuple(s))) if s else P()
+                    for s in (tuple(spec) for spec in feed_in_specs)
+                )
 
         return shard_map(
             traced,
             mesh=mesh,
-            in_specs=(feed_in_specs,
+            in_specs=(feed_specs_final,
                       tuple(P() for _ in state_mut),
                       tuple(P() for _ in state_const),
                       P()),

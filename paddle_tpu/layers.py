@@ -305,6 +305,7 @@ def batch_norm(
         [c], dtype=input.dtype_str, name=moving_variance_name, initializer=ConstantInitializer(1.0)
     )
     out = helper.create_variable_for_type_inference(input.dtype_str)
+    out.shape = tuple(input.shape)
     saved_mean = helper.create_variable_for_type_inference(input.dtype_str, stop_gradient=True)
     saved_var = helper.create_variable_for_type_inference(input.dtype_str, stop_gradient=True)
     helper.append_op(

@@ -206,3 +206,26 @@ def _recv_v2(ctx, op):
     raise NotImplementedError(
         "p2p recv_v2 lowers via ppermute inside the pipeline executor; "
         "use paddle_tpu.distributed.pipeline utilities")
+
+
+@register_lower("c_shard_slice")
+def _c_shard_slice(ctx, op):
+    """ZeRO-1 helper (sharding meta-optimizer): this rank's dim-0 shard of
+    a replicated tensor.  Reference ShardingOptimizer assigns whole params
+    to ranks (sharding_optimizer.py:33); the TPU-native form slices every
+    param/grad evenly so the optimizer update runs on 1/nranks of the
+    elements per device.  Identity when no mesh axis is in scope."""
+    x = ctx.in1(op, "X")
+    ax = _axis(ctx, op)
+    if ax is None:
+        ctx.set_out(op, "Out", x)
+        return
+    n = int(ctx.axis_size(ax))
+    if x.shape[0] % n:
+        raise ValueError(
+            f"c_shard_slice: dim 0 ({x.shape[0]}) not divisible by the "
+            f"{n}-way mesh axis {ax!r}; the sharding transpiler must leave "
+            f"this tensor replicated")
+    idx = lax.axis_index(ax)
+    shard = x.shape[0] // n
+    ctx.set_out(op, "Out", lax.dynamic_slice_in_dim(x, idx * shard, shard, 0))

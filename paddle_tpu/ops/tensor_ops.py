@@ -465,3 +465,13 @@ def _roll(ctx, op):
         ctx.set_out(op, "Out", jnp.roll(x, shifts, axes))
     else:
         ctx.set_out(op, "Out", jnp.roll(x.reshape(-1), shifts[0]).reshape(x.shape))
+
+
+@register_lower("recompute_barrier")
+def _recompute_barrier(ctx, op):
+    """CSE fence for activation recompute (framework/backward.py
+    _emit_recompute_segments): identity through lax.optimization_barrier so
+    XLA cannot common-subexpression the re-emitted forward segment with the
+    original and keep the activations alive."""
+    x = ctx.in1(op, "X")
+    ctx.set_out(op, "Out", jax.lax.optimization_barrier(x))

@@ -112,7 +112,9 @@ class Optimizer:
     # -- pipeline --------------------------------------------------------
     def backward(self, loss, startup_program=None, parameter_list=None, no_grad_set=None):
         plist = parameter_list or self._parameter_list
-        return append_backward(loss, parameter_list=plist, no_grad_set=no_grad_set)
+        ckpts = getattr(loss.block.program, "_recompute_checkpoints", None)
+        return append_backward(loss, parameter_list=plist,
+                               no_grad_set=no_grad_set, checkpoints=ckpts)
 
     def apply_gradients(self, params_grads):
         params_grads = self._apply_regularization(params_grads)

@@ -44,6 +44,11 @@ def _fresh_programs():
     prog_mod._main_program = old_main
     prog_mod._startup_program = old_startup
     scope_mod._global_scope = old_scope
+    # fleet.init installs a global mesh; leaking it into the next test
+    # makes plain Executors run SPMD on non-transpiled programs
+    from paddle_tpu.distributed.parallel_env import reset_mesh
+
+    reset_mesh()
 
 
 @pytest.fixture
