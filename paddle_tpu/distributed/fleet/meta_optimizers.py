@@ -362,6 +362,188 @@ class LocalSGDMetaOptimizer(MetaOptimizerBase):
         return ops, params_grads
 
 
+_OPTIMIZER_OP_TYPES = {
+    "sgd", "momentum", "adam", "adamw", "adamax", "adagrad", "adadelta",
+    "rmsprop", "ftrl", "lamb", "lars_momentum", "dgc_momentum", "dpsgd",
+}
+
+
+class ShardingMetaOptimizer(MetaOptimizerBase):
+    """ZeRO-1 optimizer-state sharding (reference
+    fleet/meta_optimizers/sharding_optimizer.py:33).
+
+    TPU-native form: instead of assigning whole params to ranks and
+    broadcasting (reference _split_program/_add_broadcast_allreduce), every
+    param/grad with dim0 divisible by the dp degree is sliced evenly —
+    each rank updates its 1/nranks shard with its shard of the (allreduced)
+    grad, optimizer accumulators live sharded over the mesh (in/out specs
+    P('dp') in the SPMD executor), and `c_allgather` re-assembles the
+    updated param for the next forward.  Memory for optimizer state drops
+    ~linearly with the dp degree."""
+
+    can_be_last = True  # replaces the plain DP transpile
+
+    def _can_apply(self):
+        return self.user_strategy.sharding and self._nranks() > 1
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        o = self.inner_opt
+        while isinstance(o, MetaOptimizerBase):
+            if isinstance(o, GradientMergeMetaOptimizer):
+                raise NotImplementedError(
+                    "sharding composed with gradient_merge is not "
+                    "supported yet: the merge accumulators are full-shape "
+                    "while sharded updates consume grad shards")
+            o = o.inner_opt
+        ops, params_grads = self.inner_opt.minimize(
+            loss, startup_program, parameter_list, no_grad_set)
+        prog = loss.block.program
+        n = self._nranks()
+        sharded_params = self._sharded_param_set(prog, params_grads, n)
+        if not sharded_params:
+            raise ValueError(
+                "strategy.sharding=True but no parameter has dim0 divisible "
+                f"by the dp degree {n}; sharding would be a no-op")
+        self._transpile_grads(prog, params_grads, sharded_params,
+                              loss.name + GRAD_SUFFIX)
+        self._shard_optimizer_ops(prog, n, sharded_params)
+        return ops, params_grads
+
+    def _sharded_param_set(self, prog, params_grads, nranks):
+        block = prog.global_block
+        out = set()
+        for p, _ in params_grads:
+            pvar = block._find_var_recursive(
+                p.name if hasattr(p, "name") else p)
+            if pvar is not None and pvar.shape \
+                    and int(pvar.shape[0]) % nranks == 0:
+                out.add(pvar.name)
+        return out
+
+    def _transpile_grads(self, prog, params_grads, sharded_params,
+                         loss_grad_name):
+        """ZeRO-1 grad comm: `c_reducescatter` for sharded params (each
+        rank receives only its grad shard — half the volume of
+        allreduce+slice), plain `c_allreduce_sum` for params left
+        replicated.  Loss-grad 1/nranks scaling as in GradAllReduce."""
+        from ...framework import dtypes
+        from ...framework.program import Operator
+
+        n = self._nranks()
+        fp16 = bool(getattr(prog, "_fp16_allreduce", False))
+        block = prog.global_block
+        grad_to_param = {}
+        for p, g in params_grads:
+            grad_to_param[g.name if hasattr(g, "name") else g] = (
+                p.name if hasattr(p, "name") else p)
+
+        new_ops = []
+        for op in block.ops:
+            new_ops.append(op)
+            if loss_grad_name in op.output_arg_names() \
+                    and op.type == "fill_constant":
+                new_ops.append(Operator(
+                    block, "scale", {"X": [loss_grad_name]},
+                    {"Out": [loss_grad_name]},
+                    {"scale": 1.0 / n, "bias": 0.0,
+                     "bias_after_scale": True}))
+            for g in op.output_arg_names():
+                pname = grad_to_param.get(g)
+                if pname is None or not GradAllReduce._is_last_def(
+                        block, op, g):
+                    continue
+                comm_in = g
+                if fp16:
+                    new_ops.append(Operator(
+                        block, "cast", {"X": [g]}, {"Out": [g]},
+                        {"out_dtype": dtypes.to_enum("bfloat16")}))
+                if pname in sharded_params:
+                    gvar = block._find_var_recursive(g)
+                    g_shard = g + "@SHARD"
+                    if not block.has_var(g_shard):
+                        shape = list(gvar.shape) if gvar is not None else []
+                        if shape:
+                            shape[0] = int(shape[0]) // n
+                        block.create_var(name=g_shard, shape=shape,
+                                         dtype=(gvar.dtype if gvar else
+                                                "float32"),
+                                         stop_gradient=True)
+                    new_ops.append(Operator(
+                        block, "c_reducescatter", {"X": [comm_in]},
+                        {"Out": [g_shard]}, {"ring_id": 0}))
+                    if fp16:
+                        new_ops.append(Operator(
+                            block, "cast", {"X": [g_shard]},
+                            {"Out": [g_shard]},
+                            {"out_dtype": dtypes.to_enum("float32")}))
+                else:
+                    new_ops.append(Operator(
+                        block, "c_allreduce_sum", {"X": [comm_in]},
+                        {"Out": [g]}, {"ring_id": 0}))
+                    if fp16:
+                        new_ops.append(Operator(
+                            block, "cast", {"X": [g]}, {"Out": [g]},
+                            {"out_dtype": dtypes.to_enum("float32")}))
+        block.ops[:] = new_ops
+        prog._bump()
+
+    def _shard_optimizer_ops(self, prog, nranks, sharded_params):
+        from ...framework.program import Operator
+
+        block = prog.global_block
+        new_ops = []
+        for op in block.ops:
+            if op.type not in _OPTIMIZER_OP_TYPES:
+                new_ops.append(op)
+                continue
+            pnames = op.inputs.get("Param", [])
+            gnames = op.inputs.get("Grad", [])
+            if len(pnames) != 1 or len(gnames) != 1 \
+                    or pnames[0] not in sharded_params:
+                new_ops.append(op)
+                continue
+            pname, gname = pnames[0], gnames[0]
+            pvar = block._find_var_recursive(pname)
+            shard_shape = [int(pvar.shape[0]) // nranks] + [
+                int(s) for s in pvar.shape[1:]]
+            p_shard = pname + "@SHARD"
+            g_shard = gname + "@SHARD"
+            if not block.has_var(p_shard):
+                block.create_var(name=p_shard, shape=shard_shape,
+                                 dtype=pvar.dtype, stop_gradient=True)
+            new_ops.append(Operator(block, "c_shard_slice",
+                                    {"X": [pname]}, {"Out": [p_shard]}, {}))
+            # rewire the update to run on the local shard; accumulators
+            # (same shape as the param, read & written) become sharded
+            # state, recorded ON the op so the program is self-describing
+            # (survives clone/proto round-trips, unlike a python attr)
+            outs_set = set(op.output_arg_names())
+            sharded_accs = []
+            for slot, names in list(op.inputs.items()):
+                if slot == "Param":
+                    op.inputs[slot] = [p_shard]
+                elif slot == "Grad":
+                    op.inputs[slot] = [g_shard]
+                else:
+                    for nm in names:
+                        v = block._find_var_recursive(nm)
+                        if (v is not None and v.persistable
+                                and tuple(v.shape) == tuple(pvar.shape)
+                                and nm in outs_set):
+                            sharded_accs.append(nm)
+            for slot, names in list(op.outputs.items()):
+                op.outputs[slot] = [p_shard if nm == pname else nm
+                                    for nm in names]
+            op.attrs["__sharded_accumulators__"] = sharded_accs
+            new_ops.append(op)
+            new_ops.append(Operator(block, "c_allgather",
+                                    {"X": [p_shard]}, {"Out": [pname]},
+                                    {"ring_id": 0}))
+        block.ops[:] = new_ops
+        prog._bump()
+
+
 class GraphExecutionMetaOptimizer(MetaOptimizerBase):
     """The default collective DP transpile (reference
     graph_execution_optimizer.py:92 + transpiler/collective.py:244)."""
@@ -394,6 +576,7 @@ META_OPTIMIZERS = [
     RecomputeMetaOptimizer,
     FP16AllReduceMetaOptimizer,
     LocalSGDMetaOptimizer,
+    ShardingMetaOptimizer,  # graph-level; wins over plain DP when set
     GraphExecutionMetaOptimizer,
 ]
 
@@ -401,7 +584,7 @@ META_OPTIMIZERS = [
 # silently training without the requested behavior (the reference raises
 # when a meta-optimizer is unavailable too)
 _UNSUPPORTED_FLAGS = ("dgc", "a_sync", "elastic", "tensor_parallel",
-                      "sequence_parallel", "pipeline", "sharding")
+                      "sequence_parallel", "pipeline")
 
 
 def compile_strategy(loss, role_maker, inner_opt, strategy):
@@ -416,6 +599,7 @@ def compile_strategy(loss, role_maker, inner_opt, strategy):
                 f"without the requested behavior)")
     chain = inner_opt
     last_used = False
+    applied = set()
     for cls in META_OPTIMIZERS:
         mo = cls(chain)
         mo._set_basic_info(loss, role_maker, inner_opt, strategy)
@@ -425,5 +609,13 @@ def compile_strategy(loss, role_maker, inner_opt, strategy):
             if last_used:
                 continue
             last_used = True
+        applied.add(cls)
         chain = mo
+    if strategy.sharding and ShardingMetaOptimizer not in applied:
+        # don't silently train without the requested memory behavior
+        reason = ("it conflicts with strategy.localsgd (both are graph-"
+                  "level)" if LocalSGDMetaOptimizer in applied
+                  else "it needs a data-parallel degree > 1")
+        raise ValueError(
+            f"strategy.sharding=True could not be applied: {reason}")
     return chain

@@ -33,6 +33,11 @@ logger = logging.getLogger(__name__)
 
 RNG_VAR = "@RNG_KEY@"
 
+# ops executed host-side by an interpretive walk (file I/O cannot live
+# inside a compiled XLA computation); reference runs these through the
+# same C++ executor hot loop (save_op.cc:85, load_op.cc:67)
+HOST_OPS = {"save", "load", "save_combine", "load_combine"}
+
 
 def _make_scan_fn(step_fn, state_mut, state_const, state_out, feed_names,
                   scan_steps):
@@ -139,6 +144,14 @@ class Executor:
         )
 
         block = program.global_block
+
+        # host-side I/O programs (save/load ops write files; reference
+        # save_op.cc:85/load_op.cc:67 run through the executor the same
+        # way) are interpreted on host, never compiled
+        if any(op.type in HOST_OPS for op in block.ops):
+            return self._run_host_ops(program, scope, fetch_names,
+                                      return_numpy)
+
         spec, feed_arrays = _feed_spec(block, feed)
 
         fetches = self._dispatch(program, feed, feed_arrays, spec,
@@ -294,6 +307,49 @@ class Executor:
         if entry.uses_rng:
             scope.set_var(RNG_VAR, new_rng)
         return fetches
+
+    # ------------------------------------------------------------------
+    def _run_host_ops(self, program, scope, fetch_names, return_numpy):
+        """Interpret a host I/O block (save/load programs).  Mixed
+        compute+io blocks are rejected: build a separate save program as
+        the reference's io.py does."""
+        from . import var_io
+
+        block = program.global_block
+        for op in block.ops:
+            if op.type in PSEUDO_OPS:
+                continue
+            if op.type not in HOST_OPS:
+                raise NotImplementedError(
+                    f"op {op.type!r} cannot run in a host I/O program; "
+                    f"save/load programs must contain only save/load ops "
+                    f"(build them via fluid.io helpers)")
+            if op.type == "save":
+                name = op.inputs["X"][0]
+                var_io.save_var(np.asarray(scope.get_var(name)),
+                                op.attr("file_path"))
+            elif op.type == "load":
+                name = op.outputs["Out"][0]
+                scope.set_var(name, var_io.load_var(op.attr("file_path")))
+            elif op.type == "save_combine":
+                names = list(op.inputs["X"])
+                var_io.save_combine(
+                    {n: np.asarray(scope.get_var(n)) for n in names},
+                    names, op.attr("file_path"))
+            elif op.type == "load_combine":
+                names = list(op.outputs["Out"])
+                loaded = var_io.load_combine(op.attr("file_path"))
+                missing = [n for n in names if n not in loaded]
+                if missing:
+                    raise KeyError(
+                        f"load_combine: vars {missing} not present in "
+                        f"{op.attr('file_path')!r}")
+                for n in names:
+                    scope.set_var(n, loaded[n])
+        if fetch_names:
+            vals = [scope.get_var(n) for n in fetch_names]
+            return [np.asarray(v) for v in vals] if return_numpy else vals
+        return []
 
     # ------------------------------------------------------------------
     def _analyze_state(self, program: Program, feed_names: set, scope: Scope):
@@ -466,13 +522,24 @@ class Executor:
         _CLEARING = {"c_allreduce_sum", "c_allreduce_max", "c_allreduce_min",
                      "c_allreduce_prod", "c_broadcast", "c_allgather",
                      "allreduce"}
-        varying = set(sharded_feeds)
+        # ZeRO-1 sharded optimizer state lives split over the dp axis;
+        # recorded as __sharded_accumulators__ attrs on the rewired
+        # optimizer ops so it survives clone/proto round-trips
+        sharded_state = set()
+        for op in program.global_block.ops:
+            accs = op.attr("__sharded_accumulators__", None)
+            if accs:
+                sharded_state.update(accs)
+        varying = set(sharded_feeds) | sharded_state
         for op in program.global_block.ops:
             if op.type in PSEUDO_OPS:
                 continue
             if op.type in _CLEARING:
                 for n in op.output_arg_names():
                     varying.discard(n)
+                continue
+            if op.type == "c_shard_slice":
+                varying.update(op.output_arg_names())
                 continue
             if any(n in varying for n in op.input_arg_names()):
                 varying.update(op.output_arg_names())
@@ -525,15 +592,18 @@ class Executor:
                     for s in (tuple(spec) for spec in feed_in_specs)
                 )
 
+        def state_spec(n):
+            return P(dp_axis) if n in sharded_state else P()
+
         return shard_map(
             traced,
             mesh=mesh,
             in_specs=(feed_specs_final,
-                      tuple(P() for _ in state_mut),
-                      tuple(P() for _ in state_const),
+                      tuple(state_spec(n) for n in state_mut),
+                      tuple(state_spec(n) for n in state_const),
                       P()),
             out_specs=(tuple(P() for _ in fetch_names),
-                       tuple(P() for _ in state_out),
+                       tuple(state_spec(n) for n in state_out),
                        P()),
             check_vma=False,
         )
