@@ -24,7 +24,7 @@ from ..framework.backward import append_backward, calc_gradient  # noqa: F401
 from ..layers import data  # noqa: F401
 from ..param_attr import ParamAttr, WeightNormParamAttr  # noqa: F401
 
-# fluid.io arrives with the checkpoint milestone; fluid.dygraph with dygraph.
+from . import io  # noqa: F401
 
 
 def scope_guard(scope):

@@ -1,0 +1,64 @@
+"""Top-level paddle.save / paddle.load.
+
+Role parity: reference python/paddle/framework/io.py save:177/load:361 —
+pickle-based container for state_dicts / tensors / nested structures,
+plus Program protos.  Layer/optimizer ``state_dict()`` round-trips are
+the primary contract (train -> save -> new process -> load -> resume).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+_MAGIC = b"PTPUPKL1"
+
+
+def _to_host(obj):
+    """Device arrays / eager tensors -> numpy, recursively."""
+    if isinstance(obj, dict):
+        return {k: _to_host(v) for k, v in obj.items()}
+    if isinstance(obj, tuple) and hasattr(obj, "_fields"):  # namedtuple
+        return type(obj)(*(_to_host(v) for v in obj))
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_to_host(v) for v in obj)
+    if hasattr(obj, "numpy") and callable(obj.numpy):  # eager Tensor
+        return np.asarray(obj.numpy())
+    if hasattr(obj, "sharding") and hasattr(obj, "dtype"):  # jax array
+        return np.asarray(obj)
+    return obj
+
+
+def save(obj, path: str, protocol: int = 4):
+    from .framework.program import Program
+
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    if isinstance(obj, Program):
+        # program protos are self-describing; reference save(Program) writes
+        # the desc too
+        with open(path, "wb") as f:
+            f.write(b"PTPUPROG")
+            f.write(obj.serialize_to_string())
+        return
+    with open(path, "wb") as f:
+        f.write(_MAGIC)
+        pickle.dump(_to_host(obj), f, protocol=protocol)
+
+
+def load(path: str):
+    from .framework.program import Program
+
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"paddle.load: no such file {path!r}")
+    with open(path, "rb") as f:
+        magic = f.read(8)
+        if magic == b"PTPUPROG":
+            return Program.parse_from_string(f.read())
+        if magic != _MAGIC:
+            raise ValueError(
+                f"{path!r} was not written by paddle_tpu.save "
+                f"(bad magic {magic!r})")
+        return pickle.load(f)

@@ -1,0 +1,248 @@
+"""Static-graph checkpointing + inference export + predictor.
+
+Parity model: reference io.py save/load_persistables (:620/:994) via
+save/load ops (save_op.cc:85), save_inference_model:1198 /
+load_inference_model:1424, AnalysisPredictor (analysis_predictor.h:82),
+paddle.save/load (framework/io.py).  Oracle: train -> save -> fresh scope
+(and a real fresh process) -> load -> resume produces identical losses.
+"""
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.fluid import io as fluid_io
+from paddle_tpu.framework import unique_name
+from paddle_tpu.framework.program import Program, program_guard
+from paddle_tpu.optimizer import MomentumOptimizer
+
+
+def _build():
+    from paddle_tpu.initializer import ConstantInitializer
+    from paddle_tpu.param_attr import ParamAttr
+
+    main, startup = Program(), Program()
+    main.random_seed = 3
+    with unique_name.guard(), program_guard(main, startup):
+        x = layers.data("x", [4])
+        y = layers.data("y", [1])
+        h = layers.fc(x, 8, act="relu", param_attr=ParamAttr(
+            initializer=ConstantInitializer(0.3)))
+        pred = layers.fc(h, 1)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        MomentumOptimizer(0.05, 0.9).minimize(loss)
+    return main, startup, loss, pred
+
+
+def _data():
+    rng = np.random.RandomState(7)
+    X = rng.randn(16, 4).astype("f4")
+    Y = (X @ rng.randn(4, 1) * 0.5).astype("f4")
+    return X, Y
+
+
+def _step(exe, main, loss, X, Y, scope):
+    return float(np.asarray(exe.run(
+        main, feed={"x": X, "y": Y}, fetch_list=[loss],
+        scope=scope)[0]).item())
+
+
+@pytest.mark.parametrize("filename", [None, "all_params"])
+def test_save_load_persistables_resume_parity(tmp_path, filename):
+    X, Y = _data()
+    ckpt = str(tmp_path / "ckpt")
+
+    main, startup, loss, _ = _build()
+    sc = pt.framework.Scope()
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup, scope=sc)
+    for _ in range(3):
+        _step(exe, main, loss, X, Y, sc)
+    from paddle_tpu.framework.scope import _switch_scope
+
+    old = _switch_scope(sc)
+    try:
+        fluid_io.save_persistables(exe, ckpt, main, filename=filename)
+    finally:
+        _switch_scope(old)
+    expected = [_step(exe, main, loss, X, Y, sc) for _ in range(3)]
+
+    # fresh scope + fresh executor: load and resume
+    sc2 = pt.framework.Scope()
+    exe2 = pt.Executor(pt.CPUPlace())
+    exe2.run(startup, scope=sc2)
+    old = _switch_scope(sc2)
+    try:
+        fluid_io.load_persistables(exe2, ckpt, main, filename=filename)
+    finally:
+        _switch_scope(old)
+    got = [_step(exe2, main, loss, X, Y, sc2) for _ in range(3)]
+    np.testing.assert_allclose(expected, got, rtol=1e-6, atol=1e-7)
+
+
+def test_resume_in_fresh_process(tmp_path):
+    """The reference oracle is a literally-new process (auto-checkpoint
+    resume, executor.py:1200)."""
+    script = textwrap.dedent("""
+        import sys
+        import numpy as np
+        sys.path.insert(0, {repo!r})
+        sys.path.insert(0, {tests!r})
+        import conftest  # forces cpu backend + 8 virtual devices
+        import paddle_tpu as pt
+        from paddle_tpu.fluid import io as fluid_io
+        from paddle_tpu.framework.scope import _switch_scope
+        from test_checkpoint_io import _build, _data, _step
+
+        phase = sys.argv[1]
+        ckpt = sys.argv[2]
+        X, Y = _data()
+        main, startup, loss, _ = _build()
+        sc = pt.framework.Scope()
+        exe = pt.Executor(pt.CPUPlace())
+        exe.run(startup, scope=sc)
+        old = _switch_scope(sc)
+        if phase == "train":
+            _switch_scope(old)
+            for _ in range(3):
+                _step(exe, main, loss, X, Y, sc)
+            old = _switch_scope(sc)
+            fluid_io.save_persistables(exe, ckpt, main)
+            _switch_scope(old)
+        else:
+            fluid_io.load_persistables(exe, ckpt, main)
+            _switch_scope(old)
+        out = [_step(exe, main, loss, X, Y, sc) for _ in range(3)]
+        print("LOSSES:" + ",".join(f"{v:.9f}" for v in out))
+    """)
+    script = script.replace(
+        "{repo!r}",
+        repr(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+    script = script.replace(
+        "{tests!r}", repr(os.path.dirname(os.path.abspath(__file__))))
+    ckpt = str(tmp_path / "ckpt")
+
+    def run(phase):
+        r = subprocess.run([sys.executable, "-c", script, phase, ckpt],
+                           capture_output=True, text=True, timeout=300)
+        assert r.returncode == 0, r.stderr
+        line = [l for l in r.stdout.splitlines()
+                if l.startswith("LOSSES:")][0]
+        return [float(v) for v in line[len("LOSSES:"):].split(",")]
+
+    first = run("train")
+    second = run("resume")
+    np.testing.assert_allclose(first, second, rtol=1e-6, atol=1e-7)
+
+
+def test_save_inference_model_and_predictor(tmp_path):
+    X, Y = _data()
+    model_dir = str(tmp_path / "infer_model")
+
+    main, startup, loss, pred = _build()
+    sc = pt.framework.Scope()
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup, scope=sc)
+    for _ in range(3):
+        _step(exe, main, loss, X, Y, sc)
+
+    from paddle_tpu.framework.scope import _switch_scope
+
+    old = _switch_scope(sc)
+    try:
+        fluid_io.save_inference_model(model_dir, ["x"], [pred], exe, main)
+    finally:
+        _switch_scope(old)
+    assert os.path.exists(os.path.join(model_dir, "__model__"))
+
+    # independent numpy oracle from the params as saved
+    w1 = np.asarray(sc.get_var("fc_0.w_0"))
+    b1 = np.asarray(sc.get_var("fc_0.b_0"))
+    w2 = np.asarray(sc.get_var("fc_1.w_0"))
+    b2 = np.asarray(sc.get_var("fc_1.b_0"))
+    direct = np.maximum(X @ w1 + b1, 0) @ w2 + b2
+
+    # low-level load path
+    exe2 = pt.Executor(pt.CPUPlace())
+    prog2, feeds, targets = fluid_io.load_inference_model(model_dir, exe2)
+    assert feeds == ["x"]
+    out = np.asarray(exe2.run(prog2, feed={"x": X},
+                              fetch_list=targets)[0])
+    np.testing.assert_allclose(direct, out, rtol=1e-5, atol=1e-6)
+    # pruning removed the label branch and the optimizer
+    assert all(op.type not in ("momentum", "sgd")
+               for op in prog2.global_block.ops)
+
+    # export carries only the serving surface: no optimizer state
+    exported = set(os.listdir(model_dir))
+    assert not any("velocity" in n or "learning_rate" in n
+                   for n in exported), exported
+
+    # predictor (compile-once serve path); must not clobber global scope
+    from paddle_tpu.framework.scope import global_scope
+    from paddle_tpu.inference import Config, create_predictor
+
+    global_scope().set_var("fc_0.w_0", np.float32(123.0))
+    predictor = create_predictor(Config(model_dir))
+    assert float(np.asarray(global_scope().get_var("fc_0.w_0"))) == 123.0
+    assert predictor.get_input_names() == ["x"]
+    out2 = np.asarray(predictor.run({"x": X})[0])
+    np.testing.assert_allclose(direct, out2, rtol=1e-5, atol=1e-6)
+    with pytest.raises(KeyError):
+        predictor.run({"not_x": X})
+
+
+import collections
+
+Rec = collections.namedtuple("Rec", ["a", "b"])
+
+
+def test_paddle_save_namedtuple(tmp_path):
+    path = str(tmp_path / "rec.bin")
+    pt.save(Rec(a=np.ones(3, "f4"), b=2.0), path)
+    loaded = pt.load(path)
+    np.testing.assert_allclose(loaded.a, np.ones(3))
+    assert loaded.b == 2.0
+
+
+def test_paddle_save_load_state_dict(tmp_path):
+    path = str(tmp_path / "model.pdparams")
+    from paddle_tpu import nn
+
+    with pt.dygraph.guard():
+        layer = nn.Linear(4, 2)
+        sd = layer.state_dict()
+        pt.save(sd, path)
+        loaded = pt.load(path)
+        assert set(loaded) == set(sd)
+        for k in sd:
+            np.testing.assert_allclose(np.asarray(sd[k].numpy()),
+                                       loaded[k], rtol=1e-7)
+        layer2 = nn.Linear(4, 2)
+        layer2.set_state_dict(loaded)
+        x = pt.to_tensor(np.ones((3, 4), "f4"))
+        np.testing.assert_allclose(layer(x).numpy(), layer2(x).numpy(),
+                                   rtol=1e-6)
+
+
+def test_paddle_save_load_program(tmp_path):
+    path = str(tmp_path / "prog.pdmodel")
+    main, _, loss, _ = _build()
+    pt.save(main, path)
+    loaded = pt.load(path)
+    assert [op.type for op in loaded.global_block.ops] == \
+        [op.type for op in main.global_block.ops]
+
+
+def test_load_errors(tmp_path):
+    bad = tmp_path / "bad.bin"
+    bad.write_bytes(b"NOTMAGIC" + b"x" * 16)
+    with pytest.raises(ValueError, match="magic"):
+        pt.load(str(bad))
+    with pytest.raises(FileNotFoundError):
+        pt.load(str(tmp_path / "missing.bin"))
