@@ -544,6 +544,36 @@ class ShardingMetaOptimizer(MetaOptimizerBase):
         prog._bump()
 
 
+class PipelineMetaOptimizer(MetaOptimizerBase):
+    """GPipe pipeline parallelism (reference
+    fleet/meta_optimizers/pipeline_optimizer.py:90 + fluid
+    PipelineOptimizer optimizer.py:3695).  Wraps the inner optimizer with
+    paddle_tpu.optimizer.PipelineOptimizer; the program must be built with
+    device_guard('stage:N') annotations and executed over a mesh with a
+    'pp' axis (distributed/pipeline.py)."""
+
+    can_be_last = True  # graph-level: replaces the plain DP transpile
+
+    def _can_apply(self):
+        return self.user_strategy.pipeline
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        from ..parallel_env import get_mesh
+        from ...optimizer.pipeline_opt import PipelineOptimizer
+
+        mesh = get_mesh()
+        if mesh is not None and "pp" not in mesh.axis_names:
+            raise ValueError(
+                "strategy.pipeline needs a mesh with a 'pp' axis; build it "
+                "with init_parallel_env(axis_names=('pp',)) or "
+                "set_mesh(Mesh(devs, ('pp',)))")
+        cfg = self.user_strategy.pipeline_configs
+        k = int(cfg.get("micro_batch", 1))
+        return PipelineOptimizer(self.inner_opt, num_microbatches=k).minimize(
+            loss, startup_program, parameter_list, no_grad_set)
+
+
 class GraphExecutionMetaOptimizer(MetaOptimizerBase):
     """The default collective DP transpile (reference
     graph_execution_optimizer.py:92 + transpiler/collective.py:244)."""
@@ -576,6 +606,7 @@ META_OPTIMIZERS = [
     RecomputeMetaOptimizer,
     FP16AllReduceMetaOptimizer,
     LocalSGDMetaOptimizer,
+    PipelineMetaOptimizer,  # graph-level; wins over plain DP when set
     ShardingMetaOptimizer,  # graph-level; wins over plain DP when set
     GraphExecutionMetaOptimizer,
 ]
@@ -584,7 +615,7 @@ META_OPTIMIZERS = [
 # silently training without the requested behavior (the reference raises
 # when a meta-optimizer is unavailable too)
 _UNSUPPORTED_FLAGS = ("dgc", "a_sync", "elastic", "tensor_parallel",
-                      "sequence_parallel", "pipeline")
+                      "sequence_parallel")
 
 
 def compile_strategy(loss, role_maker, inner_opt, strategy):
@@ -611,11 +642,20 @@ def compile_strategy(loss, role_maker, inner_opt, strategy):
             last_used = True
         applied.add(cls)
         chain = mo
-    if strategy.sharding and ShardingMetaOptimizer not in applied:
-        # don't silently train without the requested memory behavior
-        reason = ("it conflicts with strategy.localsgd (both are graph-"
-                  "level)" if LocalSGDMetaOptimizer in applied
-                  else "it needs a data-parallel degree > 1")
-        raise ValueError(
-            f"strategy.sharding=True could not be applied: {reason}")
+    # graph-level strategies must not be silently dropped when another
+    # graph-level meta-optimizer won the can_be_last slot
+    graph_level = {"localsgd": LocalSGDMetaOptimizer,
+                   "pipeline": PipelineMetaOptimizer,
+                   "sharding": ShardingMetaOptimizer}
+    winner = next((name for name, cls in graph_level.items()
+                   if cls in applied), None)
+    for name, cls in graph_level.items():
+        if getattr(strategy, name, False) and cls not in applied:
+            if winner is not None:
+                reason = (f"it conflicts with strategy.{winner} (both are "
+                          f"graph-level; only one can transpile the program)")
+            else:
+                reason = "it needs a data-parallel degree > 1"
+            raise ValueError(
+                f"strategy.{name}=True could not be applied: {reason}")
     return chain

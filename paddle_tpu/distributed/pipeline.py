@@ -1,0 +1,310 @@
+"""Pipeline parallelism: GPipe schedule over a 'pp' mesh axis.
+
+Role parity: reference fluid.optimizer.PipelineOptimizer
+(optimizer.py:3695) + PipelineTrainer/SectionWorker
+(framework/pipeline_trainer.cc:24, section_worker.cc:82): the program is
+split into per-device sections by `device_guard("stage:N")` annotations;
+micro-batches flow stage to stage.
+
+TPU-native redesign (SURVEY.md §2.8): no section threads or blocking
+queues — the whole schedule compiles into ONE XLA program executed SPMD
+over the 'pp' mesh axis.  Every rank runs the same code; `lax.switch` on
+`axis_index('pp')` selects the local stage, `lax.ppermute` moves boundary
+activations (forward) and their cotangents (backward) between neighbor
+ranks, and each stage's backward is `jax.vjp` of its traced forward.
+GPipe flush schedule: K micro-batch forwards fill the pipe, then K
+backwards drain it; per-stage gradients are psum'd over the axis and feed
+the program's own optimizer ops, so parameters stay replicated and every
+rank applies the identical update (memory-sharded stage params are a
+later milestone; correctness parity with the non-pipelined program is
+the v1 contract).
+
+v1 restrictions (loud errors, not silent wrongness):
+- every stage boundary passes exactly ONE activation tensor and all
+  boundaries share one shape/dtype (equal-width trunks — true for
+  transformer stacks; ppermute is SPMD and needs rank-uniform buffers);
+- no RNG ops (dropout) inside staged forwards;
+- the 'pp' axis carries only pipeline parallelism (dp x pp composition
+  is a later milestone).
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+
+def analyze_stages(program, n_stages: int):
+    """Partition forward ops into stages via op_device annotations.
+
+    Untagged ops inherit the previous op's stage (build order), starting
+    at stage 0.  Returns (stage_ops, boundary_vars): boundary_vars[s] is
+    the single activation passed from stage s to s+1.
+    """
+    meta = getattr(program, "_pipeline", None)
+    fwd_end = meta["fwd_end"] if meta else len(program.global_block.ops)
+    ops = [op for op in program.global_block.ops[:fwd_end]
+           if op.type not in ("feed", "fetch")]
+    stage_ops: List[list] = [[] for _ in range(n_stages)]
+    cur = 0
+    for op in ops:
+        dev = op.attr("op_device", None)
+        if dev:
+            if not str(dev).startswith("stage:"):
+                raise ValueError(
+                    f"op_device {dev!r} is not a pipeline annotation; use "
+                    f"device_guard('stage:N')")
+            s = int(str(dev).split(":", 1)[1])
+            if s < cur:
+                raise ValueError(
+                    f"op {op.type!r} tagged stage {s} appears after stage "
+                    f"{cur} ops; stages must be contiguous in build order")
+            if s >= n_stages:
+                raise ValueError(
+                    f"op {op.type!r} tagged stage {s} but the mesh has only "
+                    f"{n_stages} pipeline stages")
+            cur = s
+        stage_ops[cur].append(op)
+
+    boundaries = []
+    for s in range(n_stages - 1):
+        produced_here = {n for op in stage_ops[s]
+                         for n in op.output_arg_names()}
+        consumed = set()
+        for later in range(s + 1, n_stages):
+            for op in stage_ops[later]:
+                for n in op.input_arg_names():
+                    if n in produced_here:
+                        consumed.add(n)
+        act = sorted(consumed)
+        if len(act) != 1:
+            raise ValueError(
+                f"pipeline stage boundary {s}->{s + 1} must pass exactly "
+                f"one activation tensor, found {act or 'none'}; restructure "
+                f"the model so each stage hands one tensor to the next")
+        boundaries.append(act[0])
+    return stage_ops, boundaries
+
+
+def build_pipeline_fn(program, mesh, feed_names, state_mut, state_const,
+                      state_out, fetch_names, loss_name, params_grads,
+                      n_microbatches, bwd_end):
+    """The compiled GPipe train step (plugs into Executor._compile).
+
+    Signature matches the standard sharded path:
+    (feed_vals, mut_vals, const_vals, rng) -> (fetches, new_state, rng).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from ..framework.lowering import (PSEUDO_OPS, LoweringContext,
+                                      get_lowering)
+
+    pp_axis = "pp"
+    if pp_axis not in mesh.axis_names:
+        raise ValueError(
+            f"pipeline execution needs a 'pp' mesh axis; got "
+            f"{mesh.axis_names}")
+    S = int(mesh.shape[pp_axis])
+    K = int(n_microbatches)
+    stage_ops, boundaries = analyze_stages(program, S)
+    block = program.global_block
+    if set(fetch_names) - {loss_name}:
+        raise NotImplementedError(
+            f"pipeline executor fetches the loss only; got {fetch_names}")
+
+    grad_of = {(p if isinstance(p, str) else p.name):
+               (g if isinstance(g, str) else g.name)
+               for p, g in params_grads}
+    opt_ops = [op for op in block.ops[bwd_end:]
+               if op.type not in PSEUDO_OPS]
+
+    # v1: stage forwards run in throwaway per-microbatch envs, so state
+    # they write (batch_norm running stats) would be silently dropped —
+    # reject such programs loudly
+    state_out_set = set(state_out)
+    param_names = set(grad_of)
+    fwd_state_writes = sorted({
+        n for ops in stage_ops for op in ops
+        for n in op.output_arg_names()
+        if n in state_out_set and n not in param_names
+    } - {n for op in opt_ops for n in op.output_arg_names()})
+    if fwd_state_writes:
+        raise NotImplementedError(
+            f"pipeline v1 cannot persist state written inside staged "
+            f"forwards (e.g. batch_norm running stats): {fwd_state_writes}; "
+            f"use use_global_stats/layer_norm, or train non-pipelined")
+
+    def trace_ops(ops, env):
+        ctx = LoweringContext(block, env, rng_key=None, mesh=mesh,
+                              axis_env=(pp_axis,))
+        for op in ops:
+            try:
+                get_lowering(op.type)(ctx, op)
+            except Exception as e:
+                site = op.callstack[-1] if op.callstack else "<unknown>"
+                raise type(e)(
+                    f"while lowering pipeline op {op.type!r} (built at "
+                    f"{site}): {e}") from e
+        return env
+
+    def traced(feed_vals, mut_vals, const_vals, rng):
+        base_env = {}
+        base_env.update(zip(state_mut, mut_vals))
+        base_env.update(zip(state_const, const_vals))
+        full_feeds = dict(zip(feed_names, feed_vals))
+        r = lax.axis_index(pp_axis)
+
+        params = {pname: base_env[pname] for pname in grad_of}
+
+        # micro-batch every feed: (B, ...) -> (K, B//K, ...)
+        mb_feeds = {}
+        for n, v in full_feeds.items():
+            b = v.shape[0]
+            if b % K:
+                raise ValueError(
+                    f"feed {n!r} batch {b} not divisible by micro_batch "
+                    f"count {K}")
+            mb_feeds[n] = v.reshape((K, b // K) + v.shape[1:])
+
+        def stage_fwd(s, prm, act_in, mb_idx):
+            """Uniform output: (boundary_act_or_zeros, loss_or_zero)."""
+            env = dict(base_env)
+            env.update(prm)
+            for n, v in mb_feeds.items():
+                env[n] = lax.dynamic_index_in_dim(v, mb_idx, 0,
+                                                  keepdims=False)
+            if s > 0:
+                env[boundaries[s - 1]] = act_in
+            trace_ops(stage_ops[s], env)
+            if s < S - 1:
+                return (jnp.asarray(env[boundaries[s]]),
+                        jnp.zeros((), jnp.float32))
+            loss = jnp.asarray(env[loss_name], jnp.float32).reshape(())
+            return (jnp.zeros(act_shape, act_dtype), loss)
+
+        # boundary shape (uniformity enforced): probe stage chain
+        mb_structs = {n: jax.ShapeDtypeStruct((v.shape[1],) + v.shape[2:],
+                                              v.dtype)
+                      for n, v in mb_feeds.items()}
+
+        def probe_stage(s, act_sd):
+            def f(act_in):
+                env = {n: jnp.zeros(sd.shape, sd.dtype)
+                       for n, sd in mb_structs.items()}
+                env.update(base_env)
+                env.update(params)
+                # feeds win over state on name clash
+                for n, sd in mb_structs.items():
+                    env[n] = jnp.zeros(sd.shape, sd.dtype)
+                if s > 0:
+                    env[boundaries[s - 1]] = act_in
+                trace_ops(stage_ops[s], env)
+                return jnp.asarray(env[boundaries[s]])
+
+            return jax.eval_shape(
+                f, act_sd if act_sd is not None
+                else jax.ShapeDtypeStruct((), jnp.float32))
+
+        act_sd = None
+        for s in range(S - 1):
+            sd = probe_stage(s, act_sd)
+            if act_sd is not None and (sd.shape, sd.dtype) != \
+                    (act_sd.shape, act_sd.dtype):
+                raise ValueError(
+                    f"pipeline boundary {s} activation "
+                    f"{sd.dtype}{sd.shape} differs from earlier boundary "
+                    f"{act_sd.dtype}{act_sd.shape}; v1 needs uniform "
+                    f"boundary shapes")
+            act_sd = sd
+        act_shape, act_dtype = act_sd.shape, act_sd.dtype
+        zero_act = jnp.zeros(act_shape, act_dtype)
+
+        branches = [
+            (lambda prm, a, i, s=s: stage_fwd(s, prm, a, i))
+            for s in range(S)
+        ]
+
+        def switch_fwd(prm, act_in, mb_idx):
+            return lax.switch(r, branches, prm, act_in, mb_idx)
+
+        fwd_perm = [(i, i + 1) for i in range(S - 1)]
+        bwd_perm = [(i + 1, i) for i in range(S - 1)]
+
+        # ---- forward fill (K + S - 1 ticks) -----------------------------
+        T = K + S - 1
+        saved_in = jnp.zeros((K,) + act_shape, act_dtype)
+        losses = jnp.zeros((K,), jnp.float32)
+        recv = zero_act
+        for t in range(T):
+            mb = jnp.clip(t - r, 0, K - 1)
+            active = jnp.logical_and(t - r >= 0, t - r < K)
+            act_out, loss_mb = switch_fwd(params, recv, mb)
+            # remember this tick's stage INPUT for the backward vjp
+            prev = lax.dynamic_index_in_dim(saved_in, mb, 0, keepdims=False)
+            upd = jnp.where(active, recv, prev)
+            saved_in = lax.dynamic_update_index_in_dim(saved_in, upd, mb, 0)
+            losses = losses.at[mb].set(
+                jnp.where(active, loss_mb, losses[mb]))
+            send = jnp.where(active, act_out, zero_act)
+            recv = lax.ppermute(send, pp_axis, fwd_perm)
+
+        # ---- backward drain (K + S - 1 ticks) ---------------------------
+        def stage_bwd(prm, act_in, mb_idx, g_act, g_loss):
+            def f(prm_, act_in_):
+                return switch_fwd(prm_, act_in_, mb_idx)
+
+            _, vjp = jax.vjp(f, prm, act_in)
+            gp, gact = vjp((g_act, g_loss))
+            return gp, gact
+
+        grad_acc = jax.tree.map(jnp.zeros_like, params)
+        g_recv = zero_act
+        for u in range(T):
+            m = jnp.clip(u - (S - 1 - r), 0, K - 1)
+            active = jnp.logical_and(u - (S - 1 - r) >= 0,
+                                     u - (S - 1 - r) < K)
+            is_last = r == S - 1
+            g_loss = jnp.where(jnp.logical_and(active, is_last),
+                               jnp.float32(1.0 / K), 0.0)
+            g_act = jnp.where(is_last, zero_act, g_recv)
+            act_in = lax.dynamic_index_in_dim(saved_in, m, 0,
+                                              keepdims=False)
+            gp, gact = stage_bwd(params, act_in, m, g_act, g_loss)
+            # where-select, not multiply: an inf/NaN jacobian at a
+            # zero-filled inactive tick must not poison the accumulator
+            grad_acc = jax.tree.map(
+                lambda a, g: a + jnp.where(active, g, jnp.zeros_like(g)),
+                grad_acc, gp)
+            g_send = jnp.where(active, gact, zero_act)
+            g_recv = lax.ppermute(g_send, pp_axis, bwd_perm)
+
+        # grads live on the owning stage's rank; psum replicates them so
+        # every rank applies the identical optimizer update
+        grad_acc = jax.tree.map(lambda g: lax.psum(g, pp_axis), grad_acc)
+
+        env = dict(base_env)
+        for pname, gname in grad_of.items():
+            env[gname] = grad_acc[pname]
+        trace_ops(opt_ops, env)
+
+        # full-batch mean loss, present on the last rank; psum-broadcast
+        loss_sum = jnp.where(r == S - 1, losses.sum(), 0.0)
+        mean_loss = lax.psum(loss_sum, pp_axis) / K
+        fetches = tuple(mean_loss for _ in fetch_names)
+        new_state = tuple(env[n] for n in state_out)
+        return fetches, new_state, rng
+
+    return shard_map(
+        traced,
+        mesh=mesh,
+        in_specs=(tuple(P() for _ in feed_names),
+                  tuple(P() for _ in state_mut),
+                  tuple(P() for _ in state_const),
+                  P()),
+        out_specs=(tuple(P() for _ in fetch_names),
+                   tuple(P() for _ in state_out),
+                   P()),
+        check_vma=False,
+    )

@@ -457,6 +457,15 @@ def _transpose_maker(bctx, op, out_grads):
     )
 
 
+@register_grad_maker("while")
+def _while_maker(bctx, op, out_grads):
+    raise NotImplementedError(
+        "gradients through `while` loops are not supported: XLA/jax has no "
+        "reverse-mode rule for lax.while_loop (unbounded trip count). For "
+        "differentiable recurrences use the lax.scan-backed RNN ops "
+        "(gru/lstm/rnn) or unroll a fixed-length loop")
+
+
 @register_grad_maker("assign", "share_data")
 def _assign_maker(bctx, op, out_grads):
     g = out_grads.get(op.output("Out")[0])

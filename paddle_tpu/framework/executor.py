@@ -365,11 +365,34 @@ class Executor:
         state_out: List[str] = []
         seen_out: set = set()
 
+        def sub_external_reads(block_idx: int) -> List[str]:
+            """Names a sub-block reads from its surroundings (closures for
+            the lax.while_loop/lax.cond lowering): inputs not produced
+            earlier inside the sub-block, plus nested sub-blocks'."""
+            sub = program.blocks[block_idx]
+            local_written: set = set()
+            ext: List[str] = []
+            for sop in sub.ops:
+                for n in sop.input_arg_names():
+                    if n not in local_written and n not in ext:
+                        ext.append(n)
+                for aname in ("sub_block", "sub_block_t", "sub_block_f"):
+                    if sop.has_attr(aname):
+                        for n in sub_external_reads(int(sop.attr(aname))):
+                            if n not in local_written and n not in ext:
+                                ext.append(n)
+                local_written.update(sop.output_arg_names())
+            return ext
+
         def visit_block(block):
             for op in block.ops:
                 if op.type in PSEUDO_OPS:
                     continue
-                for name in op.input_arg_names():
+                reads = list(op.input_arg_names())
+                for aname in ("sub_block", "sub_block_t", "sub_block_f"):
+                    if op.has_attr(aname):
+                        reads.extend(sub_external_reads(int(op.attr(aname))))
+                for name in reads:
                     if name in feed_names or name in written:
                         continue
                     if name not in state_in:
@@ -381,10 +404,6 @@ class Executor:
                                 f"{op.callstack[-1] if op.callstack else '?'})"
                             )
                         state_in.append(name)
-                # sub-blocks (control flow) contribute reads conservatively
-                for aname in ("sub_block", "block"):
-                    if op.has_attr(aname):
-                        pass  # handled by control-flow lowering; vars resolved there
                 for name in op.output_arg_names():
                     written.add(name)
                     var = block._find_var_recursive(name)
@@ -424,6 +443,30 @@ class Executor:
             if missing:
                 raise KeyError(f"fetch vars not produced by program: {missing}")
             return ctx
+
+        pipe = getattr(program, "_pipeline", None)
+        if pipe is not None and mesh is not None \
+                and "pp" in mesh.axis_names:
+            if multi_step:
+                raise NotImplementedError(
+                    "run_steps over the pipeline executor is not supported "
+                    "yet; call run per step")
+            from ..distributed.pipeline import build_pipeline_fn
+
+            fn = build_pipeline_fn(
+                program, mesh, feed_names, state_mut, state_const,
+                state_out, fetch_names, pipe["loss_name"],
+                pipe["params_grads"], pipe["num_microbatches"],
+                pipe["bwd_end"])
+            return _Compiled(
+                fn=jax.jit(fn, donate_argnums=(1,)),
+                feed_names=feed_names,
+                state_mut=state_mut,
+                state_const=state_const,
+                state_out=tuple(state_out),
+                fetch_names=fetch_names,
+                uses_rng=True,
+            )
 
         if mesh is None and not multi_step:
             def fn(feed_vals, mut_vals, const_vals, rng):

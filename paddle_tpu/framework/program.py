@@ -171,6 +171,26 @@ class Parameter(Variable):
 # ---------------------------------------------------------------------------
 
 
+# device_guard annotation stack (reference fluid.device_guard,
+# framework.py device_guard — ops created inside get attr op_device; the
+# pipeline optimizer maps "stage:N" annotations to pipeline stages)
+_device_guard_stack: List[str] = []
+
+
+def device_guard(device: str):
+    import contextlib
+
+    @contextlib.contextmanager
+    def guard():
+        _device_guard_stack.append(device)
+        try:
+            yield
+        finally:
+            _device_guard_stack.pop()
+
+    return guard()
+
+
 class Operator:
     """One op in a block: type + slot->names inputs/outputs + attrs."""
 
@@ -191,6 +211,8 @@ class Operator:
         for k, v in list(self.attrs.items()):
             if isinstance(v, Block):
                 self.attrs[k] = v.idx
+        if _device_guard_stack and "op_device" not in self.attrs:
+            self.attrs["op_device"] = _device_guard_stack[-1]
         self.callstack: List[str] = _capture_callstack()
 
     # -- access -----------------------------------------------------------

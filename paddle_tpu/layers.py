@@ -916,3 +916,172 @@ def shape(input):
     out = helper.create_variable_for_type_inference("int32", stop_gradient=True)
     helper.append_op("shape", {"Input": input}, {"Out": out})
     return out
+
+
+# ---------------------------------------------------------------------------
+# control flow (reference python/paddle/fluid/layers/control_flow.py —
+# While:1020, while_loop:1035, cond:2333; ops lower to lax.while_loop /
+# lax.cond, see ops/control_flow.py)
+# ---------------------------------------------------------------------------
+
+
+def while_loop(cond, body, loop_vars, is_test=False, name=None):
+    """Functional while: loop_vars are updated in place by `body` until
+    `cond` is false.  Carried state is exactly `loop_vars` (+ the
+    condition), recorded on the op for the lax.while_loop lowering."""
+    if not loop_vars:
+        raise ValueError("while_loop requires at least one loop var")
+    prog = default_main_program()
+    parent = prog.current_block()
+
+    pre_cond = cond(*loop_vars)
+    if tuple(getattr(pre_cond, "shape", ())) not in ((), (1,)):
+        raise TypeError(
+            f"while_loop condition must be a scalar, got shape "
+            f"{pre_cond.shape}")
+
+    sub = prog._create_block()
+    out_vars = body(*loop_vars)
+    if not isinstance(out_vars, (list, tuple)):
+        out_vars = [out_vars]
+    if len(out_vars) != len(loop_vars):
+        raise ValueError(
+            f"body returned {len(out_vars)} vars, expected {len(loop_vars)}")
+    for lv, ov in zip(loop_vars, out_vars):
+        if ov.name != lv.name:
+            assign(ov, lv)
+    new_cond = cond(*loop_vars)
+    if new_cond.name != pre_cond.name:
+        assign(new_cond, pre_cond)
+    prog._rollback()
+
+    carried = [pre_cond.name] + [lv.name for lv in loop_vars]
+    parent.append_op(
+        "while",
+        {"X": carried, "Condition": [pre_cond.name]},
+        {"Out": list(carried)},
+        {"sub_block": sub.idx, "is_test": is_test},
+    )
+    return loop_vars
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None):
+    """Two-branch conditional; both branches must produce matching
+    structures (reference layers.cond:2333)."""
+    helper = LayerHelper("cond", name=name)
+    prog = default_main_program()
+    parent = prog.current_block()
+
+    def build(fn):
+        sub = prog._create_block()
+        out = fn() if fn is not None else None
+        prog._rollback()
+        if out is None:
+            outs = []
+        elif isinstance(out, (list, tuple)):
+            outs = list(out)
+        else:
+            outs = [out]
+        return sub, outs
+
+    sub_t, t_outs = build(true_fn)
+    sub_f, f_outs = build(false_fn)
+    if len(t_outs) != len(f_outs):
+        raise ValueError(
+            f"cond branches return different numbers of outputs: "
+            f"{len(t_outs)} vs {len(f_outs)}")
+    results = []
+    for tv in t_outs:
+        out = helper.create_variable_for_type_inference(tv.dtype_str)
+        out.shape = tuple(tv.shape)
+        results.append(out)
+    # record both branches' external reads as an input slot: the backward
+    # (generic vjp over the re-emitted lax.cond) differentiates w.r.t.
+    # these — params captured inside a branch get gradients
+    captured = []
+    for sub, outs in ((sub_t, t_outs), (sub_f, f_outs)):
+        local = set()
+        for op in sub.ops:
+            for n in op.input_arg_names():
+                if n not in local and n != pred.name and n not in captured:
+                    captured.append(n)
+            local.update(op.output_arg_names())
+        # a branch may return a pre-existing parent var directly (no ops);
+        # it is still an input of the cond
+        for v in outs:
+            if v.name not in local and v.name != pred.name \
+                    and v.name not in captured:
+                captured.append(v.name)
+    parent.append_op(
+        "cond_pair",
+        {"Cond": [pred.name], "Captured": captured},
+        {"Out": [r.name for r in results]},
+        {"sub_block_t": sub_t.idx, "sub_block_f": sub_f.idx,
+         "t_outs": [v.name for v in t_outs],
+         "f_outs": [v.name for v in f_outs]},
+    )
+    if not results:
+        return None
+    return results[0] if len(results) == 1 else results
+
+
+class While:
+    """v1.8-style while context manager:
+
+        i = layers.fill_constant([1], "int64", 0)
+        c = layers.less_than(i, n)
+        w = layers.While(c)
+        with w.block():
+            ... ops updating state ...
+            layers.increment(i)
+            layers.assign(layers.less_than(i, n), c)
+
+    Carried state is inferred from the sub-block: the condition, every
+    var read before written inside the loop, and every loop-written var
+    that was already produced in the parent block."""
+
+    def __init__(self, cond, is_test=False, name=None):
+        if tuple(getattr(cond, "shape", ())) not in ((), (1,)):
+            raise TypeError(
+                f"While condition must be a scalar, got shape {cond.shape}")
+        self.cond_var = cond
+        self.is_test = is_test
+
+    def block(self):
+        import contextlib
+
+        prog = default_main_program()
+        parent = prog.current_block()
+        parent_written = set()
+        for op in parent.ops:
+            parent_written.update(op.output_arg_names())
+        this = self
+
+        @contextlib.contextmanager
+        def guard():
+            sub = prog._create_block()
+            try:
+                yield
+            finally:
+                prog._rollback()
+                read_before_write = []
+                written = set()
+                for op in sub.ops:
+                    for n in op.input_arg_names():
+                        if n not in written and n not in read_before_write:
+                            read_before_write.append(n)
+                    written.update(op.output_arg_names())
+                carried = [this.cond_var.name]
+                for n in sorted(written):
+                    if n == this.cond_var.name:
+                        continue
+                    if n in read_before_write or n in parent_written:
+                        carried.append(n)
+                parent.append_op(
+                    "while",
+                    {"X": carried, "Condition": [this.cond_var.name]},
+                    {"Out": list(carried)},
+                    {"sub_block": sub.idx, "is_test": this.is_test},
+                )
+
+        return guard()

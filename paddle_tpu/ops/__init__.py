@@ -7,6 +7,7 @@ sites) — here each op is a trace-time jax emission rule (SURVEY.md §2.4
 from . import (  # noqa: F401
     activations,
     collective,
+    control_flow,
     creation,
     grad_generic,
     math_ops,

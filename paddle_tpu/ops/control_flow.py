@@ -1,0 +1,175 @@
+"""Control-flow op lowerings: while / cond with sub-block attrs.
+
+Role parity: reference paddle/fluid/operators/controlflow/ — while_op.cc
+(`while` executes its sub-block via a nested Executor until Condition is
+false) and conditional_block_op.cc (predicated single-branch execution),
+built by python/paddle/fluid/layers/control_flow.py (While:1020,
+while_loop:1035, cond:2333).
+
+TPU-native redesign (SURVEY.md §7 "Control flow"): scopes do not exist
+inside XLA, so sub-blocks lower to `lax.while_loop` / `lax.cond` with
+EXPLICIT carried state.  The layer builders record the carried var names
+on the op (slot "X" == slot "Out"); everything else the sub-block reads
+is closed over as a constant.  The loop body must keep carried
+shapes/dtypes fixed (an XLA requirement the reference does not have —
+violations raise at trace time with the op's build site).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..framework.lowering import LoweringContext, register_lower
+
+
+def _trace_sub_block(ctx, sub_block, env):
+    """Lower every op of a sub-block into `env` (same registry)."""
+    from ..framework.lowering import PSEUDO_OPS, get_lowering
+
+    sub_ctx = LoweringContext(sub_block, env, rng_key=None, mesh=ctx.mesh,
+                              axis_env=ctx.axis_env, ring_axes=ctx.ring_axes)
+    for op in sub_block.ops:
+        if op.type in PSEUDO_OPS:
+            continue
+        try:
+            get_lowering(op.type)(sub_ctx, op)
+        except Exception as e:
+            site = op.callstack[-1] if op.callstack else "<unknown>"
+            raise type(e)(
+                f"while lowering sub-block op {op.type!r} (built at "
+                f"{site}): {e}") from e
+    return env
+
+
+def _as_pred(value):
+    """Scalar bool for lax.cond/while_loop predicates."""
+    v = jnp.asarray(value)
+    if v.size != 1:
+        raise ValueError(
+            f"control-flow condition must be a single element, got shape "
+            f"{v.shape}")
+    return v.reshape(()).astype(jnp.bool_)
+
+
+@register_lower("while")
+def _while(ctx, op):
+    sub = ctx.program.blocks[int(op.attr("sub_block"))]
+    cond_name = op.inputs["Condition"][0]
+    carry_names = list(op.inputs.get("X", []))
+    if cond_name not in carry_names:
+        carry_names = [cond_name] + carry_names
+
+    # loud guard: a var written only inside the loop but read by later
+    # parent ops has no initial carry value — tell the user to initialize
+    # it before the loop so it becomes loop state (fluid scope semantics
+    # tolerate this; explicit carry does not)
+    sub_written = {n for sop in sub.ops for n in sop.output_arg_names()}
+    after = False
+    escaping = set()
+    for pop in ctx.block.ops:
+        if pop is op:
+            after = True
+            continue
+        if after:
+            for n in pop.input_arg_names():
+                if n in sub_written and n not in carry_names \
+                        and n not in ctx.env:
+                    escaping.add(n)
+    if escaping:
+        raise ValueError(
+            f"vars {sorted(escaping)} are written inside the while loop and "
+            f"read after it, but were never initialized before the loop; "
+            f"give them an initial value (e.g. fill_constant) before the "
+            f"loop so they join the carried state")
+
+    init = tuple(ctx.get(n) for n in carry_names)
+    cond_idx = carry_names.index(cond_name)
+
+    def cond_fun(carry):
+        return _as_pred(carry[cond_idx])
+
+    def body_fun(carry):
+        env = dict(ctx.env)
+        env.update(zip(carry_names, carry))
+        _trace_sub_block(ctx, sub, env)
+        new = []
+        for n, old in zip(carry_names, carry):
+            v = env[n]
+            if jnp.shape(v) != jnp.shape(old) or \
+                    jnp.asarray(v).dtype != jnp.asarray(old).dtype:
+                raise TypeError(
+                    f"while loop carried var {n!r} changed from "
+                    f"{jnp.asarray(old).dtype}{jnp.shape(old)} to "
+                    f"{jnp.asarray(v).dtype}{jnp.shape(v)}; XLA loops need "
+                    f"loop-invariant shapes/dtypes")
+            new.append(v)
+        return tuple(new)
+
+    final = lax.while_loop(cond_fun, body_fun, init)
+    for n, v in zip(carry_names, final):
+        ctx.set(n, v)
+
+
+@register_lower("conditional_block")
+def _conditional_block(ctx, op):
+    """Predicated single-branch execution (conditional_block_op.cc): when
+    the condition is false, outputs keep their previous values (zeros when
+    previously undefined — the reference leaves them uninitialized, which
+    XLA cannot express)."""
+    sub = ctx.program.blocks[int(op.attr("sub_block"))]
+    pred = _as_pred(ctx.in1(op, "Cond"))
+    out_names = list(op.outputs.get("Out", []))
+
+    def true_fn(_):
+        env = dict(ctx.env)
+        _trace_sub_block(ctx, sub, env)
+        return tuple(env[n] for n in out_names)
+
+    def false_fn(_):
+        vals = []
+        probe = jax.eval_shape(true_fn, None)
+        for n, sd in zip(out_names, probe):
+            if n in ctx.env:
+                vals.append(jnp.asarray(ctx.env[n]).astype(sd.dtype))
+            else:
+                vals.append(jnp.zeros(sd.shape, sd.dtype))
+        return tuple(vals)
+
+    outs = lax.cond(pred, true_fn, false_fn, None)
+    for n, v in zip(out_names, outs):
+        ctx.set(n, v)
+
+
+@register_lower("cond_pair")
+def _cond_pair(ctx, op):
+    """Two-branch functional cond (the 2.0 layers.cond builder): both
+    branches are sub-blocks; their per-branch output names are recorded in
+    attrs, results land in the op's Out names."""
+    sub_t = ctx.program.blocks[int(op.attr("sub_block_t"))]
+    sub_f = ctx.program.blocks[int(op.attr("sub_block_f"))]
+    t_outs = list(op.attr("t_outs", []) or [])
+    f_outs = list(op.attr("f_outs", []) or [])
+    out_names = list(op.outputs.get("Out", []))
+    pred = _as_pred(ctx.in1(op, "Cond"))
+
+    def true_fn(_):
+        env = dict(ctx.env)
+        _trace_sub_block(ctx, sub_t, env)
+        return tuple(jnp.asarray(env[n]) for n in t_outs)
+
+    def false_fn(_):
+        env = dict(ctx.env)
+        _trace_sub_block(ctx, sub_f, env)
+        return tuple(jnp.asarray(env[n]) for n in f_outs)
+
+    t_shapes = jax.eval_shape(true_fn, None)
+    f_shapes = jax.eval_shape(false_fn, None)
+    for n, (ts, fs) in enumerate(zip(t_shapes, f_shapes)):
+        if ts.shape != fs.shape or ts.dtype != fs.dtype:
+            raise TypeError(
+                f"cond branches disagree on output {n}: true_fn gives "
+                f"{ts.dtype}{ts.shape}, false_fn gives {fs.dtype}{fs.shape}")
+    outs = lax.cond(pred, true_fn, false_fn, None)
+    for n, v in zip(out_names, outs):
+        ctx.set(n, v)

@@ -27,6 +27,7 @@ from .static_opt import (  # noqa: F401  (fluid-compat re-exports)
     RMSPropOptimizer,
     SGDOptimizer,
 )
+from .pipeline_opt import PipelineOptimizer  # noqa: F401
 
 
 class Optimizer:

@@ -1,0 +1,49 @@
+"""PipelineOptimizer: fluid-style wrapper marking a program for the GPipe
+executor.
+
+Role parity: reference fluid.optimizer.PipelineOptimizer
+(optimizer.py:3695) — wraps an inner optimizer, records the microbatch
+count, and (in the reference) splits the program into per-device sections
+run by PipelineTrainer.  Here the split happens at compile time
+(distributed/pipeline.py analyze_stages over device_guard('stage:N')
+annotations); minimize() just records the section boundaries the pipeline
+executor needs: where the forward ends, where the backward ends, the loss,
+and the param->grad map.
+"""
+from __future__ import annotations
+
+
+class PipelineOptimizer:
+    def __init__(self, optimizer, num_microbatches=1, start_cpu_core_id=0):
+        if num_microbatches < 1:
+            raise ValueError(f"num_microbatches must be >= 1, got "
+                             f"{num_microbatches}")
+        self._opt = optimizer
+        self._num_microbatches = int(num_microbatches)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        prog = loss.block.program
+        block = prog.global_block
+        fwd_end = len(block.ops)
+        params_grads = self._opt.backward(loss, startup_program,
+                                          parameter_list, no_grad_set)
+        bwd_end = len(block.ops)
+        opt_ops = self._opt.apply_gradients(params_grads)
+        prog._pipeline = {
+            "fwd_end": fwd_end,
+            "bwd_end": bwd_end,
+            "num_microbatches": self._num_microbatches,
+            "loss_name": loss.name,
+            "params_grads": [
+                (p.name, g.name if hasattr(g, "name") else g)
+                for p, g in params_grads
+            ],
+        }
+        prog._bump()
+        return opt_ops, params_grads
+
+    def __getattr__(self, name):
+        if name == "_opt":
+            raise AttributeError(name)
+        return getattr(self._opt, name)

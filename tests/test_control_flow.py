@@ -1,0 +1,175 @@
+"""Control flow: while / While / cond lowering to lax.while_loop / lax.cond.
+
+Parity model: reference operators/controlflow/ (while_op.cc,
+conditional_block_op.cc) + layers/control_flow.py (While:1020,
+while_loop:1035, cond:2333); unittests test_while_op.py / test_cond.py.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.framework.program import Program, program_guard
+from paddle_tpu.optimizer import MomentumOptimizer
+
+
+def _run(main, startup, feed, fetch):
+    sc = pt.framework.Scope()
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup, scope=sc)
+    return exe.run(main, feed=feed, fetch_list=fetch, scope=sc)
+
+
+class TestWhileLoop:
+    def test_sum_to_n(self):
+        main, startup = Program(), Program()
+        with program_guard(main, startup):
+            i = layers.fill_constant([1], "int64", 0)
+            acc = layers.fill_constant([1], "int64", 0)
+            limit = layers.fill_constant([1], "int64", 10)
+
+            def cond(i, acc):
+                return layers.less_than(i, limit)
+
+            def body(i, acc):
+                acc = layers.elementwise_add(acc, i)
+                i = layers.increment(i)
+                return i, acc
+
+            i, acc = layers.while_loop(cond, body, [i, acc])
+        out = _run(main, startup, {}, [acc, i])
+        assert int(np.asarray(out[0]).item()) == sum(range(10))
+        assert int(np.asarray(out[1]).item()) == 10
+
+    def test_tensor_carry(self):
+        """Matrix power by repeated multiply — tensor-valued carry."""
+        main, startup = Program(), Program()
+        with program_guard(main, startup):
+            x = layers.data("x", [2, 2], append_batch_size=False)
+            i = layers.fill_constant([1], "int64", 0)
+            n = layers.fill_constant([1], "int64", 3)
+            y = layers.fill_constant([2, 2], "float32", 0.0)
+            y = layers.elementwise_add(y, x)  # y = x
+
+            def cond(i, y):
+                return layers.less_than(i, n)
+
+            def body(i, y):
+                y = layers.matmul(y, x)
+                i = layers.increment(i)
+                return i, y
+
+            i, y = layers.while_loop(cond, body, [i, y])
+        A = np.array([[1.0, 1.0], [0.0, 1.0]], "f4")
+        out = _run(main, startup, {"x": A}, [y])
+        np.testing.assert_allclose(np.asarray(out[0]),
+                                   np.linalg.matrix_power(A, 4), rtol=1e-5)
+
+    def test_shape_change_rejected(self):
+        main, startup = Program(), Program()
+        with program_guard(main, startup):
+            i = layers.fill_constant([1], "int64", 0)
+            n = layers.fill_constant([1], "int64", 3)
+            y = layers.fill_constant([2], "float32", 1.0)
+
+            def cond(i, y):
+                return layers.less_than(i, n)
+
+            def body(i, y):
+                y = layers.concat([y, y], axis=0)  # shape grows: illegal
+                return layers.increment(i), y
+
+            layers.while_loop(cond, body, [i, y])
+        with pytest.raises(Exception, match="loop-invariant|shape"):
+            _run(main, startup, {}, [])
+
+
+class TestWhileContextManager:
+    def test_v18_style_loop(self):
+        main, startup = Program(), Program()
+        with program_guard(main, startup):
+            i = layers.fill_constant([1], "int64", 0)
+            ten = layers.fill_constant([1], "int64", 10)
+            acc = layers.fill_constant([1], "float32", 0.0)
+            c = layers.less_than(i, ten)
+            w = layers.While(c)
+            with w.block():
+                layers.assign(
+                    layers.elementwise_add(acc, layers.fill_constant(
+                        [1], "float32", 2.0)), acc)
+                layers.assign(layers.increment(i), i)
+                layers.assign(layers.less_than(i, ten), c)
+        out = _run(main, startup, {}, [acc])
+        assert float(np.asarray(out[0]).item()) == 20.0
+
+
+class TestCond:
+    def test_both_branches(self):
+        for flag, expect in ((1.0, 5.0), (0.0, -5.0)):
+            main, startup = Program(), Program()
+            with program_guard(main, startup):
+                x = layers.data("x", [1])
+                pred = layers.greater_than(
+                    x, layers.fill_constant([1], "float32", 0.5))
+                out = layers.cond(
+                    pred,
+                    lambda: layers.fill_constant([1], "float32", 5.0),
+                    lambda: layers.fill_constant([1], "float32", -5.0))
+            got = _run(main, startup,
+                       {"x": np.array([[flag]], "f4")}, [out])
+            assert float(np.asarray(got[0]).item()) == expect
+
+    def test_branch_structure_mismatch_rejected(self):
+        main, startup = Program(), Program()
+        with program_guard(main, startup):
+            pred_v = layers.fill_constant([1], "bool", 1)
+            with pytest.raises(ValueError, match="different numbers"):
+                layers.cond(
+                    pred_v,
+                    lambda: (layers.zeros([1]), layers.zeros([1])),
+                    lambda: layers.zeros([1]))
+
+    def test_cond_in_training_grads_flow(self):
+        """cond train e2e: params captured inside a branch must receive
+        gradients (generic vjp over the re-emitted lax.cond)."""
+        from paddle_tpu.framework import unique_name
+        from paddle_tpu.initializer import ConstantInitializer
+        from paddle_tpu.param_attr import ParamAttr
+
+        rng = np.random.RandomState(0)
+        X = rng.randn(8, 4).astype("f4")
+        Y = (X.sum(1, keepdims=True) * 0.5).astype("f4")
+
+        main, startup = Program(), Program()
+        main.random_seed = 1
+        with unique_name.guard(), program_guard(main, startup):
+            x = layers.data("x", [4])
+            y = layers.data("y", [1])
+            flag = layers.data("flag", [1])
+            h = layers.fc(x, 8, act="relu", param_attr=ParamAttr(
+                initializer=ConstantInitializer(0.2)), bias_attr=False)
+            pred_b = layers.greater_than(
+                layers.reduce_sum(flag),
+                layers.fill_constant([1], "float32", 0.0))
+            out = layers.cond(
+                pred_b,
+                lambda: layers.fc(h, 1, param_attr=ParamAttr(
+                    initializer=ConstantInitializer(0.1)), bias_attr=False),
+                lambda: layers.reduce_sum(h, dim=1, keep_dim=True))
+            loss = layers.mean(layers.square_error_cost(out, y))
+            MomentumOptimizer(0.1, 0.9).minimize(loss)
+
+        sc = pt.framework.Scope()
+        exe = pt.Executor(pt.CPUPlace())
+        exe.run(startup, scope=sc)
+        flag_on = np.ones((1, 1), "f4")
+        losses = [
+            float(np.asarray(exe.run(
+                main, feed={"x": X, "y": Y, "flag": flag_on},
+                fetch_list=[loss], scope=sc)[0]).item())
+            for _ in range(10)
+        ]
+        assert losses[-1] < losses[0] * 0.5, losses
+        # the branch-captured fc param must have moved
+        w = np.asarray(sc.get_var("fc_1.w_0"))
+        assert not np.allclose(w, 0.1), "no gradient reached branch param"
