@@ -15,8 +15,8 @@ import time
 import numpy as np
 
 BATCH = 128
-STEPS_PER_CALL = 20
-TIMED_CALLS = 3
+STEPS_PER_CALL = 60
+TIMED_CALLS = 2
 A100_IMG_PER_SEC = 2900.0
 
 

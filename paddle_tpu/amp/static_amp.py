@@ -127,6 +127,17 @@ class OptimizerWithMixedPrecision:
 
         program = loss.block.program
         dest = "bfloat16" if self._use_bf16 else "float16"
+        if not self._use_bf16:
+            # the norms' bf16-transparent treatment (fp32 stats inside,
+            # low-precision Y) is only safe with bf16's fp32 exponent
+            # range; under fp16 + loss scaling keep them fp32 islands as
+            # the reference does (fp16_lists.py)
+            import copy
+
+            lists = copy.deepcopy(self._amp_lists)
+            lists.black_list |= {"batch_norm", "sync_batch_norm",
+                                 "layer_norm"} - lists.white_list
+            self._amp_lists = lists
         rewrite_program(program, self._amp_lists, dest)
 
         if self._use_bf16:

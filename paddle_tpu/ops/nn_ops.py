@@ -305,6 +305,13 @@ def _huber_loss(ctx, op):
 
 @register_lower("batch_norm", "sync_batch_norm")
 def _batch_norm(ctx, op):
+    """bf16-transparent batch norm: statistics and normalization run in
+    fp32 regardless of input dtype, but Y comes back in x.dtype, so under
+    AMP the activation chain conv->bn->relu->pool stays bf16 end-to-end
+    (the HBM-bandwidth win that dominates ResNet step time on TPU) while
+    running mean/var and Saved* stay fp32.  Reference keeps batch_norm in
+    the AMP black list instead (fp16_lists.py) because CUDA BN kernels are
+    fp32; XLA fuses the casts so the fp32 island costs nothing here."""
     x = ctx.in1(op, "X")
     scale = ctx.in1(op, "Scale")
     bias = ctx.in1(op, "Bias")
@@ -321,12 +328,17 @@ def _batch_norm(ctx, op):
     bshape = [1] * x.ndim
     bshape[caxis] = x.shape[caxis]
 
+    xf = x.astype(jnp.float32)
     if use_global:
-        m, v = mean, var
-        saved_mean, saved_var = mean, var
+        m, v = mean.astype(jnp.float32), var.astype(jnp.float32)
+        saved_mean, saved_var = m, v
     else:
-        m = jnp.mean(x, axis=red_axes)
-        v = jnp.var(x, axis=red_axes)
+        # one-pass moments: mean(x) and mean(x^2) are sibling reductions
+        # XLA fuses into a single read of x; jnp.var's (x-m)^2 form would
+        # read the activation tensor twice (m must land before the second
+        # pass).  fp32 accumulators keep the cancellation benign.
+        m = jnp.mean(xf, axis=red_axes)
+        v = jnp.mean(jnp.square(xf), axis=red_axes) - jnp.square(m)
         if op.type == "sync_batch_norm" and ctx.axis_env:
             # cross-replica moments ride ICI (reference sync_batch_norm_pass)
             ex2 = v + jnp.square(m)
@@ -334,14 +346,19 @@ def _batch_norm(ctx, op):
                 m = jax.lax.pmean(m, ax)
                 ex2 = jax.lax.pmean(ex2, ax)
             v = ex2 - jnp.square(m)
+        # fp32 cancellation in E[x^2]-E[x]^2 can dip slightly negative for
+        # large-mean/small-std activations; rsqrt(neg+eps) would be NaN
+        v = jnp.maximum(v, 0.0)
         saved_mean, saved_var = m, v
-        new_running_mean = momentum * mean + (1 - momentum) * m
-        new_running_var = momentum * var + (1 - momentum) * v
+        new_running_mean = momentum * mean + (1 - momentum) * m.astype(mean.dtype)
+        new_running_var = momentum * var + (1 - momentum) * v.astype(var.dtype)
         ctx.set_out(op, "MeanOut", new_running_mean)
         ctx.set_out(op, "VarianceOut", new_running_var)
-    inv = jax.lax.rsqrt(v.astype(jnp.float32) + eps).astype(x.dtype)
-    out = (x - m.reshape(bshape)) * inv.reshape(bshape) * scale.reshape(bshape) + bias.reshape(bshape)
-    ctx.set_out(op, "Y", out)
+    inv = jax.lax.rsqrt(v + eps)
+    out = (xf - m.reshape(bshape)) * inv.reshape(bshape) \
+        * scale.astype(jnp.float32).reshape(bshape) \
+        + bias.astype(jnp.float32).reshape(bshape)
+    ctx.set_out(op, "Y", out.astype(x.dtype))
     if use_global:
         ctx.set_out(op, "MeanOut", mean)
         ctx.set_out(op, "VarianceOut", var)
