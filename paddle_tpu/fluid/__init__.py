@@ -25,6 +25,7 @@ from ..layers import data  # noqa: F401
 from ..param_attr import ParamAttr, WeightNormParamAttr  # noqa: F401
 
 from . import io  # noqa: F401
+from .. import profiler  # noqa: F401
 
 
 def scope_guard(scope):

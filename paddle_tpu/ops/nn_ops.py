@@ -336,7 +336,10 @@ def _batch_norm(ctx, op):
         # one-pass moments: mean(x) and mean(x^2) are sibling reductions
         # XLA fuses into a single read of x; jnp.var's (x-m)^2 form would
         # read the activation tensor twice (m must land before the second
-        # pass).  fp32 accumulators keep the cancellation benign.
+        # pass).  Deliberate trade-off: E[x^2]-E[x]^2 in fp32 loses
+        # accuracy when |mean| >> std (cancellation), which is the same
+        # trade flax/haiku BatchNorm make on TPU; worth ~9% ResNet-50
+        # step time.
         m = jnp.mean(xf, axis=red_axes)
         v = jnp.mean(jnp.square(xf), axis=red_axes) - jnp.square(m)
         if op.type == "sync_batch_norm" and ctx.axis_env:
@@ -363,7 +366,7 @@ def _batch_norm(ctx, op):
         ctx.set_out(op, "MeanOut", mean)
         ctx.set_out(op, "VarianceOut", var)
     ctx.set_out(op, "SavedMean", saved_mean)
-    ctx.set_out(op, "SavedVariance", jax.lax.rsqrt(saved_var.astype(jnp.float32) + eps))
+    ctx.set_out(op, "SavedVariance", jax.lax.rsqrt(saved_var + eps))
 
 
 @register_lower("layer_norm")
