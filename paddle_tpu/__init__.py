@@ -64,6 +64,7 @@ from .framework import (  # noqa: F401
 
 from . import inference  # noqa: F401
 from . import profiler  # noqa: F401
+from . import text  # noqa: F401
 from .serialization import load, save  # noqa: F401
 
 __version__ = "0.1.0"

@@ -80,10 +80,23 @@ def rewrite_program(main_program, amp_lists: AutoMixedPrecisionLists,
                                       to_cast, _FLOAT, cache)
             i += ins + 1
         else:
-            # gray: propagate low precision through
+            # gray: propagate low precision through; for pure-compute
+            # elementwise ops also cast any remaining fp32 inputs down so
+            # jnp promotion cannot lift the chain back to fp32 (reference
+            # fp16_utils.py:193 gray handling) — bias adds and residual
+            # adds are the load-bearing cases
+            ins = 0
             if any(n in low_vars for n in op.input_arg_names()):
+                if op.type in getattr(amp_lists, "gray_follow_cast", ()):
+                    for slot, names in list(op.inputs.items()):
+                        to_cast = {n for n in names
+                                   if n in float_vars and n not in low_vars
+                                   and n not in amp_lists.black_varnames}
+                        if to_cast:
+                            ins += _cast_slot(block, i, op, slot,
+                                              to_cast, dest_enum, cache)
                 low_vars.update(op.output_arg_names())
-            i += 1
+            i += ins + 1
     main_program._bump()
     return main_program
 
@@ -136,7 +149,7 @@ class OptimizerWithMixedPrecision:
 
             lists = copy.deepcopy(self._amp_lists)
             lists.black_list |= {"batch_norm", "sync_batch_norm",
-                                 "layer_norm"} - lists.white_list
+                                 "layer_norm", "softmax"} - lists.white_list
             self._amp_lists = lists
         rewrite_program(program, self._amp_lists, dest)
 

@@ -63,6 +63,7 @@ __all__ = [
     "elementwise_div",
     "mul",
     "matmul",
+    "fused_multihead_attention",
     "scale",
     "clip",
     "clip_by_norm",
@@ -85,8 +86,14 @@ __all__ = [
     "pad",
     "where",
     "equal",
+    "not_equal",
     "less_than",
+    "less_equal",
     "greater_than",
+    "greater_equal",
+    "elementwise_max",
+    "elementwise_min",
+    "elementwise_pow",
     "logical_and",
     "logical_not",
     "increment",
@@ -354,6 +361,7 @@ def layer_norm(
         b_p = helper.create_parameter(bias_attr, [norm_dim], dtype=input.dtype_str, is_bias=True)
         inputs["Bias"] = b_p
     out = helper.create_variable_for_type_inference(input.dtype_str)
+    out.shape = tuple(input.shape)
     mean = helper.create_variable_for_type_inference(input.dtype_str, stop_gradient=True)
     var = helper.create_variable_for_type_inference(input.dtype_str, stop_gradient=True)
     helper.append_op(
@@ -390,6 +398,7 @@ def embedding(
 def dropout(x, dropout_prob, is_test=False, seed=None, name=None, dropout_implementation="downgrade_in_infer"):
     helper = LayerHelper("dropout", name=name)
     out = helper.create_variable_for_type_inference(x.dtype_str)
+    out.shape = tuple(x.shape)
     mask = helper.create_variable_for_type_inference("uint8", stop_gradient=True)
     helper.append_op(
         "dropout",
@@ -584,6 +593,8 @@ def reshape(x, shape, name=None, inplace=False, act=None):
 def transpose(x, perm, name=None):
     helper = LayerHelper("transpose2", name=name)
     out = helper.create_variable_for_type_inference(x.dtype_str)
+    if x.shape and len(x.shape) == len(perm):
+        out.shape = tuple(x.shape[p] for p in perm)
     xshape = helper.create_variable_for_type_inference(x.dtype_str, stop_gradient=True)
     helper.append_op(
         "transpose2", {"X": x}, {"Out": out, "XShape": xshape}, {"axis": list(perm)}
@@ -720,12 +731,43 @@ def mul(x, y, x_num_col_dims=1, y_num_col_dims=1, name=None):
 def matmul(x, y, transpose_x=False, transpose_y=False, alpha=1.0, name=None):
     helper = LayerHelper("matmul", name=name)
     out = helper.create_variable_for_type_inference(x.dtype_str)
+    xs, ys = list(x.shape or ()), list(y.shape or ())
+    if len(xs) >= 2 and len(ys) >= 2:
+        m = xs[-1] if transpose_x else xs[-2]
+        n = ys[-2] if transpose_y else ys[-1]
+        xb, yb = xs[:-2], ys[:-2]
+        # broadcast batch dims right-aligned (numpy semantics; max picks
+        # the non-1 extent for any valid broadcast pair)
+        batch = []
+        for i in range(max(len(xb), len(yb))):
+            a = xb[-1 - i] if i < len(xb) else 1
+            c = yb[-1 - i] if i < len(yb) else 1
+            batch.append(max(int(a), int(c)))
+        batch.reverse()
+        out.shape = tuple(batch) + (m, n)
     helper.append_op(
         "matmul",
         {"X": x, "Y": y},
         {"Out": out},
         {"transpose_X": transpose_x, "transpose_Y": transpose_y, "alpha": float(alpha)},
     )
+    return out
+
+
+def fused_multihead_attention(q, k, v, num_heads, bias_qk=None, alpha=0.0,
+                              name=None):
+    """Fused scaled-dot-product attention over [B, S, hidden] q/k/v
+    (reference operators/fused/multihead_matmul_op.cu).  On TPU this is
+    one Pallas flash kernel; ``alpha=0`` means 1/sqrt(head_dim)."""
+    helper = LayerHelper("fused_multihead_attention", name=name)
+    out = helper.create_variable_for_type_inference(q.dtype_str)
+    out.shape = tuple(q.shape)
+    inputs = {"Q": q, "K": k, "V": v}
+    if bias_qk is not None:
+        inputs["BiasQK"] = bias_qk
+    helper.append_op(
+        "fused_multihead_attention", inputs, {"Out": out},
+        {"head_number": num_heads, "alpha": float(alpha)})
     return out
 
 
@@ -876,8 +918,11 @@ def _compare(op_type):
 
 
 equal = _compare("equal")
+not_equal = _compare("not_equal")
 less_than = _compare("less_than")
+less_equal = _compare("less_equal")
 greater_than = _compare("greater_than")
+greater_equal = _compare("greater_equal")
 
 
 def logical_and(x, y, out=None, name=None):

@@ -9,6 +9,7 @@ from . import (  # noqa: F401
     collective,
     control_flow,
     creation,
+    fused,
     grad_generic,
     math_ops,
     misc,

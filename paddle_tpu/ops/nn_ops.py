@@ -189,9 +189,13 @@ def _pool2d(ctx, op):
 
 @register_lower("softmax")
 def _softmax(ctx, op):
+    """bf16-transparent: exp/sum run in fp32 (bf16's 8 mantissa bits lose
+    small probabilities), Out follows x.dtype so attention prob tensors
+    stay bf16 under AMP."""
     x = ctx.in1(op, "X")
     axis = int(op.attr("axis", -1))
-    ctx.set_out(op, "Out", jax.nn.softmax(x, axis=axis))
+    out = jax.nn.softmax(x.astype(jnp.float32), axis=axis)
+    ctx.set_out(op, "Out", out.astype(x.dtype))
 
 
 @register_lower("log_softmax")
@@ -219,11 +223,15 @@ def _softmax_with_cross_entropy(ctx, op):
         lbl = label
         if lbl.ndim == logits.ndim and lbl.shape[axis] == 1:
             lbl = jnp.squeeze(lbl, axis)
-        picked = jnp.take_along_axis(logp, jnp.expand_dims(lbl, axis), axis=axis)
-        loss = -picked
-        if ignore_index >= 0:
-            mask = jnp.expand_dims(lbl, axis) != ignore_index
-            loss = jnp.where(mask, loss, jnp.zeros_like(loss))
+        # clip negative ignore labels (e.g. -1/-100) before the gather;
+        # out-of-range wrap would otherwise pick a real vocab row
+        safe = jnp.clip(lbl, 0, logits.shape[axis] - 1)
+        picked = jnp.take_along_axis(logp, jnp.expand_dims(safe, axis), axis=axis)
+        # paddle semantics: positions whose label == ignore_index carry
+        # zero loss regardless of the ignore_index sign (reference
+        # softmax_with_cross_entropy_op.h hard-codes the compare)
+        mask = jnp.expand_dims(lbl, axis) != ignore_index
+        loss = jnp.where(mask, -picked, jnp.zeros_like(picked))
     ctx.set_out(op, "Softmax", softmax)
     ctx.set_out(op, "Loss", loss)
 
@@ -235,14 +243,19 @@ def _softmax_with_cross_entropy_grad(ctx, op):
     dloss = ctx.in1(op, "Loss@GRAD")
     axis = int(op.attr("axis", -1)) % softmax.ndim
     soft_label = bool(op.attr("soft_label", False))
+    ignore_index = int(op.attr("ignore_index", -100))
     if soft_label:
         dlogits = (softmax - label) * dloss
     else:
         lbl = label
         if lbl.ndim == softmax.ndim and lbl.shape[axis] == 1:
             lbl = jnp.squeeze(lbl, axis)
-        onehot = jax.nn.one_hot(lbl, softmax.shape[axis], axis=axis, dtype=softmax.dtype)
+        safe = jnp.clip(lbl, 0, softmax.shape[axis] - 1)
+        onehot = jax.nn.one_hot(safe, softmax.shape[axis], axis=axis, dtype=softmax.dtype)
         dlogits = (softmax - onehot) * dloss
+        # ignored positions contribute zero loss -> zero gradient
+        mask = jnp.expand_dims(lbl != ignore_index, axis)
+        dlogits = jnp.where(mask, dlogits, jnp.zeros_like(dlogits))
     ctx.set_out(op, "Logits@GRAD", dlogits)
 
 
@@ -378,8 +391,12 @@ def _layer_norm(ctx, op):
     begin = int(op.attr("begin_norm_axis", 1))
     red = tuple(range(begin, x.ndim))
     xf = x.astype(jnp.float32)
+    # one-pass fp32 moments (sibling reductions fuse into a single read;
+    # same deliberate cancellation trade-off as batch_norm above)
     m = jnp.mean(xf, axis=red, keepdims=True)
-    v = jnp.var(xf, axis=red, keepdims=True)
+    v = jnp.maximum(
+        jnp.mean(jnp.square(xf), axis=red, keepdims=True) - jnp.square(m),
+        0.0)
     y = (xf - m) * jax.lax.rsqrt(v + eps)
     norm_shape = x.shape[begin:]
     if scale is not None:

@@ -50,7 +50,13 @@ def _momentum(ctx, op):
 @register_lower("adam", "adamw")
 def _adam(ctx, op):
     p = ctx.in1(op, "Param")
-    g = ctx.in1(op, "Grad").astype(jnp.float32)
+    # barrier: without it XLA fuses the weight-grad dot INTO the update
+    # kernel (kOutput fusion), demoting the contraction from an MXU
+    # custom-call to a vector-unit transpose-reuse emitter (~6x slower on
+    # BERT's [3072,768] params); the barrier materializes the grad and
+    # keeps the dot on the MXU
+    g = jax.lax.optimization_barrier(
+        ctx.in1(op, "Grad").astype(jnp.float32))
     m1 = ctx.in1(op, "Moment1")
     m2 = ctx.in1(op, "Moment2")
     b1p = ctx.in1(op, "Beta1Pow")

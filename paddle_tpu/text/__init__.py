@@ -1,0 +1,10 @@
+"""``paddle.text``-role namespace: NLP model builders (datasets live in
+``paddle_tpu.text.datasets`` when present).
+
+Role parity: the reference ships its transformer/BERT workloads as fluid
+builder scripts (python/paddle/fluid/tests/unittests/dist_transformer.py,
+contrib ERNIE configs) plus a ``paddle.text`` dataset package.  The static
+BERT builder here is the BASELINE.json config-3 flagship workload.
+"""
+from . import static_models  # noqa: F401
+from .static_models import bert_base_pretrain_program, bert_encoder  # noqa: F401

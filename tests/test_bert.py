@@ -1,0 +1,102 @@
+"""BERT static builder: program builds, trains, and MLM masking is honest.
+
+Reference parity: the transformer dist workload
+(python/paddle/fluid/tests/unittests/dist_transformer.py) and
+softmax_with_cross_entropy ignore_index semantics
+(operators/softmax_with_cross_entropy_op.h).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.text import bert_base_pretrain_program
+
+B, S, V, P = 4, 16, 64, 3
+
+
+def _feed(rng):
+    ids = rng.randint(0, V, (B, S)).astype("int64")
+    flat_pos = np.zeros((B * P,), "int64")
+    labels = np.zeros((B * P, 1), "int64")
+    weights = np.ones((B * P, 1), "float32")
+    for b in range(B):
+        pos = rng.choice(S, P, replace=False)
+        flat_pos[b * P:(b + 1) * P] = b * S + pos
+        labels[b * P:(b + 1) * P, 0] = ids[b, pos]
+    weights[-1, 0] = 0.0  # one padding prediction slot
+    return {
+        "input_ids": ids,
+        "token_type_ids": np.zeros((B, S), "int64"),
+        "pos_ids": np.tile(np.arange(S, dtype="int64"), (B, 1)),
+        "input_mask": np.zeros((B, 1, 1, S), "float32"),
+        "masked_flat_pos": flat_pos,
+        "masked_labels": labels,
+        "masked_weights": weights,
+        "nsp_labels": rng.randint(0, 2, (B, 1)).astype("int64"),
+    }
+
+
+@pytest.fixture(scope="module")
+def tiny_bert():
+    main, startup, feeds, loss, opt = bert_base_pretrain_program(
+        batch_size=B, seq_len=S, vocab_size=V, hidden=32, n_layers=2,
+        n_heads=4, ffn_size=64, dropout_prob=0.0, lr=1e-3,
+        max_preds_per_seq=P)
+    from paddle_tpu.framework.program import program_guard
+
+    with program_guard(main, startup):
+        opt.minimize(loss)
+    return main, startup, loss
+
+
+def test_bert_trains_and_loss_drops(tiny_bert):
+    main, startup, loss = tiny_bert
+    exe = pt.Executor(pt.CPUPlace())
+    scope = pt.framework.Scope()
+    exe.run(startup, scope=scope)
+    rng = np.random.RandomState(0)
+    feed = _feed(rng)  # same batch every step: loss must drop fast
+    losses = [
+        float(np.asarray(
+            exe.run(main, feed=feed, fetch_list=[loss], scope=scope)[0]
+        ).ravel()[0])
+        for _ in range(25)
+    ]
+    assert np.isfinite(losses).all(), losses
+    assert losses[-1] < losses[0] * 0.7, losses
+    assert losses[-1] < losses[-2] < losses[0], losses
+
+
+def test_mlm_ignore_index_masks_loss_and_grad():
+    """Positions labelled -1 must contribute zero loss and zero gradient."""
+    from paddle_tpu import layers
+    from paddle_tpu.framework.backward import append_backward
+    from paddle_tpu.framework.program import Program, program_guard
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        logits = layers.data("logits", [2, 3, 5], append_batch_size=False)
+        logits.stop_gradient = False  # feeds default to stop_gradient
+        label = layers.data("label", [2, 3, 1], dtype="int64",
+                            append_batch_size=False)
+        tok = layers.softmax_with_cross_entropy(logits, label,
+                                                ignore_index=-1)
+        total = layers.mean(tok)
+        append_backward(total)
+    exe = pt.Executor(pt.CPUPlace())
+    scope = pt.framework.Scope()
+    exe.run(startup, scope=scope)
+    rng = np.random.RandomState(1)
+    lg = rng.randn(2, 3, 5).astype("float32")
+    lb = np.array([[[1], [-1], [2]], [[-1], [0], [-1]]], dtype="int64")
+    tok_v, dlg = exe.run(
+        main, feed={"logits": lg, "label": lb},
+        fetch_list=[tok, "logits@GRAD"], scope=scope)
+    tok_v = np.asarray(tok_v)
+    assert tok_v[0, 1, 0] == 0.0 and tok_v[1, 0, 0] == 0.0 and tok_v[1, 2, 0] == 0.0
+    # numpy oracle for a live position
+    sm = np.exp(lg[0, 0]) / np.exp(lg[0, 0]).sum()
+    np.testing.assert_allclose(tok_v[0, 0, 0], -np.log(sm[1]), rtol=1e-5)
+    dlg = np.asarray(dlg)
+    assert np.all(dlg[0, 1] == 0.0) and np.all(dlg[1, 0] == 0.0) and np.all(dlg[1, 2] == 0.0)
+    assert np.any(dlg[0, 0] != 0.0)
