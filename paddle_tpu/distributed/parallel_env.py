@@ -41,6 +41,14 @@ def init_parallel_env(mesh_shape: Optional[Sequence[int]] = None,
             eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
             coord = eps.split(",")[0] if eps else None
         rank = int(os.environ.get("PADDLE_TRAINER_ID", "0") or 0)
+        if jax.config.jax_cpu_collectives_implementation is None:
+            # XLA CPU needs an explicit cross-process collectives impl;
+            # without it multi-process psum SILENTLY stays process-local
+            # (each rank reduces only its own devices).  Setting it here
+            # is safe for TPU backends (only consulted when the CPU
+            # client is created) but must happen BEFORE any backend
+            # exists, hence before jax.distributed.initialize.
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
         jax.distributed.initialize(coordinator_address=coord,
                                    num_processes=nproc, process_id=rank)
 
@@ -61,10 +69,12 @@ def init_parallel_env(mesh_shape: Optional[Sequence[int]] = None,
 
 
 def _distributed_initialized() -> bool:
-    import jax
-
+    # must NOT call jax.process_count(): that instantiates the XLA
+    # backend, after which jax.distributed.initialize refuses to run
     try:
-        return jax.process_count() > 1
+        from jax._src import distributed
+
+        return distributed.global_state.client is not None
     except Exception:
         return False
 

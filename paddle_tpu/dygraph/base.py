@@ -20,7 +20,20 @@ class _DygraphState:
     def __init__(self):
         self.mode_on = True  # reference defaults to dygraph in 2.0 API
         self.grad_enabled = True
-        self.rng_key = jax.random.PRNGKey(0)
+        # lazy: creating a PRNGKey initialises the XLA backend, which
+        # must not happen at import time (jax.distributed.initialize in
+        # multi-process trainers must run first)
+        self._rng_key = None
+
+    @property
+    def rng_key(self):
+        if self._rng_key is None:
+            self._rng_key = jax.random.PRNGKey(0)
+        return self._rng_key
+
+    @rng_key.setter
+    def rng_key(self, value):
+        self._rng_key = value
 
 
 _state = _DygraphState()

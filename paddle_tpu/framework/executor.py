@@ -86,6 +86,9 @@ class _Compiled:
     state_out: Tuple[str, ...]
     fetch_names: Tuple[str, ...]
     uses_rng: bool
+    # multi-process SPMD: converts process-local feed/state values into
+    # global jax.Arrays over the mesh before the executable call
+    globalize: object = None
     n_calls: int = 0
 
 
@@ -299,6 +302,10 @@ class Executor:
         const_vals = tuple(scope.get_var(n) for n in entry.state_const)
         rng = scope.get_var(RNG_VAR)
 
+        if entry.globalize is not None:
+            feed_vals, mut_vals, const_vals, rng = entry.globalize(
+                feed_vals, mut_vals, const_vals, rng)
+
         fetches, new_state, new_rng = entry.fn(feed_vals, mut_vals, const_vals, rng)
         entry.n_calls += 1
 
@@ -426,9 +433,10 @@ class Executor:
         state_mut = tuple(n for n in state_in if n in out_set)
         state_const = tuple(n for n in state_in if n not in out_set)
 
-        def trace_block(env, rng, axis_env=(), ring_axes=None):
+        def trace_block(env, rng, axis_env=(), ring_axes=None, fold_axes=()):
             ctx = LoweringContext(block, env, rng_key=rng, mesh=mesh,
-                                  axis_env=axis_env, ring_axes=ring_axes)
+                                  axis_env=axis_env, ring_axes=ring_axes,
+                                  fold_axes=fold_axes)
             for op in block.ops:
                 if op.type in PSEUDO_OPS:
                     continue
@@ -468,6 +476,7 @@ class Executor:
                 uses_rng=True,
             )
 
+        globalize = None
         if mesh is None and not multi_step:
             def fn(feed_vals, mut_vals, const_vals, rng):
                 env = {}
@@ -486,7 +495,7 @@ class Executor:
             fn = _make_scan_fn(step_fn, state_mut, state_const, state_out,
                                feed_names, scan_steps)
         else:
-            fn = self._build_sharded_fn(
+            fn, globalize = self._build_sharded_fn(
                 program, mesh, feed_spec, feed_names, state_mut, state_const,
                 state_out, fetch_names, trace_block, multi_step=multi_step,
                 scan_steps=scan_steps)
@@ -511,6 +520,7 @@ class Executor:
             state_out=tuple(state_out),
             fetch_names=fetch_names,
             uses_rng=True,
+            globalize=globalize,
         )
         return compiled
 
@@ -535,6 +545,10 @@ class Executor:
         axis_names = tuple(mesh.axis_names)
         dp_axis = "dp" if "dp" in axis_names else axis_names[0]
         dp_size = int(mesh.shape[dp_axis])
+        # feeds are process-local: each rank supplies its own shard, so
+        # divisibility is judged against the devices THIS process feeds
+        n_procs = len({d.process_index for d in mesh.devices.flat})
+        local_dp = max(dp_size // n_procs, 1)
         try:
             from ..distributed.parallel_env import ring_axes as _ring_axes
 
@@ -547,15 +561,16 @@ class Executor:
         for name, shape, _ in feed_spec:
             if len(shape) == 0 or shape[0] <= 1:
                 feed_in_specs.append(P())  # scalars/broadcast feeds replicate
-            elif shape[0] % dp_size == 0:
+            elif shape[0] % local_dp == 0:
                 feed_in_specs.append(P(dp_axis))
                 sharded_feeds.add(name)
             else:
                 raise ValueError(
                     f"feed {name!r} batch dim {shape[0]} is not divisible by "
-                    f"the data-parallel degree {dp_size}; pad the batch or "
+                    f"the local data-parallel degree {local_dp} (global dp "
+                    f"{dp_size} over {n_procs} processes); pad the batch or "
                     f"resize the mesh (silent replication would waste "
-                    f"{dp_size}x compute)")
+                    f"{local_dp}x compute)")
         feed_in_specs = tuple(feed_in_specs)
 
         # static dp-variance analysis: which vars differ across dp shards?
@@ -588,12 +603,13 @@ class Executor:
                 varying.update(op.output_arg_names())
 
         def step_once(env, rng):
-            # per-shard randomness: fold the dp index into the key; the
-            # carried key advances identically on every shard
-            local_rng = jax.random.fold_in(rng, lax.axis_index(dp_axis))
-            ctx = trace_block(env, local_rng, axis_env=axis_names,
-                              ring_axes=rings)
-            new_rng = jax.random.split(rng, 2)[0] if ctx.rng_consumed else rng
+            # the program key advances identically on every shard;
+            # per-shard randomness (dropout) folds the dp index in at the
+            # op (LoweringContext.next_key(per_shard=True)) — replica-
+            # invariant randomness (param init) must NOT differ per shard
+            ctx = trace_block(env, rng, axis_env=axis_names,
+                              ring_axes=rings, fold_axes=(dp_axis,))
+            new_rng = ctx.rng_key if ctx.rng_consumed else rng
             fetches = []
             for n in fetch_names:
                 v = env[n]
@@ -638,7 +654,7 @@ class Executor:
         def state_spec(n):
             return P(dp_axis) if n in sharded_state else P()
 
-        return shard_map(
+        fn = shard_map(
             traced,
             mesh=mesh,
             in_specs=(feed_specs_final,
@@ -650,6 +666,40 @@ class Executor:
                        P()),
             check_vma=False,
         )
+
+        # ---- multi-process: each rank holds only ITS shard of the data
+        # (reference trainers each feed their own batch).  jit over a
+        # multi-host mesh needs global jax.Arrays, so process-local
+        # feeds/state are assembled with make_array_from_process_local_data
+        # (the jax.distributed rendezvous replaces c_gen_nccl_id /
+        # c_comm_init; SURVEY §5 comm backend).
+        multiproc = any(d.process_index != jax.process_index()
+                        for d in mesh.devices.flat)
+        globalize = None
+        if multiproc:
+            from jax.sharding import NamedSharding
+
+            if sharded_state:
+                raise NotImplementedError(
+                    "ZeRO-sharded optimizer state is not yet supported on "
+                    "multi-process meshes; use a single-process mesh or "
+                    "disable sharding")
+
+            def to_global(val, pspec):
+                if _is_jax_array(val) and not getattr(
+                        val, "is_fully_addressable", True):
+                    return val  # already a global array (prior step output)
+                return jax.make_array_from_process_local_data(
+                    NamedSharding(mesh, pspec), np.asarray(val))
+
+            def globalize(feed_vals, mut_vals, const_vals, rng):
+                feeds = tuple(to_global(v, s)
+                              for v, s in zip(feed_vals, feed_specs_final))
+                muts = tuple(to_global(v, P()) for v in mut_vals)
+                consts = tuple(to_global(v, P()) for v in const_vals)
+                return feeds, muts, consts, to_global(rng, P())
+
+        return fn, globalize
 
     def close(self):
         self._cache.clear()

@@ -57,7 +57,7 @@ class LoweringContext:
     """
 
     def __init__(self, block, env: dict, rng_key=None, mesh=None, axis_env=(),
-                 ring_axes=None):
+                 ring_axes=None, fold_axes=()):
         self.block = block
         self.program = block.program
         self.env = env
@@ -67,6 +67,12 @@ class LoweringContext:
         self.axis_env = tuple(axis_env)
         # ring_id -> mesh axis name (collective ops; see ops/collective.py)
         self.ring_axes = dict(ring_axes or {})
+        # axes whose index is folded into per-shard keys (next_key(
+        # per_shard=True)); replica-invariant randomness (param init)
+        # must NOT fold or each shard initializes differently — the
+        # reference broadcasts params from device 0 for the same reason
+        # (multi_devices_graph_pass param broadcast)
+        self.fold_axes = tuple(fold_axes)
         self.rng_consumed = False
 
     def axis_size(self, axis) -> int:
@@ -121,14 +127,29 @@ class LoweringContext:
         return dtypes.to_jnp(v.dtype if v is not None else "float32")
 
     # -- randomness --------------------------------------------------------
-    def next_key(self):
+    def next_key(self, per_shard=False):
+        """Draw the next program key.  ``per_shard=True`` additionally
+        folds in the dp shard index (dropout masks must differ per data
+        shard); the default key is replica-invariant so param init and
+        other P()-state randomness stay identical across shards."""
         import jax
 
         if self._rng is None:
             raise RuntimeError("program uses random ops but no RNG key was threaded")
         self.rng_consumed = True
         self._rng, k = jax.random.split(self._rng)
+        if per_shard:
+            k = self.fold_shard(k)
         return k
+
+    def fold_shard(self, key):
+        """Fold the shard index of every fold axis into ``key``."""
+        import jax
+        from jax import lax
+
+        for ax in self.fold_axes:
+            key = jax.random.fold_in(key, lax.axis_index(ax))
+        return key
 
     @property
     def rng_key(self):

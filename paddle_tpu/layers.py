@@ -736,13 +736,17 @@ def matmul(x, y, transpose_x=False, transpose_y=False, alpha=1.0, name=None):
         m = xs[-1] if transpose_x else xs[-2]
         n = ys[-2] if transpose_y else ys[-1]
         xb, yb = xs[:-2], ys[:-2]
-        # broadcast batch dims right-aligned (numpy semantics; max picks
-        # the non-1 extent for any valid broadcast pair)
+        # broadcast batch dims right-aligned (numpy semantics); dynamic
+        # -1 dims survive unless the other operand pins a >1 extent
+        # (then any valid runtime broadcast yields that extent)
         batch = []
         for i in range(max(len(xb), len(yb))):
-            a = xb[-1 - i] if i < len(xb) else 1
-            c = yb[-1 - i] if i < len(yb) else 1
-            batch.append(max(int(a), int(c)))
+            a = int(xb[-1 - i]) if i < len(xb) else 1
+            c = int(yb[-1 - i]) if i < len(yb) else 1
+            if a < 0 or c < 0:
+                batch.append(max(a, c) if max(a, c) > 1 else -1)
+            else:
+                batch.append(max(a, c))
         batch.reverse()
         out.shape = tuple(batch) + (m, n)
     helper.append_op(

@@ -16,13 +16,17 @@ def attr_dtype(op, name="dtype", default="float32"):
     return dtypes.to_jnp(v)
 
 
-def op_seed_key(ctx, op):
+def op_seed_key(ctx, op, per_shard=False):
     """Deterministic key for a random op: explicit nonzero `seed` attr wins
-    (reference per-op seed semantics), else draw from the threaded program key."""
+    (reference per-op seed semantics), else draw from the threaded program
+    key.  ``per_shard`` folds the dp shard index in (dropout-style ops on
+    sharded activations); replica-invariant ops (initializers) leave it
+    False so every shard sees the same stream."""
     seed = int(op.attr("seed", 0) or 0)
     if seed:
-        return jax.random.PRNGKey(seed)
-    return ctx.next_key()
+        k = jax.random.PRNGKey(seed)
+        return ctx.fold_shard(k) if per_shard else k
+    return ctx.next_key(per_shard=per_shard)
 
 
 def bcast_shapes_elementwise(x, y, axis: int):

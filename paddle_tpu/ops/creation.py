@@ -109,7 +109,8 @@ def _dropout(ctx, op):
         ctx.set_out(op, "Out", out)
         ctx.set_out(op, "Mask", jnp.ones_like(x, dtype=jnp.uint8))
         return
-    k = op_seed_key(ctx, op)
+    # per_shard: each dp shard masks ITS batch slice independently
+    k = op_seed_key(ctx, op, per_shard=True)
     keep = jax.random.bernoulli(k, 1.0 - p, x.shape)
     if impl == "upscale_in_train":
         scale = 0.0 if p >= 1.0 else 1.0 / (1.0 - p)

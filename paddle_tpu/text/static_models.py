@@ -4,9 +4,11 @@ Role parity: the reference's transformer workload lives in
 python/paddle/fluid/tests/unittests/dist_transformer.py (fluid builder
 functions emitting OpDescs) and the fused attention fast path in
 paddle/fluid/operators/fused/multihead_matmul_op.cu.  TPU-native: the
-attention block is plain matmul/softmax ops — XLA fuses the
-scale+mask+softmax chain on its own, so no fused-op surface is needed;
-the whole encoder compiles into one executable via the Executor.
+builder defaults to the single fused_multihead_attention op (Pallas
+flash kernel for long sequences, one fused XLA composition otherwise —
+see ops/fused.py); ``use_fused_attention=False`` emits the reference's
+plain matmul/softmax/dropout op chain instead.  Either way the whole
+encoder compiles into one executable via the Executor.
 
 Pretraining objective matches BERT phase 1: masked-LM over a seq-length
 token stream (ignore_index marks unmasked positions) + next-sentence
