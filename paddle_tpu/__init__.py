@@ -63,6 +63,7 @@ from .framework import (  # noqa: F401
 )
 
 from . import inference  # noqa: F401
+from . import jit  # noqa: F401
 from . import profiler  # noqa: F401
 from . import text  # noqa: F401
 from .serialization import load, save  # noqa: F401

@@ -51,6 +51,9 @@ _OUT_SLOTS: Dict[str, Sequence[str]] = {
 _LIST_OUT_OPS = {"split": "Out", "unstack": "Y", "meshgrid": "Out",
                  "check_finite_and_unscale": "Out"}
 
+# active dygraph->static program recorder (set by jit._trace_guard)
+_TRACE_REC = None
+
 
 class _EagerOp:
     """Duck-typed Operator (framework/program.py:174) for eager dispatch."""
@@ -254,6 +257,13 @@ def run_op(op_type: str, inputs: Dict[str, object], attrs: Optional[dict] = None
             result[slot] = [t for t in ts if t is not None]
         else:
             result[slot] = ts[0] if n == 1 else ts
+
+    # dygraph->static trace (jit.TracedLayer): record this op into the
+    # program being built (reference imperative/jit ProgramDescTracer);
+    # _TRACE_REC is set by jit._trace_guard so the common non-traced
+    # path pays one global check, no import machinery
+    if _TRACE_REC is not None:
+        _TRACE_REC.record(op_type, tensor_inputs, attrs, result, out_slots)
     return result
 
 
@@ -280,6 +290,9 @@ class Tracer:
                     node = t.grad_node
                     node.out_tensors = tuple(
                         caller if o is t else o for o in node.out_tensors)
+                if _TRACE_REC is not None:
+                    # the trace must follow the caller's tensor identity
+                    _TRACE_REC.alias(t, caller)
         return res
 
 

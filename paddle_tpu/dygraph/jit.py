@@ -1,0 +1,327 @@
+"""Trace-based dygraph->static export: TracedLayer / to_static / jit.save.
+
+Role parity: reference python/paddle/fluid/dygraph/jit.py (``save``:466,
+``TracedLayer``:995) over the C++ ``ProgramDescTracer`` (imperative/jit/).
+TPU-native: eager dispatch already funnels every op through
+``eager.run_op`` with IR op names/slots/attrs, so tracing is just
+recording each eager op into a ``Program`` as it runs — no AST transforms
+needed for the trace path.  The exported program feeds the compile-once
+``inference.Predictor`` / ``fluid.io.save_inference_model`` machinery, so
+dygraph-train -> trace -> serve round-trips inside one framework.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..framework import dtypes, unique_name
+from ..framework.program import Program, program_guard
+from .tensor import Tensor
+
+# The active recorder lives in eager._TRACE_REC (one trace at a time,
+# like the reference's ProgramDescTracer guard) so the eager hot path
+# checks a plain module global instead of importing this module per op.
+
+
+class _ProgramRecorder:
+    """Records eager ops into a Program while they execute."""
+
+    def __init__(self):
+        self.program = Program()
+        self.block = self.program.global_block
+        self._names: Dict[int, str] = {}  # id(Tensor) -> var name
+        # id() is only unique while the object lives: hold a reference to
+        # every traced tensor or a GC'd intermediate's recycled id would
+        # alias a later tensor to a stale var (the reference
+        # ProgramDescTracer holds VarBase refs for the same reason)
+        self._keep: List[Tensor] = []
+        self.feed_names: List[str] = []
+        self.param_values: Dict[str, np.ndarray] = {}
+
+    # -- var management -----------------------------------------------
+    def declare_input(self, t: Tensor) -> str:
+        name = unique_name.generate("trace_feed")
+        self.block.create_var(name=name, shape=list(t.shape),
+                              dtype=str(np.dtype(t._value.dtype)),
+                              stop_gradient=True)
+        self._names[id(t)] = name
+        self._keep.append(t)
+        self.feed_names.append(name)
+        return name
+
+    def _var_for(self, t: Tensor) -> str:
+        name = self._names.get(id(t))
+        if name is not None:
+            return name
+        # first sighting mid-trace: a parameter or a captured constant —
+        # either way it becomes persistable state saved with the model
+        if getattr(t, "persistable", False) and t.name:
+            name = t.name
+        else:
+            name = unique_name.generate("trace_const")
+        self.block.create_var(name=name, shape=list(t.shape),
+                              dtype=str(np.dtype(t._value.dtype)),
+                              persistable=True, stop_gradient=True)
+        self._names[id(t)] = name
+        self._keep.append(t)
+        self.param_values[name] = np.asarray(t._value)
+        return name
+
+    def _out_var(self, t: Tensor) -> str:
+        name = unique_name.generate("trace_tmp")
+        self.block.create_var(name=name, shape=list(t.shape),
+                              dtype=str(np.dtype(t._value.dtype)),
+                              stop_gradient=False)
+        self._names[id(t)] = name
+        self._keep.append(t)
+        return name
+
+    def alias(self, produced: Tensor, holder: Tensor):
+        """trace_op-style value hand-off: ``holder`` now carries the value
+        ``produced`` had; later ops reference ``holder``."""
+        if id(produced) in self._names:
+            self._names[id(holder)] = self._names[id(produced)]
+            self._keep.append(holder)
+
+    def name_of(self, t: Tensor) -> Optional[str]:
+        return self._names.get(id(t))
+
+    # -- op recording --------------------------------------------------
+    def record(self, op_type: str, tensor_inputs: Dict[str, List[Tensor]],
+               attrs: dict, result: Dict[str, object],
+               out_slots: Sequence[str]):
+        in_names = {slot: [self._var_for(t) for t in ts]
+                    for slot, ts in tensor_inputs.items()}
+        out_names: Dict[str, List[str]] = {}
+        for slot in out_slots:
+            v = result.get(slot)
+            ts = v if isinstance(v, (list, tuple)) else [v]
+            out_names[slot] = [self._out_var(t) for t in ts if t is not None]
+        self.block.append_op(op_type, in_names, out_names, dict(attrs or {}))
+
+
+def _recorder() -> Optional[_ProgramRecorder]:
+    from . import eager
+
+    return eager._TRACE_REC
+
+
+class _trace_guard:
+    def __init__(self, rec):
+        self.rec = rec
+
+    def __enter__(self):
+        from . import eager
+
+        if eager._TRACE_REC is not None:
+            raise RuntimeError("a dygraph trace is already active")
+        eager._TRACE_REC = self.rec
+        return self.rec
+
+    def __exit__(self, *exc):
+        from . import eager
+
+        eager._TRACE_REC = None
+        return False
+
+
+def _as_tensors(inputs):
+    ts = []
+    for x in inputs:
+        if isinstance(x, Tensor):
+            ts.append(x)
+        else:
+            ts.append(Tensor(np.asarray(x)))
+    return ts
+
+
+def trace(layer_or_fn, inputs):
+    """Run ``layer_or_fn(*inputs)`` once, recording every op into a
+    Program.  Returns (outputs, recorder)."""
+    inputs = _as_tensors(list(inputs))
+    rec = _ProgramRecorder()
+    for t in inputs:
+        rec.declare_input(t)
+    with _trace_guard(rec):
+        outs = layer_or_fn(*inputs)
+    flat = outs if isinstance(outs, (list, tuple)) else [outs]
+    fetch = []
+    for o in flat:
+        name = rec.name_of(o)
+        if name is None:
+            raise RuntimeError(
+                "trace output was not produced by recorded ops (did the "
+                "forward use a non-IR escape hatch like numpy indexing?)")
+        fetch.append(name)
+    return outs, rec, fetch
+
+
+class TracedLayer:
+    """Reference fluid.dygraph.TracedLayer (jit.py:995): trace once, then
+    run / export the static program."""
+
+    def __init__(self, program, feed_names, fetch_names, param_values):
+        self.program = program
+        self._feed_names = list(feed_names)
+        self._fetch_names = list(fetch_names)
+        self._param_values = dict(param_values)
+        self._exe = None
+        self._scope = None
+
+    @staticmethod
+    def trace(layer, inputs):
+        outs, rec, fetch = trace(layer, inputs)
+        tl = TracedLayer(rec.program, rec.feed_names, fetch, rec.param_values)
+        return outs, tl
+
+    def _ensure_exe(self):
+        import paddle_tpu as pt
+
+        if self._exe is None:
+            self._exe = pt.Executor(pt.framework.place._default_place())
+            self._scope = pt.framework.Scope()
+            for name, val in self._param_values.items():
+                self._scope.set_var(name, val)
+        return self._exe, self._scope
+
+    def __call__(self, *inputs):
+        exe, scope = self._ensure_exe()
+        feed = {n: (t._value if isinstance(t, Tensor) else np.asarray(t))
+                for n, t in zip(self._feed_names, inputs)}
+        outs = exe.run(self.program, feed=feed,
+                       fetch_list=self._fetch_names, scope=scope,
+                       return_numpy=False)
+        return [Tensor(o) for o in outs]
+
+    def save_inference_model(self, path, feed=None, fetch=None):
+        """Export (program, params) servable by inference.Predictor
+        (reference TracedLayer.save_inference_model)."""
+        import paddle_tpu as pt
+        from ..fluid import io as fluid_io
+
+        exe, scope = self._ensure_exe()
+        feed_names = ([self._feed_names[i] for i in feed]
+                      if feed else self._feed_names)
+        fetch_names = ([self._fetch_names[i] for i in fetch]
+                       if fetch else self._fetch_names)
+        from ..fluid import scope_guard
+
+        with scope_guard(scope):
+            fluid_io.save_inference_model(
+                path, feed_names,
+                [self.program.global_block.var(n) for n in fetch_names],
+                exe, main_program=self.program)
+
+
+class StaticFunction:
+    """``@to_static`` wrapper: traces on first call per input signature and
+    afterwards executes the compiled static program (reference
+    dygraph_to_static ProgramTranslator, trace-based instead of AST)."""
+
+    def __init__(self, fn, input_spec=None):
+        self._fn = fn
+        self._input_spec = input_spec
+        self._traced: Dict[tuple, TracedLayer] = {}
+
+    def _key(self, inputs):
+        return tuple((tuple(t.shape), str(np.dtype(t._value.dtype)))
+                     for t in inputs)
+
+    def __call__(self, *inputs):
+        if _recorder() is not None:
+            # nested inside an active trace: run the python body eagerly
+            # so its ops are recorded into the OUTER program (a nested
+            # trace would either deadlock the guard or hide these ops
+            # behind an Executor call)
+            return self._fn(*inputs)
+        inputs = _as_tensors(list(inputs))
+        key = self._key(inputs)
+        tl = self._traced.get(key)
+        if tl is None:
+            _, tl = TracedLayer.trace(self._fn, inputs)
+            self._traced[key] = tl
+        outs = tl(*inputs)
+        return outs[0] if len(outs) == 1 else outs
+
+    @property
+    def concrete_program(self):
+        if not self._traced:
+            raise RuntimeError("call the function once (or pass input_spec "
+                               "to jit.save) before reading the program")
+        return next(iter(self._traced.values()))
+
+
+def to_static(fn=None, input_spec=None):
+    """Decorator parity with paddle.jit.to_static (reference
+    dygraph_to_static/program_translator.py declarative)."""
+    if fn is None:
+        return lambda f: StaticFunction(f, input_spec)
+    return StaticFunction(fn, input_spec)
+
+
+declarative = to_static
+
+
+def _example_from_spec(spec):
+    from ..hapi.model import InputSpec
+
+    if isinstance(spec, InputSpec):
+        shape = [1 if (s is None or int(s) < 0) else int(s)
+                 for s in spec.shape]
+        return Tensor(np.zeros(shape, dtypes.to_np(spec.dtype)))
+    if isinstance(spec, Tensor):
+        return spec
+    return Tensor(np.asarray(spec))
+
+
+def save(layer, path, input_spec=None):
+    """paddle.jit.save (reference dygraph/jit.py:466): trace ``layer`` and
+    export an inference model to ``path`` (dir with model+params)."""
+    if isinstance(layer, StaticFunction):
+        fn = layer._fn
+        if input_spec is None:
+            input_spec = layer._input_spec  # @to_static(input_spec=...)
+    elif callable(layer):
+        fn = layer
+    else:
+        raise TypeError(f"cannot jit.save {type(layer)}")
+    if input_spec is None:
+        raise ValueError(
+            "jit.save needs input_spec (InputSpec list or example tensors) "
+            "to trace the forward")
+    inputs = [_example_from_spec(s) for s in input_spec]
+    _, tl = TracedLayer.trace(fn, inputs)
+    tl.save_inference_model(path)
+    return tl
+
+
+class TranslatedLayer:
+    """Loaded counterpart of jit.save (reference TranslatedLayer): a
+    callable over the compile-once Predictor."""
+
+    def __init__(self, predictor):
+        self._predictor = predictor
+
+    def __call__(self, *inputs):
+        arrays = [t._value if isinstance(t, Tensor) else np.asarray(t)
+                  for t in inputs]
+        outs = self._predictor.run(arrays)
+        ts = [Tensor(o) for o in outs]
+        return ts[0] if len(ts) == 1 else ts
+
+    def eval(self):
+        return self
+
+    def train(self):
+        raise RuntimeError("a loaded inference program cannot be trained; "
+                           "retrain from the dygraph Layer and re-save")
+
+
+def load(path):
+    """paddle.jit.load: inference model dir -> callable TranslatedLayer."""
+    from ..inference import Config, create_predictor
+
+    cfg = Config(path)
+    return TranslatedLayer(create_predictor(cfg))

@@ -197,8 +197,25 @@ class Model:
 
     # -- persistence -----------------------------------------------------
     def save(self, path, training=True):
-        """state-dict save (reference Model.save; `training=False` export
-        is the jit.save path, milestone: inference)."""
+        """Reference Model.save: ``training=True`` saves a state dict (+
+        optimizer state); ``training=False`` exports a servable inference
+        model via the trace-based jit.save path (hapi/model.py:199)."""
+        if not training:
+            from .. import jit
+
+            if not self._inputs:
+                raise ValueError(
+                    "Model.save(training=False) needs the Model to be "
+                    "constructed with `inputs=[InputSpec(...)]` so the "
+                    "forward can be traced for export")
+            was_training = getattr(self.network, "training", False)
+            self.network.eval()
+            try:
+                jit.save(self.network, path, input_spec=self._inputs)
+            finally:
+                if was_training:
+                    self.network.train()
+            return
         dirname = os.path.dirname(path)
         if dirname:
             os.makedirs(dirname, exist_ok=True)

@@ -1,0 +1,16 @@
+"""``paddle.jit`` namespace (reference python/paddle/jit/__init__.py,
+re-exporting the dygraph jit machinery: fluid/dygraph/jit.py +
+dygraph_to_static's to_static entry point — here trace-based, see
+dygraph/jit.py)."""
+from ..dygraph.jit import (  # noqa: F401
+    StaticFunction,
+    TracedLayer,
+    TranslatedLayer,
+    declarative,
+    load,
+    save,
+    to_static,
+)
+
+__all__ = ["save", "load", "to_static", "declarative", "TracedLayer",
+           "TranslatedLayer", "StaticFunction"]
