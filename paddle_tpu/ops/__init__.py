@@ -11,11 +11,18 @@ from . import (  # noqa: F401
     creation,
     fused,
     grad_generic,
+    interp_ops,
+    linalg_ops,
+    loss_ops,
     math_ops,
     misc,
+    misc_ops,
     nn_ops,
     optimizer_ops,
+    rnn_ops,
+    sequence_ops,
     tensor_ops,
+    vision_ops,
 )
 
 from ..framework.lowering import LOWERINGS

@@ -1,0 +1,146 @@
+"""Image interpolation ops: nearest/linear/bilinear/bicubic/trilinear.
+
+Reference parity: operators/interpolate_op.cc (+ *_v2 variants) — on TPU
+these are gathers/weighted gathers XLA vectorizes; align_corners follows
+the reference coordinate transforms exactly so OpTest parity holds.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.lowering import register_lower
+
+
+def _out_hw(op, in_hw, ndim):
+    """Resolve target spatial size: OutSize/SizeTensor input > out_* attrs
+    > scale (attr or Scale input)."""
+    names = ["out_d", "out_h", "out_w"][-ndim:]
+    sizes = [int(op.attr(n, -1) or -1) for n in names]
+    if all(s > 0 for s in sizes):
+        return sizes
+    scale = op.attr("scale", None)
+    if isinstance(scale, (list, tuple)) and scale:
+        return [int(round(s * f)) for s, f in zip(in_hw, scale)]
+    if isinstance(scale, (int, float)) and scale > 0:
+        return [int(round(s * float(scale))) for s in in_hw]
+    raise NotImplementedError(
+        "interpolate needs static out_h/out_w or scale attrs (dynamic "
+        "OutSize tensors do not fit XLA static shapes; resolve upstream)")
+
+
+def _src_index(out_len, in_len, align_corners, align_mode,
+               dtype=jnp.float32, clip=True):
+    i = jnp.arange(out_len, dtype=dtype)
+    if align_corners:
+        ratio = (in_len - 1) / max(out_len - 1, 1)
+        return i * ratio
+    ratio = in_len / out_len
+    if align_mode == 0:
+        src = ratio * (i + 0.5) - 0.5
+        # bilinear kernels clamp negative src at 0 (reference
+        # interpolate_op.h); bicubic keeps the negative coordinate and
+        # clamps the GATHERS instead (clip=False)
+        return jnp.clip(src, 0.0, None) if clip else src
+    return ratio * i
+
+
+def _linear_axis(x, axis, out_len, align_corners, align_mode):
+    in_len = x.shape[axis]
+    src = _src_index(out_len, in_len, align_corners, align_mode)
+    lo = jnp.floor(src).astype(jnp.int32)
+    hi = jnp.clip(lo + 1, 0, in_len - 1)
+    lo = jnp.clip(lo, 0, in_len - 1)
+    w = (src - lo).astype(x.dtype)
+    xl = jnp.take(x, lo, axis=axis)
+    xh = jnp.take(x, hi, axis=axis)
+    shape = [1] * x.ndim
+    shape[axis] = out_len
+    w = w.reshape(shape)
+    return xl * (1 - w) + xh * w
+
+
+def _nearest_axis(x, axis, out_len, align_corners):
+    in_len = x.shape[axis]
+    if align_corners:
+        src = jnp.round(_src_index(out_len, in_len, True, 1))
+    else:
+        src = jnp.floor(jnp.arange(out_len) * (in_len / out_len))
+    idx = jnp.clip(src.astype(jnp.int32), 0, in_len - 1)
+    return jnp.take(x, idx, axis=axis)
+
+
+def _cubic_axis(x, axis, out_len, align_corners):
+    in_len = x.shape[axis]
+    src = _src_index(out_len, in_len, align_corners, 0, clip=False)
+    i0 = jnp.floor(src).astype(jnp.int32)
+    t = (src - i0).astype(x.dtype)
+    a = -0.75
+    # standard keys cubic weights
+    def w(d):
+        d = jnp.abs(d)
+        return jnp.where(
+            d <= 1, (a + 2) * d ** 3 - (a + 3) * d ** 2 + 1,
+            jnp.where(d < 2, a * d ** 3 - 5 * a * d ** 2 + 8 * a * d - 4 * a,
+                      jnp.zeros_like(d)))
+    shape = [1] * x.ndim
+    shape[axis] = out_len
+    out = 0.0
+    for k in range(-1, 3):
+        idx = jnp.clip(i0 + k, 0, in_len - 1)
+        out = out + jnp.take(x, idx, axis=axis) * w(t - k).reshape(shape)
+    return out
+
+
+def _interp(ctx, op, method, nd):
+    x = ctx.in1(op, "X")  # NCHW / NCDHW / NCW
+    data_layout = op.attr("data_layout", "NCHW") or "NCHW"
+    channel_last = data_layout.endswith("C") and len(data_layout) == x.ndim
+    if channel_last:
+        perm = (0, x.ndim - 1) + tuple(range(1, x.ndim - 1))
+        x = jnp.transpose(x, perm)
+    in_hw = x.shape[2:]
+    out_hw = _out_hw(op, in_hw, nd)
+    align_corners = bool(op.attr("align_corners", True))
+    align_mode = int(op.attr("align_mode", 1))
+    y = x
+    for i, (o, s) in enumerate(zip(out_hw, in_hw)):
+        axis = 2 + i
+        if o == s:
+            continue
+        if method == "nearest":
+            y = _nearest_axis(y, axis, o, align_corners)
+        elif method == "cubic":
+            y = _cubic_axis(y, axis, o, align_corners)
+        else:
+            y = _linear_axis(y, axis, o, align_corners, align_mode)
+    if channel_last:
+        inv = (0,) + tuple(range(2, x.ndim)) + (1,)
+        y = jnp.transpose(y, inv)
+    ctx.set_out(op, "Out", y)
+
+
+@register_lower("nearest_interp", "nearest_interp_v2")
+def _nearest_interp(ctx, op):
+    _interp(ctx, op, "nearest", 2)
+
+
+@register_lower("bilinear_interp", "bilinear_interp_v2")
+def _bilinear_interp(ctx, op):
+    _interp(ctx, op, "linear", 2)
+
+
+@register_lower("bicubic_interp", "bicubic_interp_v2")
+def _bicubic_interp(ctx, op):
+    _interp(ctx, op, "cubic", 2)
+
+
+@register_lower("trilinear_interp", "trilinear_interp_v2")
+def _trilinear_interp(ctx, op):
+    _interp(ctx, op, "linear", 3)
+
+
+@register_lower("linear_interp", "linear_interp_v2")
+def _linear_interp(ctx, op):
+    _interp(ctx, op, "linear", 1)

@@ -1,0 +1,189 @@
+"""Linear-algebra & tensor-math ops.
+
+Reference parity: operators/{cholesky,inverse,addmm,mv,kron,cross,dist,
+trace,logsumexp,norm,multiplex,unbind,...}_op.cc — direct jnp/lax
+mappings; gradients via the generic vjp fallback (jax ships VJPs for the
+decompositions).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.lowering import register_lower
+
+
+@register_lower("cholesky")
+def _cholesky(ctx, op):
+    x = ctx.in1(op, "X")
+    upper = bool(op.attr("upper", False))
+    l = jnp.linalg.cholesky(x)
+    ctx.set_out(op, "Out", jnp.swapaxes(l, -1, -2) if upper else l)
+
+
+@register_lower("inverse")
+def _inverse(ctx, op):
+    ctx.set_out(op, "Output", jnp.linalg.inv(ctx.in1(op, "Input")))
+
+
+@register_lower("addmm")
+def _addmm(ctx, op):
+    inp = ctx.in1(op, "Input")
+    x = ctx.in1(op, "X")
+    y = ctx.in1(op, "Y")
+    alpha = float(op.attr("Alpha", 1.0))
+    beta = float(op.attr("Beta", 1.0))
+    ctx.set_out(op, "Out", beta * inp + alpha * (x @ y))
+
+
+@register_lower("mv")
+def _mv(ctx, op):
+    ctx.set_out(op, "Out", ctx.in1(op, "X") @ ctx.in1(op, "Vec"))
+
+
+@register_lower("kron")
+def _kron(ctx, op):
+    ctx.set_out(op, "Out", jnp.kron(ctx.in1(op, "X"), ctx.in1(op, "Y")))
+
+
+@register_lower("cross")
+def _cross(ctx, op):
+    x = ctx.in1(op, "X")
+    y = ctx.in1(op, "Y")
+    dim = op.attr("dim", None)
+    if dim is None or int(dim) == -2147483648:  # INT_MIN sentinel: first dim-3
+        dim = next(i for i, s in enumerate(x.shape) if s == 3)
+    ctx.set_out(op, "Out", jnp.cross(x, y, axis=int(dim)))
+
+
+@register_lower("dist")
+def _dist(ctx, op):
+    x = ctx.in1(op, "X")
+    y = ctx.in1(op, "Y")
+    p = float(op.attr("p", 2.0))
+    d = jnp.abs(x - y)
+    if p == float("inf"):
+        out = jnp.max(d)
+    elif p == float("-inf"):
+        out = jnp.min(d)
+    elif p == 0:
+        out = jnp.sum((d != 0).astype(x.dtype))
+    else:
+        out = jnp.power(jnp.sum(jnp.power(d, p)), 1.0 / p)
+    ctx.set_out(op, "Out", out)
+
+
+@register_lower("trace")
+def _trace(ctx, op):
+    x = ctx.in1(op, "Input")
+    ctx.set_out(op, "Out", jnp.trace(
+        x, offset=int(op.attr("offset", 0)),
+        axis1=int(op.attr("axis1", 0)), axis2=int(op.attr("axis2", 1))))
+
+
+@register_lower("logsumexp")
+def _logsumexp(ctx, op):
+    x = ctx.in1(op, "X")
+    axis = op.attr("axis", [0]) or None
+    if bool(op.attr("reduce_all", False)):
+        axis = None
+    else:
+        axis = tuple(int(a) for a in axis)
+    ctx.set_out(op, "Out", jax.scipy.special.logsumexp(
+        x, axis=axis, keepdims=bool(op.attr("keepdim", False))))
+
+
+@register_lower("norm")
+def _norm(ctx, op):
+    """L2-normalize along axis (reference norm_op.cc: Out = X / norm)."""
+    x = ctx.in1(op, "X")
+    axis = int(op.attr("axis", -1))
+    eps = float(op.attr("epsilon", 1e-10))
+    n = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True) + eps)
+    ctx.set_out(op, "Out", x / n)
+    ctx.set_out(op, "Norm", n)
+
+
+@register_lower("multiplex")
+def _multiplex(ctx, op):
+    ids = ctx.in1(op, "Ids")  # [N, 1]
+    xs = ctx.in_list(op, "X")
+    stacked = jnp.stack(xs)  # [K, N, D]
+    idx = ids.reshape(-1).astype(jnp.int32)
+    out = stacked[idx, jnp.arange(stacked.shape[1])]
+    ctx.set_out(op, "Out", out)
+
+
+@register_lower("unbind")
+def _unbind(ctx, op):
+    x = ctx.in1(op, "X")
+    axis = int(op.attr("axis", 0))
+    outs = [jnp.squeeze(s, axis) for s in jnp.split(x, x.shape[axis], axis)]
+    for name, val in zip(op.outputs.get("Out", []), outs):
+        ctx.set(name, val)
+
+
+@register_lower("minus")
+def _minus(ctx, op):
+    ctx.set_out(op, "Out", ctx.in1(op, "X") - ctx.in1(op, "Y"))
+
+
+@register_lower("partial_sum")
+def _partial_sum(ctx, op):
+    xs = ctx.in_list(op, "X")
+    start = int(op.attr("start_index", 0))
+    length = int(op.attr("length", -1))
+    end = None if length < 0 else start + length
+    ctx.set_out(op, "Out", sum(x[:, start:end] for x in xs))
+
+
+@register_lower("partial_concat")
+def _partial_concat(ctx, op):
+    xs = ctx.in_list(op, "X")
+    start = int(op.attr("start_index", 0))
+    length = int(op.attr("length", -1))
+    end = None if length < 0 else start + length
+    ctx.set_out(op, "Out", jnp.concatenate([x[:, start:end] for x in xs],
+                                           axis=1))
+
+
+@register_lower("segment_pool")
+def _segment_pool(ctx, op):
+    x = ctx.in1(op, "X")
+    seg = ctx.in1(op, "SegmentIds").astype(jnp.int32)
+    pooltype = op.attr("pooltype", "SUM")
+    n = x.shape[0]  # segments bounded by row count (static shape)
+    if pooltype == "SUM":
+        out = jax.ops.segment_sum(x, seg, num_segments=n)
+    elif pooltype == "MEAN":
+        s = jax.ops.segment_sum(x, seg, num_segments=n)
+        cnt = jax.ops.segment_sum(jnp.ones((x.shape[0],), x.dtype), seg,
+                                  num_segments=n)
+        out = s / jnp.maximum(cnt, 1.0)[:, None]
+    elif pooltype == "MAX":
+        out = jax.ops.segment_max(x, seg, num_segments=n)
+    else:
+        out = jax.ops.segment_min(x, seg, num_segments=n)
+    ctx.set_out(op, "Out", out)
+    ctx.set_out(op, "SummedIds", jax.ops.segment_sum(
+        jnp.ones((x.shape[0], 1), x.dtype), seg, num_segments=n))
+
+
+@register_lower("gather_tree")
+def _gather_tree(ctx, op):
+    """Beam-search ancestry walk (reference gather_tree_op.cc): ids/parents
+    [T, B, W] -> full beams re-threaded from the last step backwards."""
+    ids = ctx.in1(op, "Ids")
+    parents = ctx.in1(op, "Parents")
+    t, b, w = ids.shape
+    binx = jnp.arange(b)[:, None]
+
+    def step(parent, tup):
+        id_t, par_t = tup
+        out = id_t[binx, parent]
+        nxt = par_t[binx, parent]
+        return nxt, out
+
+    init = jnp.tile(jnp.arange(w)[None, :], (b, 1))
+    _, outs = jax.lax.scan(step, init, (ids[::-1], parents[::-1]))
+    ctx.set_out(op, "Out", outs[::-1])

@@ -1,0 +1,207 @@
+"""Loss ops beyond the softmax/cross-entropy family.
+
+Reference parity: operators/{bce_loss,nll_loss,kldiv_loss,log_loss,
+hinge_loss,rank_loss,margin_rank_loss,smooth_l1_loss,sigmoid_focal_loss,
+bpr_loss,warpctc,...}_op.cc — each a few jnp lines on TPU; gradients come
+from the generic vjp fallback.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.lowering import register_lower
+
+
+@register_lower("bce_loss")
+def _bce_loss(ctx, op):
+    x = ctx.in1(op, "X")  # probabilities
+    label = ctx.in1(op, "Label")
+    eps = 1e-12
+    xc = jnp.clip(x, eps, 1.0 - eps)
+    out = -(label * jnp.log(xc) + (1.0 - label) * jnp.log1p(-xc))
+    ctx.set_out(op, "Out", out)
+
+
+@register_lower("nll_loss")
+def _nll_loss(ctx, op):
+    x = ctx.in1(op, "X")  # log-probabilities [N, C, ...]
+    label = ctx.in1(op, "Label")
+    weight = ctx.in1(op, "Weight")
+    ignore_index = int(op.attr("ignore_index", -100))
+    reduction = op.attr("reduction", "mean")
+    safe = jnp.clip(label, 0, x.shape[1] - 1)
+    picked = jnp.take_along_axis(x, safe[:, None], axis=1)[:, 0]
+    w = weight[safe] if weight is not None else jnp.ones_like(picked)
+    w = jnp.where(label == ignore_index, jnp.zeros_like(w), w)
+    loss = -picked * w
+    total_w = jnp.sum(w)
+    if reduction == "mean":
+        out = jnp.sum(loss) / jnp.maximum(total_w, 1e-12)
+    elif reduction == "sum":
+        out = jnp.sum(loss)
+    else:
+        out = loss
+    ctx.set_out(op, "Out", out)
+    ctx.set_out(op, "Total_weight", total_w)
+
+
+@register_lower("kldiv_loss")
+def _kldiv_loss(ctx, op):
+    x = ctx.in1(op, "X")  # log-probabilities
+    target = ctx.in1(op, "Target")
+    reduction = op.attr("reduction", "mean")
+    loss = jnp.where(target > 0, target * (jnp.log(jnp.maximum(target, 1e-12)) - x),
+                     jnp.zeros_like(target))
+    if reduction == "mean":
+        out = jnp.mean(loss)
+    elif reduction == "sum":
+        out = jnp.sum(loss)
+    elif reduction == "batchmean":
+        out = jnp.sum(loss) / x.shape[0]
+    else:
+        out = loss
+    ctx.set_out(op, "Loss", out)
+
+
+@register_lower("log_loss")
+def _log_loss(ctx, op):
+    p = ctx.in1(op, "Predicted")
+    label = ctx.in1(op, "Labels")
+    eps = float(op.attr("epsilon", 1e-4))
+    out = -label * jnp.log(p + eps) - (1.0 - label) * jnp.log(1.0 - p + eps)
+    ctx.set_out(op, "Loss", out)
+
+
+@register_lower("hinge_loss")
+def _hinge_loss(ctx, op):
+    logits = ctx.in1(op, "Logits")
+    labels = ctx.in1(op, "Labels")
+    out = jnp.maximum(1.0 - (2.0 * labels - 1.0) * logits, 0.0)
+    ctx.set_out(op, "Loss", out)
+
+
+@register_lower("rank_loss")
+def _rank_loss(ctx, op):
+    label = ctx.in1(op, "Label")
+    left = ctx.in1(op, "Left")
+    right = ctx.in1(op, "Right")
+    d = left - right
+    out = jnp.logaddexp(0.0, d) - label * d
+    ctx.set_out(op, "Out", out)
+
+
+@register_lower("margin_rank_loss")
+def _margin_rank_loss(ctx, op):
+    label = ctx.in1(op, "Label")
+    x1 = ctx.in1(op, "X1")
+    x2 = ctx.in1(op, "X2")
+    margin = float(op.attr("margin", 0.0))
+    out = jnp.maximum(-label * (x1 - x2) + margin, 0.0)
+    ctx.set_out(op, "Out", out)
+    ctx.set_out(op, "Activated", (out > 0).astype(x1.dtype))
+
+
+@register_lower("smooth_l1_loss")
+def _smooth_l1_loss(ctx, op):
+    x = ctx.in1(op, "X")
+    y = ctx.in1(op, "Y")
+    in_w = ctx.in1(op, "InsideWeight")
+    out_w = ctx.in1(op, "OutsideWeight")
+    sigma = float(op.attr("sigma", 1.0))
+    s2 = sigma * sigma
+    d = x - y
+    if in_w is not None:
+        d = d * in_w
+    ad = jnp.abs(d)
+    loss = jnp.where(ad < 1.0 / s2, 0.5 * d * d * s2, ad - 0.5 / s2)
+    if out_w is not None:
+        loss = loss * out_w
+    ctx.set_out(op, "Diff", d)
+    ctx.set_out(op, "Out", jnp.sum(loss, axis=tuple(range(1, loss.ndim)),
+                                   keepdims=loss.ndim > 1)
+                if loss.ndim > 1 else loss)
+
+
+@register_lower("sigmoid_focal_loss")
+def _sigmoid_focal_loss(ctx, op):
+    x = ctx.in1(op, "X")  # [N, C] logits
+    label = ctx.in1(op, "Label")  # [N, 1] int; 0 = background
+    fg_num = ctx.in1(op, "FgNum")
+    gamma = float(op.attr("gamma", 2.0))
+    alpha = float(op.attr("alpha", 0.25))
+    n, c = x.shape
+    # target[i, j] = 1 if label[i] == j+1 (classes are 1-based; 0 = bg)
+    tgt = (label.reshape(-1, 1) == jnp.arange(1, c + 1)[None, :]).astype(x.dtype)
+    p = jax.nn.sigmoid(x)
+    ce = jnp.maximum(x, 0) - x * tgt + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    p_t = p * tgt + (1.0 - p) * (1.0 - tgt)
+    a_t = alpha * tgt + (1.0 - alpha) * (1.0 - tgt)
+    fg = jnp.maximum(fg_num.astype(x.dtype).reshape(()), 1.0)
+    out = a_t * jnp.power(1.0 - p_t, gamma) * ce / fg
+    ctx.set_out(op, "Out", out)
+
+
+@register_lower("bpr_loss")
+def _bpr_loss(ctx, op):
+    x = ctx.in1(op, "X")  # [N, C]
+    label = ctx.in1(op, "Label")  # [N, 1]
+    n, c = x.shape
+    pos = jnp.take_along_axis(x, label.reshape(-1, 1), axis=1)
+    diff = pos - x  # [N, C]
+    lse = jnp.logaddexp(0.0, -diff)  # stable: log(1+exp(-diff))
+    mask = jnp.ones((n, c), x.dtype).at[
+        jnp.arange(n), label.reshape(-1)].set(0.0)
+    out = jnp.sum(lse * mask, axis=1, keepdims=True) / (c - 1)
+    ctx.set_out(op, "Y", out)
+
+
+@register_lower("l1_norm")
+def _l1_norm(ctx, op):
+    x = ctx.in1(op, "X")
+    ctx.set_out(op, "Out", jnp.sum(jnp.abs(x)))
+
+
+@register_lower("cos_sim")
+def _cos_sim(ctx, op):
+    x = ctx.in1(op, "X")
+    y = ctx.in1(op, "Y")
+    xn = jnp.sqrt(jnp.sum(jnp.square(x), axis=-1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(jnp.square(y), axis=-1, keepdims=True))
+    out = jnp.sum(x * y, axis=-1, keepdims=True) / jnp.maximum(xn * yn, 1e-12)
+    ctx.set_out(op, "Out", out)
+    ctx.set_out(op, "XNorm", xn)
+    ctx.set_out(op, "YNorm", yn)
+
+
+@register_lower("warpctc")
+def _warpctc(ctx, op):
+    """CTC loss (reference warpctc_op.cc wrapping the warp-ctc lib).
+    TPU-native: optax.ctc_loss on dense [B, T, C] logits with
+    length tensors (the v2 padded interface)."""
+    import optax
+
+    logits = ctx.in1(op, "Logits")
+    label = ctx.in1(op, "Label")
+    logits_len = ctx.in1(op, "LogitsLength")
+    label_len = ctx.in1(op, "LabelLength")
+    blank = int(op.attr("blank", 0))
+    norm_by_times = bool(op.attr("norm_by_times", False))
+    if logits_len is None or label_len is None:
+        raise NotImplementedError(
+            "warpctc requires LogitsLength/LabelLength (padded dense "
+            "interface); LoD-style inputs are not supported on TPU")
+    # optax wants [B, T, C]; paddle's padded interface is [T, B, C]
+    lp = jax.nn.log_softmax(jnp.transpose(logits, (1, 0, 2)), axis=-1)
+    t = lp.shape[1]
+    logit_pad = (jnp.arange(t)[None, :] >= logits_len.reshape(-1, 1)
+                 ).astype(lp.dtype)
+    lm = label.shape[1]
+    label_pad = (jnp.arange(lm)[None, :] >= label_len.reshape(-1, 1)
+                 ).astype(lp.dtype)
+    loss = optax.ctc_loss(lp, logit_pad, label.astype(jnp.int32), label_pad,
+                          blank_id=blank)
+    if norm_by_times:
+        loss = loss / jnp.maximum(logits_len.astype(loss.dtype), 1.0)
+    ctx.set_out(op, "Loss", loss.reshape(-1, 1))
+    ctx.set_out(op, "WarpCTCGrad", jnp.zeros_like(logits))

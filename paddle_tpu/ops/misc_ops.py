@@ -1,0 +1,367 @@
+"""Misc op batch: dense LoD shims, conv-transpose variants, TensorArray
+ops, affine_grid, unpool, host-callback py_func, and friends.
+
+Reference parity noted per op.  Gradients via generic vjp fallback.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.lowering import LOWERINGS, register_lower
+
+
+@register_lower("lod_reset")
+def _lod_reset(ctx, op):
+    # dense tensors carry no LoD: pass-through (reference lod_reset_op
+    # only rewrites metadata)
+    ctx.set_out(op, "Out", ctx.in1(op, "X"))
+
+
+@register_lower("get_tensor_from_selected_rows", "merge_selected_rows")
+def _selected_rows_passthrough(ctx, op):
+    # SelectedRows lower to dense on TPU (SURVEY §7): both ops are identity
+    ctx.set_out(op, "Out", ctx.in1(op, "X"))
+
+
+@register_lower("depthwise_conv2d_transpose")
+def _depthwise_conv2d_transpose(ctx, op):
+    LOWERINGS["conv2d_transpose"](ctx, op)
+
+
+@register_lower("conv3d_transpose")
+def _conv3d_transpose(ctx, op):
+    x = ctx.in1(op, "Input")  # NCDHW
+    w = ctx.in1(op, "Filter")  # [in, out, kd, kh, kw]
+    strides = [int(s) for s in op.attr("strides", [1, 1, 1])]
+    dilations = [int(d) for d in op.attr("dilations", [1, 1, 1])]
+    paddings = [int(p) for p in op.attr("paddings", [0, 0, 0])]
+    ksize = w.shape[2:]
+    pads = [((k - 1) * d - p, (k - 1) * d - p)
+            for k, d, p in zip(ksize, dilations, paddings)]
+    out = jax.lax.conv_transpose(
+        x, w, strides=strides, padding=pads, rhs_dilation=dilations,
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"), transpose_kernel=True)
+    ctx.set_out(op, "Output", out)
+
+
+@register_lower("conv_shift")
+def _conv_shift(ctx, op):
+    """Circular correlation (reference conv_shift_op): X [B, D], Y [B, K]."""
+    x = ctx.in1(op, "X")
+    y = ctx.in1(op, "Y")
+    b, d = x.shape
+    k = y.shape[1]
+    half = k // 2
+    idx = (jnp.arange(d)[:, None] + jnp.arange(-half, k - half)[None, :]) % d
+    ctx.set_out(op, "Out", jnp.einsum("bdk,bk->bd", x[:, idx], y))
+
+
+@register_lower("fsp")
+def _fsp(ctx, op):
+    """FSP matrix for distillation (reference fsp_op): mean over H*W of
+    outer products between channel maps."""
+    x = ctx.in1(op, "X")  # [N, Cx, H, W]
+    y = ctx.in1(op, "Y")  # [N, Cy, H, W]
+    hw = x.shape[2] * x.shape[3]
+    out = jnp.einsum("nchw,ndhw->ncd", x, y) / hw
+    ctx.set_out(op, "Out", out)
+
+
+@register_lower("data_norm")
+def _data_norm(ctx, op):
+    """Global data normalization (reference data_norm_op): running
+    size/sum/squared-sum stats produce mean/scale."""
+    x = ctx.in1(op, "X")
+    bsize = ctx.in1(op, "BatchSize")
+    bsum = ctx.in1(op, "BatchSum")
+    bsq = ctx.in1(op, "BatchSquareSum")
+    eps = float(op.attr("epsilon", 1e-4))
+    mean = bsum / bsize
+    scale = jnp.sqrt(bsize / (bsq - bsum * mean + eps))
+    y = (x - mean) * scale
+    ctx.set_out(op, "Y", y)
+    ctx.set_out(op, "Means", jnp.broadcast_to(mean, x.shape))
+    ctx.set_out(op, "Scales", jnp.broadcast_to(scale, x.shape))
+
+
+@register_lower("affine_grid")
+def _affine_grid(ctx, op):
+    """theta [N, 2, 3] -> sampling grid [N, H, W, 2] (reference
+    affine_grid_op, align_corners=True semantics)."""
+    theta = ctx.in1(op, "Theta")
+    shape = op.attr("output_shape", [])
+    osize = ctx.in1(op, "OutputShape")
+    if osize is not None:
+        shape = [int(v) for v in np.asarray(osize)]
+    n, _c, h, w = (int(s) for s in shape)
+    ys = jnp.linspace(-1.0, 1.0, h)
+    xs = jnp.linspace(-1.0, 1.0, w)
+    if not bool(op.attr("align_corners", True)):
+        # pixel-center convention: shrink extremes by (size-1)/size
+        ys = ys * (h - 1) / h
+        xs = xs * (w - 1) / w
+    gx, gy = jnp.meshgrid(xs, ys)  # [H, W]
+    ones = jnp.ones_like(gx)
+    base = jnp.stack([gx, gy, ones], axis=-1)  # [H, W, 3]
+    out = jnp.einsum("hwk,njk->nhwj", base, theta)
+    ctx.set_out(op, "Output", out)
+
+
+@register_lower("unpool")
+def _unpool(ctx, op):
+    """Max unpooling by stored flat indices (reference unpool_op)."""
+    x = ctx.in1(op, "X")  # [N, C, H, W]
+    idx = ctx.in1(op, "Indices")  # flat h*w indices into the output map
+    ksize = [int(k) for k in op.attr("ksize", [2, 2])]
+    strides = [int(s) for s in op.attr("strides", [2, 2])]
+    paddings = [int(p) for p in op.attr("paddings", [0, 0])]
+    n, c, h, w = x.shape
+    oh = (h - 1) * strides[0] - 2 * paddings[0] + ksize[0]
+    ow = (w - 1) * strides[1] - 2 * paddings[1] + ksize[1]
+    flat = jnp.zeros((n, c, oh * ow), x.dtype)
+    out = flat.at[
+        jnp.arange(n)[:, None, None], jnp.arange(c)[None, :, None],
+        idx.reshape(n, c, -1)].add(x.reshape(n, c, -1))
+    ctx.set_out(op, "Out", out.reshape(n, c, oh, ow))
+
+
+@register_lower("center_loss")
+def _center_loss(ctx, op):
+    x = ctx.in1(op, "X")  # [N, D] features
+    label = ctx.in1(op, "Label")
+    centers = ctx.in1(op, "Centers")  # [C, D]
+    update_rate = ctx.in1(op, "CenterUpdateRate")
+    need_update = bool(op.attr("need_update", True))
+    lbl = label.reshape(-1)
+    picked = centers[lbl]
+    diff = x - picked
+    loss = 0.5 * jnp.sum(jnp.square(diff), axis=1, keepdims=True)
+    ctx.set_out(op, "Loss", loss)
+    ctx.set_out(op, "SampleCenterDiff", diff)
+    if need_update:
+        cnt = jnp.zeros((centers.shape[0],), x.dtype).at[lbl].add(1.0)
+        upd = jnp.zeros_like(centers).at[lbl].add(diff)
+        alpha = update_rate.reshape(()) if update_rate is not None else 0.5
+        new_centers = centers + alpha * upd / (cnt[:, None] + 1.0)
+        ctx.set_out(op, "CentersOut", new_centers)
+    else:
+        ctx.set_out(op, "CentersOut", centers)
+
+
+@register_lower("shuffle_batch")
+def _shuffle_batch(ctx, op):
+    x = ctx.in1(op, "X")
+    perm = jax.random.permutation(ctx.next_key(), x.shape[0])
+    ctx.set_out(op, "Out", x[perm])
+    ctx.set_out(op, "ShuffleIdx", perm.astype(jnp.int64))
+
+
+@register_lower("batch_fc")
+def _batch_fc(ctx, op):
+    x = ctx.in1(op, "Input")  # [B, N, D]
+    w = ctx.in1(op, "W")  # [B, D, O]
+    bias = ctx.in1(op, "Bias")  # [B, 1, O]
+    out = jnp.einsum("bnd,bdo->bno", x, w)
+    if bias is not None:
+        out = out + bias
+    ctx.set_out(op, "Out", out)
+
+
+@register_lower("select_input")
+def _select_input(ctx, op):
+    xs = ctx.in_list(op, "X")
+    mask = ctx.in1(op, "Mask").reshape(()).astype(jnp.int32)
+    out = xs[0]
+    for i, x in enumerate(xs[1:], start=1):
+        out = jnp.where(mask == i, x, out)
+    ctx.set_out(op, "Out", out)
+
+
+@register_lower("select_output")
+def _select_output(ctx, op):
+    x = ctx.in1(op, "X")
+    mask = ctx.in1(op, "Mask").reshape(()).astype(jnp.int32)
+    for i, name in enumerate(op.outputs.get("Out", [])):
+        # each branch output gets x where selected, zeros otherwise (the
+        # consuming conditional_block reads only the live branch)
+        ctx.set(name, jnp.where(mask == i, x, jnp.zeros_like(x)))
+
+
+# --- TensorArray ops: the env holds a python list at trace time -------
+# (reference lod_tensor_array; usable with statically-unrolled loops —
+# lax.while_loop bodies need fixed-shape carries instead)
+
+
+@register_lower("write_to_array")
+def _write_to_array(ctx, op):
+    x = ctx.in1(op, "X")
+    i = int(np.asarray(ctx.in1(op, "I")).ravel()[0])
+    name = op.outputs["Out"][0]
+    arr = list(ctx.env.get(name, []))
+    while len(arr) <= i:
+        arr.append(None)
+    arr[i] = x
+    ctx.set(name, arr)
+
+
+@register_lower("read_from_array")
+def _read_from_array(ctx, op):
+    arr = ctx.get(op.inputs["X"][0])
+    i = int(np.asarray(ctx.in1(op, "I")).ravel()[0])
+    ctx.set_out(op, "Out", arr[i])
+
+
+@register_lower("lod_array_length")
+def _lod_array_length(ctx, op):
+    arr = ctx.get(op.inputs["X"][0])
+    ctx.set_out(op, "Out", jnp.asarray([len(arr)], jnp.int64))
+
+
+@register_lower("array_to_lod_tensor")
+def _array_to_lod_tensor(ctx, op):
+    arr = ctx.get(op.inputs["X"][0])
+    ctx.set_out(op, "Out", jnp.concatenate([jnp.atleast_1d(a) for a in arr],
+                                           axis=0))
+
+
+@register_lower("lod_tensor_to_array")
+def _lod_tensor_to_array(ctx, op):
+    x = ctx.in1(op, "X")
+    ctx.set_out(op, "Out", [x[i] for i in range(x.shape[0])])
+
+
+@register_lower("py_func")
+def _py_func(ctx, op):
+    """Host-side python function embedded in the program (reference
+    py_func_op).  TPU-native: jax.pure_callback — the callable runs on
+    host per executable call; registered via misc_ops.register_py_func."""
+    fid = int(op.attr("forward_callable_id", op.attr("func_id", -1)))
+    fn = _PY_FUNCS.get(fid)
+    if fn is None:
+        raise NotImplementedError(
+            f"py_func id {fid} is not registered in this process; call "
+            f"paddle_tpu.ops.misc_ops.register_py_func")
+    xs = ctx.in_list(op, "X")
+    out_names = op.outputs.get("Out", [])
+    # shapes/dtypes must be declared on the output vars
+    specs = []
+    for n in out_names:
+        var = ctx.block._find_var_recursive(n)
+        from ..framework import dtypes as _dt
+
+        specs.append(jax.ShapeDtypeStruct(
+            tuple(int(s) for s in var.shape), _dt.to_np(var.dtype)))
+    outs = jax.pure_callback(lambda *a: fn(*a), tuple(specs), *xs)
+    for n, v in zip(out_names, outs):
+        ctx.set(n, v)
+
+
+_PY_FUNCS = {}
+
+
+def register_py_func(fid, fn):
+    _PY_FUNCS[fid] = fn
+
+
+@register_lower("diag", "diag_v2")
+def _diag(ctx, op):
+    x = ctx.in1(op, "X")
+    offset = int(op.attr("offset", 0))
+    pad = float(op.attr("padding_value", 0.0))
+    if x.ndim == 1:
+        out = jnp.diag(x, k=offset)
+        if pad:
+            mask = jnp.diag(jnp.ones_like(x), k=offset)
+            out = out + pad * (1 - mask)
+    else:
+        out = jnp.diagonal(x, offset=offset)
+    ctx.set_out(op, "Out", out)
+
+
+@register_lower("allclose")
+def _allclose(ctx, op):
+    x = ctx.in1(op, "Input")
+    y = ctx.in1(op, "Other")
+    rtol = float(op.attr("rtol", 1e-5) or 1e-5)
+    atol = float(op.attr("atol", 1e-8) or 1e-8)
+    ctx.set_out(op, "Out", jnp.allclose(
+        x, y, rtol=rtol, atol=atol,
+        equal_nan=bool(op.attr("equal_nan", False))))
+
+
+@register_lower("histogram")
+def _histogram(ctx, op):
+    x = ctx.in1(op, "X")
+    bins = int(op.attr("bins", 100))
+    lo = float(op.attr("min", 0))
+    hi = float(op.attr("max", 0))
+    if lo == 0 and hi == 0:
+        # reference uses data min/max; needs static range on TPU
+        raise NotImplementedError(
+            "histogram needs explicit min/max attrs on TPU (data-dependent "
+            "range is not XLA-static)")
+    h, _ = jnp.histogram(x.reshape(-1), bins=bins, range=(lo, hi))
+    ctx.set_out(op, "Out", h.astype(jnp.int64))
+
+
+@register_lower("bincount")
+def _bincount(ctx, op):
+    x = ctx.in1(op, "X")
+    w = ctx.in1(op, "Weights")
+    minlength = int(op.attr("minlength", 0))
+    # static length: bounded by minlength (callers must size it; dynamic
+    # max(x)+1 is not XLA-static)
+    if minlength <= 0:
+        raise NotImplementedError(
+            "bincount needs minlength > 0 on TPU (static output shape)")
+    out = jnp.bincount(x.reshape(-1).astype(jnp.int32),
+                       weights=None if w is None else w.reshape(-1),
+                       length=minlength)
+    ctx.set_out(op, "Out", out)
+
+
+@register_lower("broadcast_to")
+def _broadcast_to(ctx, op):
+    x = ctx.in1(op, "X")
+    shape = [int(s) for s in op.attr("shape", [])]
+    shape = [x.shape[i - (len(shape) - x.ndim)] if s == -1 and i >= len(shape) - x.ndim else s
+             for i, s in enumerate(shape)]
+    ctx.set_out(op, "Out", jnp.broadcast_to(x, shape))
+
+
+@register_lower("full_like")
+def _full_like(ctx, op):
+    x = ctx.in1(op, "X")
+    value = op.attr("value", 0.0)
+    dtype = op.attr("dtype", -1)
+    from ..framework import dtypes as _dt
+
+    dt = x.dtype if dtype in (-1, None) else _dt.to_jnp(dtype)
+    ctx.set_out(op, "Out", jnp.full(x.shape, value, dtype=dt))
+
+
+@register_lower("put_along_axis")
+def _put_along_axis(ctx, op):
+    x = ctx.in1(op, "Input")
+    idx = ctx.in1(op, "Index")
+    val = ctx.in1(op, "Value")
+    axis = int(op.attr("Axis", 0))
+    reduce = op.attr("Reduce", "assign")
+    val = jnp.broadcast_to(val, idx.shape).astype(x.dtype)
+    if reduce == "add":
+        out = _scatter_along_axis(x, idx, val, axis, "add")
+    elif reduce == "multiply" or reduce == "mul":
+        out = _scatter_along_axis(x, idx, val, axis, "mul")
+    else:
+        out = _scatter_along_axis(x, idx, val, axis, "set")
+    ctx.set_out(op, "Result", out)
+
+
+def _scatter_along_axis(x, idx, val, axis, mode):
+    grids = jnp.meshgrid(*[jnp.arange(s) for s in idx.shape], indexing="ij")
+    coords = list(grids)
+    coords[axis] = idx
+    at = x.at[tuple(coords)]
+    return {"add": at.add, "mul": at.multiply, "set": at.set}[mode](val)

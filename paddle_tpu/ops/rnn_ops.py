@@ -1,0 +1,261 @@
+"""Recurrent ops: the v2 `rnn` op (LSTM/GRU/simple, multi-layer, bidi)
+plus the fluid-era cell/sequence ops (gru_unit, lstm_unit, gru, lstm).
+
+Reference parity: operators/rnn_op.cc (cudnn-style fused RNN over
+time-major input with a flat WeightList), gru_unit_op.cc, lstm_unit_op.cc,
+gru_op.cc, lstm_op.cc.  TPU-native: one `lax.scan` per (layer, direction)
+— the recurrence compiles to a single fused loop; no cudnn descriptors,
+no Reserve workspace (XLA remat owns backward memory).
+
+WeightList layout (reference nn/layer/rnn.py flatten_parameters): all
+[w_ih, w_hh] pairs for each (layer, direction) first, then all
+[b_ih, b_hh] pairs in the same order.  Gate order: i,f,g,o for LSTM and
+r,z,n (reset-after, cudnn semantics) for GRU.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.lowering import register_lower
+
+
+def _lstm_cell(x_g, h, c, w_hh, b_hh):
+    gates = x_g + h @ w_hh.T + (b_hh if b_hh is not None else 0.0)
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+    g = jnp.tanh(g)
+    c2 = f * c + i * g
+    return jnp.tanh(c2) * o, c2
+
+
+def _gru_cell(x_g, h, w_hh, b_hh):
+    hg = h @ w_hh.T + (b_hh if b_hh is not None else 0.0)
+    xr, xz, xn = jnp.split(x_g, 3, axis=-1)
+    hr, hz, hn = jnp.split(hg, 3, axis=-1)
+    r = jax.nn.sigmoid(xr + hr)
+    z = jax.nn.sigmoid(xz + hz)
+    n = jnp.tanh(xn + r * hn)
+    return (1.0 - z) * n + z * h
+
+
+def _run_direction(x, h0, c0, w_ih, w_hh, b_ih, b_hh, mode, reverse):
+    """x: [T, B, I] time-major; returns (outs [T,B,H], hT, cT|None)."""
+    if reverse:
+        x = jnp.flip(x, axis=0)
+    # input projection for ALL steps at once -> one big MXU matmul
+    x_g = jnp.einsum("tbi,gi->tbg", x, w_ih)
+    if b_ih is not None:
+        x_g = x_g + b_ih
+
+    if mode == "LSTM":
+        def step(carry, xg):
+            h, c = carry
+            h2, c2 = _lstm_cell(xg, h, c, w_hh, b_hh)
+            return (h2, c2), h2
+
+        (hT, cT), outs = jax.lax.scan(step, (h0, c0), x_g)
+    elif mode == "GRU":
+        def step(h, xg):
+            h2 = _gru_cell(xg, h, w_hh, b_hh)
+            return h2, h2
+
+        hT, outs = jax.lax.scan(step, h0, x_g)
+        cT = None
+    else:  # RNN_TANH / RNN_RELU
+        act = jnp.tanh if mode == "RNN_TANH" else jax.nn.relu
+
+        def step(h, xg):
+            h2 = act(xg + h @ w_hh.T + (b_hh if b_hh is not None else 0.0))
+            return h2, h2
+
+        hT, outs = jax.lax.scan(step, h0, x_g)
+        cT = None
+    if reverse:
+        outs = jnp.flip(outs, axis=0)
+    return outs, hT, cT
+
+
+@register_lower("rnn")
+def _rnn(ctx, op):
+    mode = op.attr("mode", "LSTM")
+    x = ctx.in1(op, "Input")  # [T, B, I]
+    pre_states = ctx.in_list(op, "PreState")
+    weights = ctx.in_list(op, "WeightList")
+    num_layers = int(op.attr("num_layers", 1))
+    bidi = bool(op.attr("is_bidirec", False))
+    n_dir = 2 if bidi else 1
+    hidden = int(op.attr("hidden_size", 0)) or pre_states[0].shape[-1]
+
+    n_ld = num_layers * n_dir
+    has_bias = len(weights) >= 4 * n_ld
+    w_pairs = weights[:2 * n_ld]
+    b_pairs = weights[2 * n_ld:4 * n_ld] if has_bias else [None] * (2 * n_ld)
+
+    h0 = pre_states[0]  # [L*D, B, H]
+    c0 = pre_states[1] if mode == "LSTM" and len(pre_states) > 1 else None
+
+    y = x
+    hTs, cTs = [], []
+    for layer in range(num_layers):
+        outs_dir = []
+        for d in range(n_dir):
+            ld = layer * n_dir + d
+            w_ih, w_hh = w_pairs[2 * ld], w_pairs[2 * ld + 1]
+            b_ih, b_hh = b_pairs[2 * ld], b_pairs[2 * ld + 1]
+            outs, hT, cT = _run_direction(
+                y, h0[ld], c0[ld] if c0 is not None else None,
+                w_ih, w_hh, b_ih, b_hh, mode, reverse=(d == 1))
+            outs_dir.append(outs)
+            hTs.append(hT)
+            if cT is not None:
+                cTs.append(cT)
+        y = outs_dir[0] if n_dir == 1 else jnp.concatenate(outs_dir, axis=-1)
+
+    ctx.set_out(op, "Out", y)
+    state_names = op.outputs.get("State", [])
+    states = [jnp.stack(hTs)]
+    if mode == "LSTM":
+        states.append(jnp.stack(cTs) if cTs else jnp.zeros_like(states[0]))
+    for name, val in zip(state_names, states):
+        ctx.set(name, val)
+    if op.outputs.get("Reserve"):
+        ctx.set_out(op, "Reserve", jnp.zeros((1,), jnp.uint8))
+    if op.outputs.get("DropoutState"):
+        ctx.set_out(op, "DropoutState", jnp.zeros((1,), jnp.uint8))
+
+
+@register_lower("gru_unit")
+def _gru_unit(ctx, op):
+    """Single GRU step (reference gru_unit_op.cc): fluid gate layout
+    [update, reset, cell] over Input [B, 3H] + HiddenPrev @ Weight."""
+    x = ctx.in1(op, "Input")  # [B, 3H] (already x@W_ih + b)
+    h_prev = ctx.in1(op, "HiddenPrev")
+    w = ctx.in1(op, "Weight")  # [H, 3H]: [:, :2H] gates, [:, 2H:] candidate
+    bias = ctx.in1(op, "Bias")
+    hid = h_prev.shape[-1]
+    if bias is not None:
+        x = x + bias.reshape((-1,))
+    gu = x[:, :2 * hid] + h_prev @ w[:, :2 * hid]
+    u, r = jnp.split(jax.nn.sigmoid(gu), 2, axis=-1)
+    c = jnp.tanh(x[:, 2 * hid:] + (r * h_prev) @ w[:, 2 * hid:])
+    h = u * h_prev + (1.0 - u) * c
+    ctx.set_out(op, "Gate", jnp.concatenate([u, r, c], axis=-1))
+    ctx.set_out(op, "ResetHiddenPrev", r * h_prev)
+    ctx.set_out(op, "Hidden", h)
+
+
+@register_lower("lstm_unit")
+def _lstm_unit(ctx, op):
+    """Single LSTM step (reference lstm_unit_op.h:64-72): X [B,4H]
+    pre-gates in (i, f, o, g) chunk order, forget_bias added to f;
+    C_prev [B,H]."""
+    x = ctx.in1(op, "X")
+    c_prev = ctx.in1(op, "C_prev")
+    forget_bias = float(op.attr("forget_bias", 0.0))
+    i, f, o, g = jnp.split(x, 4, axis=-1)
+    c = jax.nn.sigmoid(f + forget_bias) * c_prev \
+        + jax.nn.sigmoid(i) * jnp.tanh(g)
+    h = jax.nn.sigmoid(o) * jnp.tanh(c)
+    ctx.set_out(op, "C", c)
+    ctx.set_out(op, "H", h)
+
+
+def _act(name):
+    return {"sigmoid": jax.nn.sigmoid, "tanh": jnp.tanh,
+            "relu": jax.nn.relu, "identity": lambda v: v}[name]
+
+
+@register_lower("gru")
+def _gru(ctx, op):
+    """Fluid LoD gru (gru_op.cc) under uniform/dense semantics: Input
+    [T, 3H] is ONE sequence of pre-projected gates (x@W_ih+b done by the
+    surrounding fc, reference layers.dynamic_gru contract).  Ragged
+    batches are padded+masked upstream per SURVEY §7 LoD mitigation."""
+    x = ctx.in1(op, "Input")  # [T, 3H]
+    w = ctx.in1(op, "Weight")  # [H, 3H]
+    bias = ctx.in1(op, "Bias")
+    h0 = ctx.in1(op, "H0")
+    hid = w.shape[0]
+    gate_act = _act(op.attr("gate_activation", "sigmoid"))
+    cand_act = _act(op.attr("activation", "tanh"))
+    reverse = bool(op.attr("is_reverse", False))
+    if bias is not None:
+        x = x + bias.reshape((-1,))
+    if reverse:
+        x = jnp.flip(x, axis=0)
+    h_init = h0 if h0 is not None else jnp.zeros((hid,), x.dtype)
+
+    def step(h, xg):
+        gu = gate_act(xg[:2 * hid] + h @ w[:, :2 * hid])
+        u, r = gu[:hid], gu[hid:]
+        c = cand_act(xg[2 * hid:] + (r * h) @ w[:, 2 * hid:])
+        h2 = u * h + (1.0 - u) * c
+        return h2, (h2, r * h, gu)
+
+    hT, (hidden, reset_h, gates) = jax.lax.scan(step, h_init, x)
+    if reverse:
+        hidden = jnp.flip(hidden, axis=0)
+    ctx.set_out(op, "Hidden", hidden)
+    ctx.set_out(op, "BatchGate", jnp.concatenate(
+        [gates, jnp.zeros((x.shape[0], hid), x.dtype)], axis=-1)[:, :3 * hid])
+    ctx.set_out(op, "BatchResetHiddenPrev", reset_h)
+    ctx.set_out(op, "BatchHidden", hidden)
+
+
+@register_lower("lstm", "lstmp")
+def _lstm(ctx, op):
+    """Fluid LoD lstm/lstmp (lstm_op.cc) under single-sequence dense
+    semantics: Input [T, 4H] pre-projected gates; lstmp adds a recurrent
+    projection ProjWeight [H, P]."""
+    x = ctx.in1(op, "Input")  # [T, 4H]
+    w = ctx.in1(op, "Weight")  # [H or P, 4H]
+    bias = ctx.in1(op, "Bias")
+    h0 = ctx.in1(op, "H0")
+    c0 = ctx.in1(op, "C0")
+    proj = ctx.in1(op, "ProjWeight") if op.type == "lstmp" else None
+    hid = x.shape[-1] // 4
+    use_peepholes = bool(op.attr("use_peepholes", False))
+    reverse = bool(op.attr("is_reverse", False))
+    gate_act = _act(op.attr("gate_activation", "sigmoid"))
+    cell_act = _act(op.attr("cell_activation", "tanh"))
+    cand_act = _act(op.attr("candidate_activation", "tanh"))
+    if bias is not None:
+        b = bias.reshape((-1,))
+        x = x + b[:4 * hid]
+        peep = b[4 * hid:] if use_peepholes and b.shape[0] > 4 * hid else None
+    else:
+        peep = None
+    if reverse:
+        x = jnp.flip(x, axis=0)
+    rec_dim = w.shape[0]
+    h_init = h0 if h0 is not None else jnp.zeros((rec_dim,), x.dtype)
+    c_init = c0 if c0 is not None else jnp.zeros((hid,), x.dtype)
+
+    def step(carry, xg):
+        h, c = carry
+        g = xg + h @ w
+        i, f, cc, o = jnp.split(g, 4, axis=-1)
+        if peep is not None:
+            wic, wfc, woc = jnp.split(peep, 3)
+            i = i + wic * c
+            f = f + wfc * c
+        i, f = gate_act(i), gate_act(f)
+        c2 = f * c + i * cand_act(cc)
+        if peep is not None:
+            o = o + woc * c2
+        o = gate_act(o)
+        h2 = o * cell_act(c2)
+        if proj is not None:
+            h2 = h2 @ proj
+        return (h2, c2), (h2, c2)
+
+    (hT, cT), (hidden, cell) = jax.lax.scan(step, (h_init, c_init), x)
+    if reverse:
+        hidden, cell = jnp.flip(hidden, axis=0), jnp.flip(cell, axis=0)
+    ctx.set_out(op, "Hidden", hidden)
+    ctx.set_out(op, "Cell", cell)
+    if op.type == "lstmp":
+        ctx.set_out(op, "Projection", hidden)
+    ctx.set_out(op, "BatchGate", jnp.zeros_like(x))
+    ctx.set_out(op, "BatchCellPreAct", jnp.zeros_like(cell))

@@ -1,0 +1,200 @@
+"""Sequence ops under dense/masked semantics.
+
+Reference parity: operators/sequence_ops/*.cc, which operate on LoD
+(ragged) tensors.  TPU-native (SURVEY §7 "LoD -> dense padding + mask"):
+ragged batches are padded to [B, T, ...] upstream; ops that need real
+lengths take them via the Length input (sequence_pad/unpad) or treat the
+time axis uniformly.  This matches how the XLA-era successors of these
+APIs behave; bitwise LoD parity is a non-goal (documented).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.lowering import register_lower
+
+
+@register_lower("sequence_pool")
+def _sequence_pool(ctx, op):
+    """[B, T, ...] -> [B, ...] pooled over the time axis (uniform-length
+    dense form of the reference LoD pooling)."""
+    x = ctx.in1(op, "X")
+    ptype = op.attr("pooltype", "AVERAGE").upper()
+    if ptype == "AVERAGE":
+        out = jnp.mean(x, axis=1)
+    elif ptype == "SUM":
+        out = jnp.sum(x, axis=1)
+    elif ptype == "SQRT":
+        out = jnp.sum(x, axis=1) / np.sqrt(x.shape[1])
+    elif ptype == "MAX":
+        out = jnp.max(x, axis=1)
+    elif ptype == "LAST":
+        out = x[:, -1]
+    elif ptype == "FIRST":
+        out = x[:, 0]
+    else:
+        raise NotImplementedError(f"sequence_pool {ptype}")
+    ctx.set_out(op, "Out", out)
+    if op.outputs.get("MaxIndex"):
+        ctx.set_out(op, "MaxIndex",
+                    jnp.argmax(x, axis=1).astype(jnp.int32))
+
+
+@register_lower("sequence_softmax")
+def _sequence_softmax(ctx, op):
+    x = ctx.in1(op, "X")
+    ctx.set_out(op, "Out", jax.nn.softmax(
+        x.astype(jnp.float32), axis=1).astype(x.dtype))
+
+
+@register_lower("sequence_reverse")
+def _sequence_reverse(ctx, op):
+    x = ctx.in1(op, "X")
+    ctx.set_out(op, "Y", jnp.flip(x, axis=1 if x.ndim > 2 else 0))
+
+
+@register_lower("sequence_concat")
+def _sequence_concat(ctx, op):
+    xs = ctx.in_list(op, "X")
+    ctx.set_out(op, "Out", jnp.concatenate(xs, axis=1 if xs[0].ndim > 2 else 0))
+
+
+@register_lower("sequence_reshape")
+def _sequence_reshape(ctx, op):
+    x = ctx.in1(op, "X")
+    new_dim = int(op.attr("new_dim", x.shape[-1]))
+    ctx.set_out(op, "Out", x.reshape(-1, new_dim))
+
+
+@register_lower("sequence_expand")
+def _sequence_expand(ctx, op):
+    """Dense form: tile X's rows to match Y's time extent (uniform
+    expansion, reference sequence_expand with uniform ref lod)."""
+    x = ctx.in1(op, "X")
+    y = ctx.in1(op, "Y")
+    times = y.shape[0] // x.shape[0]
+    ctx.set_out(op, "Out", jnp.repeat(x, times, axis=0))
+
+
+@register_lower("sequence_expand_as")
+def _sequence_expand_as(ctx, op):
+    x = ctx.in1(op, "X")
+    y = ctx.in1(op, "Y")
+    times = y.shape[0] // x.shape[0]
+    ctx.set_out(op, "Out", jnp.repeat(x, times, axis=0))
+
+
+@register_lower("sequence_pad")
+def _sequence_pad(ctx, op):
+    """[sum_T, D] + Length -> [B, maxlen, D] (reference sequence_pad_op);
+    dense uniform: rows are already grouped per sequence with uniform
+    stride, so this is a reshape + mask fill."""
+    x = ctx.in1(op, "X")
+    pad_value = ctx.in1(op, "PadValue")
+    length = ctx.in1(op, "Length")
+    padded_len = int(op.attr("padded_length", -1))
+    if length is not None:
+        b = length.shape[0]
+        t = x.shape[0] // b
+        maxlen = padded_len if padded_len > 0 else t
+        xr = x.reshape((b, t) + x.shape[1:])
+        if maxlen > t:
+            pads = [(0, 0), (0, maxlen - t)] + [(0, 0)] * (x.ndim - 1)
+            xr = jnp.pad(xr, pads)
+        mask = (jnp.arange(xr.shape[1])[None, :]
+                < length.reshape(-1, 1)).astype(x.dtype)
+        mshape = mask.shape + (1,) * (xr.ndim - 2)
+        pv = pad_value.reshape(()) if pad_value.size == 1 else pad_value
+        out = xr * mask.reshape(mshape) + pv * (1 - mask.reshape(mshape))
+        ctx.set_out(op, "Out", out)
+        ctx.set_out(op, "Length", length)
+    else:
+        raise NotImplementedError("sequence_pad needs the Length input")
+
+
+@register_lower("sequence_unpad")
+def _sequence_unpad(ctx, op):
+    """[B, maxlen, D] + Length -> dense [B*maxlen, D] with padded rows
+    zeroed (static shapes forbid true ragged output; consumers mask)."""
+    x = ctx.in1(op, "X")
+    length = ctx.in1(op, "Length")
+    mask = (jnp.arange(x.shape[1])[None, :]
+            < length.reshape(-1, 1)).astype(x.dtype)
+    out = x * mask.reshape(mask.shape + (1,) * (x.ndim - 2))
+    ctx.set_out(op, "Out", out.reshape((-1,) + x.shape[2:]))
+
+
+@register_lower("sequence_slice")
+def _sequence_slice(ctx, op):
+    x = ctx.in1(op, "X")
+    offset = ctx.in1(op, "Offset")
+    length = ctx.in1(op, "Length")
+    off = int(np.asarray(offset).ravel()[0])
+    ln = int(np.asarray(length).ravel()[0])
+    ctx.set_out(op, "Out", x[off:off + ln])
+
+
+@register_lower("sequence_enumerate")
+def _sequence_enumerate(ctx, op):
+    x = ctx.in1(op, "X")  # [T] or [T, 1] ids
+    win = int(op.attr("win_size", 2))
+    pad = int(op.attr("pad_value", 0))
+    flat = x.reshape(-1)
+    t = flat.shape[0]
+    idx = jnp.arange(t)[:, None] + jnp.arange(win)[None, :]
+    vals = jnp.where(idx < t, flat[jnp.clip(idx, 0, t - 1)], pad)
+    ctx.set_out(op, "Out", vals.astype(x.dtype))
+
+
+@register_lower("sequence_mask")
+def _sequence_mask(ctx, op):
+    x = ctx.in1(op, "X")  # lengths
+    maxlen = int(op.attr("maxlen", -1))
+    if maxlen <= 0:
+        raise NotImplementedError(
+            "sequence_mask needs a static maxlen attr on TPU (data-"
+            "dependent max length breaks XLA static shapes)")
+    from ..framework import dtypes as _dt
+
+    out_dtype = op.attr("out_dtype", None)
+    dt = _dt.to_jnp(out_dtype) if out_dtype else jnp.int64
+    mask = jnp.arange(maxlen)[None, :] < x.reshape(-1, 1)
+    ctx.set_out(op, "Y", mask.astype(dt))
+
+
+@register_lower("sequence_conv")
+def _sequence_conv(ctx, op):
+    """Context-window conv over the time axis (reference
+    sequence_conv_op): X [T, D], Filter [ctx_len*D, OD]."""
+    x = ctx.in1(op, "X")
+    f = ctx.in1(op, "Filter")
+    ctx_len = int(op.attr("contextLength", 3))
+    ctx_start = int(op.attr("contextStart", -1))
+    t, d = x.shape
+    cols = []
+    for k in range(ctx_len):
+        shift = ctx_start + k
+        rows = jnp.arange(t) + shift
+        valid = (rows >= 0) & (rows < t)
+        g = x[jnp.clip(rows, 0, t - 1)] * valid[:, None].astype(x.dtype)
+        cols.append(g)
+    im2col = jnp.concatenate(cols, axis=1)  # [T, ctx_len*D]
+    ctx.set_out(op, "Out", im2col @ f)
+
+
+@register_lower("row_conv")
+def _row_conv(ctx, op):
+    """Lookahead row convolution (reference row_conv_op): X [T, D],
+    Filter [future_ctx, D]."""
+    x = ctx.in1(op, "X")
+    f = ctx.in1(op, "Filter")
+    t, d = x.shape
+    k = f.shape[0]
+    out = jnp.zeros_like(x)
+    for i in range(k):
+        rows = jnp.arange(t) + i
+        valid = (rows < t).astype(x.dtype)[:, None]
+        out = out + x[jnp.clip(rows, 0, t - 1)] * valid * f[i][None, :]
+    ctx.set_out(op, "Out", out)

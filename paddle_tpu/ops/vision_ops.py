@@ -1,0 +1,425 @@
+"""Vision / spatial ops: roi ops, pixel shuffles, grid sampler, 3-D conv,
+local response norm, unfold, and friends.
+
+Reference parity: operators/{roi_align,roi_pool,grid_sampler,
+pixel_shuffle,space_to_depth,shuffle_channel,unfold,temporal_shift,
+affine_channel,label_smooth,lrn,pad_constant_like,crop,crop_tensor,
+reverse,conv3d,...}_op.cc and detection/.  Gradients via generic vjp.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.lowering import register_lower
+from .nn_ops import _conv_paddings
+
+
+@register_lower("pixel_shuffle")
+def _pixel_shuffle(ctx, op):
+    x = ctx.in1(op, "X")  # [N, C*r^2, H, W]
+    r = int(op.attr("upscale_factor", 1))
+    n, c, h, w = x.shape
+    oc = c // (r * r)
+    y = x.reshape(n, oc, r, r, h, w)
+    y = jnp.transpose(y, (0, 1, 4, 2, 5, 3)).reshape(n, oc, h * r, w * r)
+    ctx.set_out(op, "Out", y)
+
+
+@register_lower("space_to_depth")
+def _space_to_depth(ctx, op):
+    x = ctx.in1(op, "X")
+    b = int(op.attr("blocksize", 1))
+    n, c, h, w = x.shape
+    y = x.reshape(n, c, h // b, b, w // b, b)
+    y = jnp.transpose(y, (0, 3, 5, 1, 2, 4)).reshape(
+        n, c * b * b, h // b, w // b)
+    ctx.set_out(op, "Out", y)
+
+
+@register_lower("shuffle_channel")
+def _shuffle_channel(ctx, op):
+    x = ctx.in1(op, "X")
+    g = int(op.attr("group", 1))
+    n, c, h, w = x.shape
+    y = x.reshape(n, g, c // g, h, w)
+    y = jnp.transpose(y, (0, 2, 1, 3, 4)).reshape(n, c, h, w)
+    ctx.set_out(op, "Out", y)
+
+
+@register_lower("temporal_shift")
+def _temporal_shift(ctx, op):
+    x = ctx.in1(op, "X")  # [N*T, C, H, W]
+    t = int(op.attr("seg_num", 1))
+    ratio = float(op.attr("shift_ratio", 0.25))
+    nt, c, h, w = x.shape
+    n = nt // t
+    c1 = int(c * ratio)
+    c2 = int(c * 2 * ratio)
+    y = x.reshape(n, t, c, h, w)
+    fwd = jnp.concatenate([y[:, 1:, :c1], jnp.zeros_like(y[:, :1, :c1])], 1)
+    bwd = jnp.concatenate([jnp.zeros_like(y[:, :1, c1:c2]), y[:, :-1, c1:c2]], 1)
+    keep = y[:, :, c2:]
+    out = jnp.concatenate([fwd, bwd, keep], axis=2).reshape(nt, c, h, w)
+    ctx.set_out(op, "Out", out)
+
+
+@register_lower("affine_channel")
+def _affine_channel(ctx, op):
+    x = ctx.in1(op, "X")
+    scale = ctx.in1(op, "Scale")
+    bias = ctx.in1(op, "Bias")
+    layout = op.attr("data_layout", "NCHW") or "NCHW"
+    caxis = 1 if layout == "NCHW" else x.ndim - 1
+    shape = [1] * x.ndim
+    shape[caxis] = x.shape[caxis]
+    ctx.set_out(op, "Out", x * scale.reshape(shape) + bias.reshape(shape))
+
+
+@register_lower("label_smooth")
+def _label_smooth(ctx, op):
+    x = ctx.in1(op, "X")
+    dist = ctx.in1(op, "PriorDist")
+    eps = float(op.attr("epsilon", 0.0))
+    k = x.shape[-1]
+    if dist is not None:
+        out = (1 - eps) * x + eps * dist.reshape((1,) * (x.ndim - 1) + (k,))
+    else:
+        out = (1 - eps) * x + eps / k
+    ctx.set_out(op, "Out", out)
+
+
+@register_lower("lrn")
+def _lrn(ctx, op):
+    x = ctx.in1(op, "X")  # NCHW
+    n_size = int(op.attr("n", 5))
+    alpha = float(op.attr("alpha", 1e-4))
+    beta = float(op.attr("beta", 0.75))
+    k = float(op.attr("k", 1.0))
+    sq = jnp.square(x)
+    half = n_size // 2
+    pad = jnp.pad(sq, ((0, 0), (half, n_size - 1 - half), (0, 0), (0, 0)))
+    acc = sum(pad[:, i:i + x.shape[1]] for i in range(n_size))
+    mid = k + alpha * acc
+    ctx.set_out(op, "MidOut", mid)
+    ctx.set_out(op, "Out", x / jnp.power(mid, beta))
+
+
+@register_lower("pad_constant_like")
+def _pad_constant_like(ctx, op):
+    x = ctx.in1(op, "X")  # big
+    y = ctx.in1(op, "Y")  # small
+    val = float(op.attr("pad_value", 0.0))
+    pads = [(0, xs - ys) for xs, ys in zip(x.shape, y.shape)]
+    ctx.set_out(op, "Out", jnp.pad(y, pads, constant_values=val))
+
+
+@register_lower("crop", "crop_tensor")
+def _crop(ctx, op):
+    x = ctx.in1(op, "X")
+    offsets = op.attr("offsets", []) or [0] * x.ndim
+    shape = op.attr("shape", []) or list(x.shape)
+    off_in = ctx.in1(op, "Offsets")
+    if off_in is not None:
+        offsets = [int(v) for v in np.asarray(off_in)]
+    shape = [x.shape[i] if s in (-1, 0) else int(s)
+             for i, s in enumerate(shape)]
+    sl = tuple(slice(int(o), int(o) + int(s)) for o, s in zip(offsets, shape))
+    ctx.set_out(op, "Out", x[sl])
+
+
+@register_lower("reverse")
+def _reverse(ctx, op):
+    x = ctx.in1(op, "X")
+    axes = [int(a) for a in op.attr("axis", [0])]
+    ctx.set_out(op, "Out", jnp.flip(x, axis=tuple(axes)))
+
+
+@register_lower("unfold")
+def _unfold(ctx, op):
+    """im2col (reference unfold_op.cc): [N,C,H,W] -> [N, C*kh*kw, L]."""
+    x = ctx.in1(op, "X")
+    ks = [int(k) for k in op.attr("kernel_sizes", [1, 1])]
+    st = [int(s) for s in op.attr("strides", [1, 1])]
+    pd = [int(p) for p in op.attr("paddings", [0, 0, 0, 0])]
+    dl = [int(d) for d in op.attr("dilations", [1, 1])]
+    if len(pd) == 2:
+        pd = [pd[0], pd[1], pd[0], pd[1]]
+    n, c, h, w = x.shape
+    patches = jax.lax.conv_general_dilated_patches(
+        x, filter_shape=ks, window_strides=st,
+        padding=((pd[0], pd[2]), (pd[1], pd[3])), rhs_dilation=dl,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    # patches: [N, C*kh*kw, OH, OW]
+    ctx.set_out(op, "Y", patches.reshape(n, patches.shape[1], -1))
+
+
+@register_lower("grid_sampler")
+def _grid_sampler(ctx, op):
+    """Grid sampling (reference grid_sampler_op.cc): bilinear/nearest,
+    zeros/border padding, align_corners attr honored."""
+    x = ctx.in1(op, "X")  # [N, C, H, W]
+    grid = ctx.in1(op, "Grid")  # [N, Ho, Wo, 2] in [-1, 1]
+    mode = op.attr("mode", "bilinear") or "bilinear"
+    padding_mode = op.attr("padding_mode", "zeros") or "zeros"
+    align_corners = bool(op.attr("align_corners", True))
+    if padding_mode not in ("zeros", "border"):
+        raise NotImplementedError(
+            f"grid_sampler padding_mode {padding_mode!r} (reflection) is "
+            f"not lowered yet")
+    n, c, h, w = x.shape
+    if align_corners:
+        gx = (grid[..., 0] + 1.0) * (w - 1) / 2.0
+        gy = (grid[..., 1] + 1.0) * (h - 1) / 2.0
+    else:
+        gx = ((grid[..., 0] + 1.0) * w - 1.0) / 2.0
+        gy = ((grid[..., 1] + 1.0) * h - 1.0) / 2.0
+
+    def gather(yy, xx):
+        yc = jnp.clip(yy, 0, h - 1).astype(jnp.int32)
+        xc = jnp.clip(xx, 0, w - 1).astype(jnp.int32)
+        # vals[n, c, ho, wo] = x[n, c, yc[n,ho,wo], xc[n,ho,wo]]
+        vals = jax.vmap(lambda img, ys, xs: img[:, ys, xs])(x, yc, xc)
+        if padding_mode == "zeros":
+            valid = (yy >= 0) & (yy < h) & (xx >= 0) & (xx < w)
+            vals = vals * valid[:, None].astype(x.dtype)
+        return vals
+
+    if mode == "nearest":
+        out = gather(jnp.round(gy), jnp.round(gx))
+    else:
+        x0 = jnp.floor(gx)
+        y0 = jnp.floor(gy)
+        wx = (gx - x0)[:, None]
+        wy = (gy - y0)[:, None]
+        out = (gather(y0, x0) * (1 - wx) * (1 - wy)
+               + gather(y0, x0 + 1) * wx * (1 - wy)
+               + gather(y0 + 1, x0) * (1 - wx) * wy
+               + gather(y0 + 1, x0 + 1) * wx * wy)
+    ctx.set_out(op, "Output", out)
+
+
+def _roi_boxes(ctx, op):
+    rois = ctx.in1(op, "ROIs")  # [R, 4] (x1, y1, x2, y2)
+    rois_num = op.inputs.get("RoisNum") or op.inputs.get("RoisLod")
+    # batch assignment: RoisNum gives per-image counts; without it all
+    # rois belong to image 0 (single-image static case)
+    if rois_num:
+        counts = ctx.get(rois_num[0])
+        batch_idx = jnp.repeat(
+            jnp.arange(counts.shape[0]), counts.astype(jnp.int32),
+            total_repeat_length=rois.shape[0])
+    else:
+        batch_idx = jnp.zeros((rois.shape[0],), jnp.int32)
+    return rois, batch_idx
+
+
+@register_lower("roi_align")
+def _roi_align(ctx, op):
+    x = ctx.in1(op, "X")  # [N, C, H, W]
+    rois, batch_idx = _roi_boxes(ctx, op)
+    ph = int(op.attr("pooled_height", 1))
+    pw = int(op.attr("pooled_width", 1))
+    scale = float(op.attr("spatial_scale", 1.0))
+    ratio = int(op.attr("sampling_ratio", -1))
+    ratio = ratio if ratio > 0 else 2
+    # aligned=True (paddle 2.x roi_align default): -0.5 pixel offset and
+    # no min-size clamp (Detectron2 "aligned" correction)
+    aligned = bool(op.attr("aligned", False))
+    n, c, h, w = x.shape
+
+    def one_roi(roi, bi):
+        img = x[bi]  # [C, H, W]
+        off = 0.5 if aligned else 0.0
+        x1, y1, x2, y2 = roi * scale - off
+        if aligned:
+            rh = y2 - y1
+            rw = x2 - x1
+        else:
+            rh = jnp.maximum(y2 - y1, 1.0)
+            rw = jnp.maximum(x2 - x1, 1.0)
+        bh, bw = rh / ph, rw / pw
+        iy = (jnp.arange(ph)[:, None] * bh + y1
+              + (jnp.arange(ratio)[None, :] + 0.5) * bh / ratio)  # [ph, r]
+        ix = (jnp.arange(pw)[:, None] * bw + x1
+              + (jnp.arange(ratio)[None, :] + 0.5) * bw / ratio)  # [pw, r]
+        yy = iy.reshape(-1)  # [ph*r]
+        xx = ix.reshape(-1)  # [pw*r]
+
+        y0 = jnp.clip(jnp.floor(yy), 0, h - 1)
+        x0 = jnp.clip(jnp.floor(xx), 0, w - 1)
+        y1i = jnp.clip(y0 + 1, 0, h - 1).astype(jnp.int32)
+        x1i = jnp.clip(x0 + 1, 0, w - 1).astype(jnp.int32)
+        wy = jnp.clip(yy, 0, h - 1) - y0
+        wx = jnp.clip(xx, 0, w - 1) - x0
+        y0 = y0.astype(jnp.int32)
+        x0 = x0.astype(jnp.int32)
+        # bilinear at the [ph*r, pw*r] grid of sample points
+        def at(yi, xi):
+            return img[:, yi][:, :, xi]  # [C, ph*r, pw*r]
+        v = (at(y0, x0) * ((1 - wy)[:, None] * (1 - wx)[None, :])
+             + at(y0, x1i) * ((1 - wy)[:, None] * wx[None, :])
+             + at(y1i, x0) * (wy[:, None] * (1 - wx)[None, :])
+             + at(y1i, x1i) * (wy[:, None] * wx[None, :]))
+        v = v.reshape(c, ph, ratio, pw, ratio)
+        return v.mean(axis=(2, 4))
+
+    out = jax.vmap(one_roi)(rois, batch_idx)
+    ctx.set_out(op, "Out", out)
+
+
+@register_lower("roi_pool")
+def _roi_pool(ctx, op):
+    x = ctx.in1(op, "X")
+    rois, batch_idx = _roi_boxes(ctx, op)
+    ph = int(op.attr("pooled_height", 1))
+    pw = int(op.attr("pooled_width", 1))
+    scale = float(op.attr("spatial_scale", 1.0))
+    n, c, h, w = x.shape
+
+    def one_roi(roi, bi):
+        img = x[bi]
+        x1 = jnp.round(roi[0] * scale)
+        y1 = jnp.round(roi[1] * scale)
+        x2 = jnp.round(roi[2] * scale)
+        y2 = jnp.round(roi[3] * scale)
+        rh = jnp.maximum(y2 - y1 + 1, 1.0)
+        rw = jnp.maximum(x2 - x1 + 1, 1.0)
+        bh, bw = rh / ph, rw / pw
+        ys = jnp.arange(h, dtype=x.dtype)
+        xs = jnp.arange(w, dtype=x.dtype)
+        out = jnp.zeros((c, ph, pw), x.dtype)
+        # membership masks per output bin (static ph*pw loop)
+        vals = []
+        for i in range(ph):
+            ylo = jnp.floor(y1 + i * bh)
+            yhi = jnp.ceil(y1 + (i + 1) * bh)
+            ym = ((ys >= ylo) & (ys < yhi)).astype(x.dtype)
+            for j in range(pw):
+                xlo = jnp.floor(x1 + j * bw)
+                xhi = jnp.ceil(x1 + (j + 1) * bw)
+                xm = ((xs >= xlo) & (xs < xhi)).astype(x.dtype)
+                m = ym[:, None] * xm[None, :]
+                neg = jnp.full_like(img, -jnp.inf)
+                sel = jnp.where(m[None] > 0, img, neg)
+                v = jnp.max(sel, axis=(1, 2))
+                vals.append(jnp.where(jnp.isfinite(v), v, 0.0))
+        return jnp.stack(vals, axis=1).reshape(c, ph, pw)
+
+    out = jax.vmap(one_roi)(rois, batch_idx)
+    ctx.set_out(op, "Out", out)
+    ctx.set_out(op, "Argmax", jnp.zeros(out.shape, jnp.int64))
+
+
+@register_lower("conv3d")
+def _conv3d(ctx, op):
+    x = ctx.in1(op, "Input")  # NCDHW
+    w = ctx.in1(op, "Filter")  # OIDHW
+    strides = [int(s) for s in op.attr("strides", [1, 1, 1])]
+    dilations = [int(d) for d in op.attr("dilations", [1, 1, 1])]
+    groups = int(op.attr("groups", 1) or 1)
+    pads = _conv_paddings(
+        op.attr("paddings", [0, 0, 0]), op.attr("padding_algorithm", "EXPLICIT"),
+        w.shape[2:], strides, dilations, x.shape[2:])
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=strides, padding=pads, rhs_dilation=dilations,
+        feature_group_count=groups,
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"))
+    ctx.set_out(op, "Output", out)
+
+
+@register_lower("pool3d")
+def _pool3d(ctx, op):
+    x = ctx.in1(op, "X")  # NCDHW
+    ptype = op.attr("pooling_type", "max")
+    ksize = [int(k) for k in op.attr("ksize", [1, 1, 1])]
+    strides = [int(s) for s in op.attr("strides", [1, 1, 1])]
+    if bool(op.attr("global_pooling", False)):
+        red = jnp.max if ptype == "max" else jnp.mean
+        ctx.set_out(op, "Out", red(x, axis=(2, 3, 4), keepdims=True))
+        return
+    pads = _conv_paddings(
+        op.attr("paddings", [0, 0, 0]), op.attr("padding_algorithm", "EXPLICIT"),
+        ksize, strides, [1, 1, 1], x.shape[2:])
+    window = (1, 1) + tuple(ksize)
+    st = (1, 1) + tuple(strides)
+    pd = [(0, 0), (0, 0)] + pads
+    if ptype == "max":
+        out = jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, window, st, pd)
+    else:
+        s = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, st, pd)
+        cnt = jax.lax.reduce_window(jnp.ones_like(x), 0.0, jax.lax.add,
+                                    window, st, pd)
+        out = s / cnt
+    ctx.set_out(op, "Out", out)
+
+
+@register_lower("max_pool2d_with_index")
+def _max_pool2d_with_index(ctx, op):
+    """Max pool returning the flat h*w argmax per window (reference
+    max_pool2d_with_index; the Mask feeds unpool)."""
+    x = ctx.in1(op, "X")
+    ksize = [int(k) for k in op.attr("ksize", [1, 1])]
+    strides = [int(s) for s in op.attr("strides", [1, 1])]
+    paddings = [int(p) for p in op.attr("paddings", [0, 0])]
+    if bool(op.attr("global_pooling", False)):
+        ksize = list(x.shape[2:])
+        paddings = [0, 0]
+    n, c, h, w = x.shape
+    if bool(op.attr("adaptive", False)):
+        # adaptive bins (AdaptiveMaxPool2D): ksize IS the output size;
+        # divisible case maps to uniform windows, else unsupported
+        oh, ow = ksize
+        if h % oh or w % ow:
+            raise NotImplementedError(
+                "adaptive max_pool2d_with_index with non-divisible "
+                f"input {h}x{w} -> output {oh}x{ow}")
+        ksize = [h // oh, w // ow]
+        strides = [h // oh, w // ow]
+        paddings = [0, 0]
+    kh, kw = ksize
+    # pad with -inf so padding never wins the max, then VALID patches
+    xp = jnp.pad(x, ((0, 0), (0, 0), (paddings[0],) * 2, (paddings[1],) * 2),
+                 constant_values=-jnp.inf)
+    patches = jax.lax.conv_general_dilated_patches(
+        xp, filter_shape=ksize, window_strides=strides, padding="VALID",
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    oh, ow = patches.shape[2], patches.shape[3]
+    pv = patches.reshape(n, c, kh * kw, oh, ow)
+    out = jnp.max(pv, axis=2)
+    arg = jnp.argmax(pv, axis=2)  # window-local index
+    hs = (jnp.arange(oh) * strides[0] - paddings[0])[:, None]
+    ws = (jnp.arange(ow) * strides[1] - paddings[1])[None, :]
+    flat = (hs + arg // kw) * w + (ws + arg % kw)
+    ctx.set_out(op, "Out", out)
+    ctx.set_out(op, "Mask", flat.astype(jnp.int64))
+
+
+@register_lower("im2sequence")
+def _im2sequence(ctx, op):
+    x = ctx.in1(op, "X")
+    ks = [int(k) for k in op.attr("kernels", [1, 1])]
+    st = [int(s) for s in op.attr("strides", [1, 1])]
+    pd = [int(p) for p in op.attr("paddings", [0, 0, 0, 0])]
+    n, c, h, w = x.shape
+    patches = jax.lax.conv_general_dilated_patches(
+        x, filter_shape=ks, window_strides=st,
+        padding=((pd[0], pd[2]), (pd[1], pd[3])),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    # [N, C*kh*kw, OH, OW] -> [N*OH*OW, C*kh*kw]
+    nck = patches.shape[1]
+    out = jnp.transpose(patches, (0, 2, 3, 1)).reshape(-1, nck)
+    ctx.set_out(op, "Out", out)
+
+
+@register_lower("cvm")
+def _cvm(ctx, op):
+    x = ctx.in1(op, "X")
+    use_cvm = bool(op.attr("use_cvm", True))
+    if use_cvm:
+        # log the first two "show/click" columns (reference cvm_op semantics)
+        sc = jnp.log1p(jnp.maximum(x[:, :2], 0.0))
+        ctx.set_out(op, "Y", jnp.concatenate([sc, x[:, 2:]], axis=1))
+    else:
+        ctx.set_out(op, "Y", x[:, 2:])

@@ -1,0 +1,610 @@
+"""OpTest parity for the round-3 op-breadth batch: rnn/losses/linalg/
+interp/vision/sequence/misc families vs numpy oracles.
+
+Reference parity model: unittests op_test.py pattern — declare inputs/
+attrs/expected outputs, run through the real Executor, compare; grads
+checked against numeric differences for a representative sample.
+"""
+import numpy as np
+
+from op_test import OpTest
+
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+# --------------------------------------------------------------------------
+# rnn family
+# --------------------------------------------------------------------------
+
+
+class TestRnnLSTM(OpTest):
+    op_type = "rnn"
+
+    def setup(self):
+        T, B, I, H = 4, 2, 3, 5
+        rs = np.random.RandomState(0)
+        x = rs.randn(T, B, I).astype("f4")
+        h0 = rs.randn(1, B, H).astype("f4")
+        c0 = rs.randn(1, B, H).astype("f4")
+        w_ih = rs.randn(4 * H, I).astype("f4") * 0.5
+        w_hh = rs.randn(4 * H, H).astype("f4") * 0.5
+        b_ih = rs.randn(4 * H).astype("f4") * 0.1
+        b_hh = rs.randn(4 * H).astype("f4") * 0.1
+
+        outs = []
+        h, c = h0[0], c0[0]
+        for t in range(T):
+            g = x[t] @ w_ih.T + b_ih + h @ w_hh.T + b_hh
+            i, f, gg, o = np.split(g, 4, axis=-1)
+            i, f, o = _sigmoid(i), _sigmoid(f), _sigmoid(o)
+            c = f * c + i * np.tanh(gg)
+            h = o * np.tanh(c)
+            outs.append(h)
+        out = np.stack(outs)
+
+        self.inputs = {
+            "Input": [("x", x)],
+            "PreState": [("h0", h0), ("c0", c0)],
+            "WeightList": [("w_ih", w_ih), ("w_hh", w_hh),
+                           ("b_ih", b_ih), ("b_hh", b_hh)],
+        }
+        self.attrs = {"mode": "LSTM", "hidden_size": 5, "num_layers": 1,
+                      "is_bidirec": False}
+        self.outputs = {
+            "Out": [("out", out)],
+            "State": [("hT", h[None]), ("cT", c[None])],
+        }
+
+    def test_output(self):
+        self.check_output(no_check_set=["Reserve", "DropoutState"])
+
+    def test_grad(self):
+        self.check_grad(["x"], "Out", max_relative_error=0.02)
+
+
+class TestRnnGRU(OpTest):
+    op_type = "rnn"
+
+    def setup(self):
+        T, B, I, H = 3, 2, 4, 3
+        rs = np.random.RandomState(1)
+        x = rs.randn(T, B, I).astype("f4")
+        h0 = rs.randn(1, B, H).astype("f4")
+        w_ih = rs.randn(3 * H, I).astype("f4") * 0.5
+        w_hh = rs.randn(3 * H, H).astype("f4") * 0.5
+
+        h = h0[0]
+        outs = []
+        for t in range(T):
+            xg = x[t] @ w_ih.T
+            hg = h @ w_hh.T
+            xr, xz, xn = np.split(xg, 3, -1)
+            hr, hz, hn = np.split(hg, 3, -1)
+            r = _sigmoid(xr + hr)
+            z = _sigmoid(xz + hz)
+            n = np.tanh(xn + r * hn)
+            h = (1 - z) * n + z * h
+            outs.append(h)
+        self.inputs = {
+            "Input": [("x", x)],
+            "PreState": [("h0", h0)],
+            "WeightList": [("w_ih", w_ih), ("w_hh", w_hh)],
+        }
+        self.attrs = {"mode": "GRU", "hidden_size": 3, "num_layers": 1}
+        self.outputs = {"Out": [("out", np.stack(outs))],
+                        "State": [("hT", h[None])]}
+
+    def test_output(self):
+        self.check_output(no_check_set=["Reserve", "DropoutState"])
+
+
+class TestLstmUnit(OpTest):
+    op_type = "lstm_unit"
+
+    def setup(self):
+        B, H = 3, 4
+        rs = np.random.RandomState(2)
+        x = rs.randn(B, 4 * H).astype("f4")
+        c_prev = rs.randn(B, H).astype("f4")
+        # reference lstm_unit_op.h chunk order: (i, f, o, g)
+        i, f, o, g = np.split(x, 4, -1)
+        c = _sigmoid(f) * c_prev + _sigmoid(i) * np.tanh(g)
+        h = _sigmoid(o) * np.tanh(c)
+        self.inputs = {"X": [("x", x)], "C_prev": [("c_prev", c_prev)]}
+        self.outputs = {"C": [("c", c)], "H": [("h", h)]}
+
+    def test_output(self):
+        self.check_output()
+
+
+# --------------------------------------------------------------------------
+# losses
+# --------------------------------------------------------------------------
+
+
+class TestBceLoss(OpTest):
+    op_type = "bce_loss"
+
+    def setup(self):
+        rs = np.random.RandomState(3)
+        x = rs.uniform(0.05, 0.95, (4, 5)).astype("f4")
+        lbl = rs.randint(0, 2, (4, 5)).astype("f4")
+        out = -(lbl * np.log(x) + (1 - lbl) * np.log(1 - x))
+        self.inputs = {"X": [("x", x)], "Label": [("lbl", lbl)]}
+        self.outputs = {"Out": [("out", out)]}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["x"], "Out", max_relative_error=0.02)
+
+
+class TestKldivLoss(OpTest):
+    op_type = "kldiv_loss"
+
+    def setup(self):
+        rs = np.random.RandomState(4)
+        x = np.log(rs.uniform(0.1, 0.9, (3, 4)).astype("f4"))
+        t = rs.uniform(0.1, 0.9, (3, 4)).astype("f4")
+        loss = (t * (np.log(t) - x)).mean()
+        self.inputs = {"X": [("x", x)], "Target": [("t", t)]}
+        self.attrs = {"reduction": "mean"}
+        self.outputs = {"Loss": [("loss", np.float32(loss))]}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestSmoothL1(OpTest):
+    op_type = "smooth_l1_loss"
+
+    def setup(self):
+        rs = np.random.RandomState(5)
+        x = rs.randn(4, 3).astype("f4")
+        y = rs.randn(4, 3).astype("f4")
+        d = x - y
+        ad = np.abs(d)
+        loss = np.where(ad < 1.0, 0.5 * d * d, ad - 0.5).sum(1, keepdims=True)
+        self.inputs = {"X": [("x", x)], "Y": [("y", y)]}
+        self.attrs = {"sigma": 1.0}
+        self.outputs = {"Out": [("out", loss)], "Diff": [("diff", d)]}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestNllLoss(OpTest):
+    op_type = "nll_loss"
+
+    def setup(self):
+        rs = np.random.RandomState(6)
+        x = np.log(rs.dirichlet(np.ones(5), 4)).astype("f4")
+        lbl = rs.randint(0, 5, (4,)).astype("i8")
+        picked = x[np.arange(4), lbl]
+        self.inputs = {"X": [("x", x)], "Label": [("lbl", lbl)]}
+        self.attrs = {"reduction": "mean", "ignore_index": -100}
+        self.outputs = {
+            "Out": [("out", np.float32(-picked.mean()))],
+            "Total_weight": [("tw", np.float32(4.0))],
+        }
+
+    def test_output(self):
+        self.check_output()
+
+
+# --------------------------------------------------------------------------
+# linalg
+# --------------------------------------------------------------------------
+
+
+class TestCholesky(OpTest):
+    op_type = "cholesky"
+
+    def setup(self):
+        rs = np.random.RandomState(7)
+        a = rs.randn(4, 4).astype("f4")
+        spd = a @ a.T + 4 * np.eye(4, dtype="f4")
+        self.inputs = {"X": [("x", spd)]}
+        self.attrs = {"upper": False}
+        self.outputs = {"Out": [("out", np.linalg.cholesky(spd))]}
+
+    def test_output(self):
+        self.check_output(atol=1e-4, rtol=1e-4)
+
+
+class TestInverse(OpTest):
+    op_type = "inverse"
+
+    def setup(self):
+        rs = np.random.RandomState(8)
+        a = rs.randn(3, 3).astype("f4") + 3 * np.eye(3, dtype="f4")
+        self.inputs = {"Input": [("x", a)]}
+        self.outputs = {"Output": [("out", np.linalg.inv(a))]}
+
+    def test_output(self):
+        self.check_output(atol=1e-4, rtol=1e-4)
+
+
+class TestAddmm(OpTest):
+    op_type = "addmm"
+
+    def setup(self):
+        rs = np.random.RandomState(9)
+        inp = rs.randn(2, 4).astype("f4")
+        x = rs.randn(2, 3).astype("f4")
+        y = rs.randn(3, 4).astype("f4")
+        self.inputs = {"Input": [("inp", inp)], "X": [("x", x)],
+                       "Y": [("y", y)]}
+        self.attrs = {"Alpha": 2.0, "Beta": 0.5}
+        self.outputs = {"Out": [("out", 0.5 * inp + 2.0 * (x @ y))]}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["x", "y"], "Out", max_relative_error=0.02)
+
+
+class TestKron(OpTest):
+    op_type = "kron"
+
+    def setup(self):
+        rs = np.random.RandomState(10)
+        x = rs.randn(2, 3).astype("f4")
+        y = rs.randn(4, 2).astype("f4")
+        self.inputs = {"X": [("x", x)], "Y": [("y", y)]}
+        self.outputs = {"Out": [("out", np.kron(x, y))]}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestLogsumexp(OpTest):
+    op_type = "logsumexp"
+
+    def setup(self):
+        rs = np.random.RandomState(11)
+        x = rs.randn(3, 4).astype("f4")
+        out = np.log(np.exp(x).sum(1))
+        self.inputs = {"X": [("x", x)]}
+        self.attrs = {"axis": [1], "keepdim": False, "reduce_all": False}
+        self.outputs = {"Out": [("out", out)]}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestTrace(OpTest):
+    op_type = "trace"
+
+    def setup(self):
+        rs = np.random.RandomState(12)
+        x = rs.randn(4, 5).astype("f4")
+        self.inputs = {"Input": [("x", x)]}
+        self.attrs = {"offset": 1, "axis1": 0, "axis2": 1}
+        self.outputs = {"Out": [("out", np.trace(x, offset=1))]}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestNormL2(OpTest):
+    op_type = "norm"
+
+    def setup(self):
+        rs = np.random.RandomState(13)
+        x = rs.randn(3, 4).astype("f4")
+        n = np.sqrt((x * x).sum(1, keepdims=True) + 1e-10)
+        self.inputs = {"X": [("x", x)]}
+        self.attrs = {"axis": 1, "epsilon": 1e-10}
+        self.outputs = {"Out": [("out", x / n)], "Norm": [("n", n)]}
+
+    def test_output(self):
+        self.check_output()
+
+
+# --------------------------------------------------------------------------
+# interpolation
+# --------------------------------------------------------------------------
+
+
+class TestNearestInterp(OpTest):
+    op_type = "nearest_interp_v2"
+
+    def setup(self):
+        rs = np.random.RandomState(14)
+        x = rs.randn(2, 3, 4, 4).astype("f4")
+        out = x.repeat(2, axis=2).repeat(2, axis=3)
+        self.inputs = {"X": [("x", x)]}
+        self.attrs = {"out_h": 8, "out_w": 8, "align_corners": False}
+        self.outputs = {"Out": [("out", out)]}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestBilinearInterpAlignCorners(OpTest):
+    op_type = "bilinear_interp_v2"
+
+    def setup(self):
+        rs = np.random.RandomState(15)
+        x = rs.randn(1, 1, 3, 3).astype("f4")
+        oh = ow = 5
+
+        def oracle(img):
+            out = np.zeros((oh, ow), "f4")
+            for i in range(oh):
+                for j in range(ow):
+                    sy = i * (3 - 1) / (oh - 1)
+                    sx = j * (3 - 1) / (ow - 1)
+                    y0, x0 = int(np.floor(sy)), int(np.floor(sx))
+                    y1, x1 = min(y0 + 1, 2), min(x0 + 1, 2)
+                    wy, wx = sy - y0, sx - x0
+                    out[i, j] = (img[y0, x0] * (1 - wy) * (1 - wx)
+                                 + img[y0, x1] * (1 - wy) * wx
+                                 + img[y1, x0] * wy * (1 - wx)
+                                 + img[y1, x1] * wy * wx)
+            return out
+
+        out = oracle(x[0, 0])[None, None]
+        self.inputs = {"X": [("x", x)]}
+        self.attrs = {"out_h": oh, "out_w": ow, "align_corners": True}
+        self.outputs = {"Out": [("out", out)]}
+
+    def test_output(self):
+        self.check_output(atol=1e-5, rtol=1e-4)
+
+
+# --------------------------------------------------------------------------
+# vision / spatial
+# --------------------------------------------------------------------------
+
+
+class TestPixelShuffle(OpTest):
+    op_type = "pixel_shuffle"
+
+    def setup(self):
+        rs = np.random.RandomState(16)
+        x = rs.randn(2, 8, 3, 3).astype("f4")
+        r = 2
+        n, c, h, w = x.shape
+        oc = c // (r * r)
+        out = x.reshape(n, oc, r, r, h, w).transpose(0, 1, 4, 2, 5, 3)
+        out = out.reshape(n, oc, h * r, w * r)
+        self.inputs = {"X": [("x", x)]}
+        self.attrs = {"upscale_factor": 2}
+        self.outputs = {"Out": [("out", out)]}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestLabelSmooth(OpTest):
+    op_type = "label_smooth"
+
+    def setup(self):
+        rs = np.random.RandomState(17)
+        x = rs.dirichlet(np.ones(5), 4).astype("f4")
+        eps = 0.1
+        out = (1 - eps) * x + eps / 5
+        self.inputs = {"X": [("x", x)]}
+        self.attrs = {"epsilon": eps}
+        self.outputs = {"Out": [("out", out)]}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestUnfold(OpTest):
+    op_type = "unfold"
+
+    def setup(self):
+        rs = np.random.RandomState(18)
+        x = rs.randn(1, 2, 4, 4).astype("f4")
+        # oracle: manual im2col, k=2, s=2, p=0 -> 4 patches
+        cols = []
+        for i in range(0, 3, 2):
+            for j in range(0, 3, 2):
+                cols.append(x[:, :, i:i + 2, j:j + 2].reshape(1, -1))
+        out = np.stack(cols, axis=-1)  # [1, C*k*k, L]
+        self.inputs = {"X": [("x", x)]}
+        self.attrs = {"kernel_sizes": [2, 2], "strides": [2, 2],
+                      "paddings": [0, 0, 0, 0], "dilations": [1, 1]}
+        self.outputs = {"Y": [("y", out)]}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestMaxPoolWithIndex(OpTest):
+    op_type = "max_pool2d_with_index"
+
+    def setup(self):
+        rs = np.random.RandomState(19)
+        x = rs.randn(1, 1, 4, 4).astype("f4")
+        out = np.zeros((1, 1, 2, 2), "f4")
+        mask = np.zeros((1, 1, 2, 2), "i8")
+        for i in range(2):
+            for j in range(2):
+                win = x[0, 0, 2 * i:2 * i + 2, 2 * j:2 * j + 2]
+                out[0, 0, i, j] = win.max()
+                k = int(win.argmax())
+                mask[0, 0, i, j] = (2 * i + k // 2) * 4 + (2 * j + k % 2)
+        self.inputs = {"X": [("x", x)]}
+        self.attrs = {"ksize": [2, 2], "strides": [2, 2], "paddings": [0, 0]}
+        self.outputs = {"Out": [("out", out)], "Mask": [("mask", mask)]}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestRoiAlignSingleBox(OpTest):
+    op_type = "roi_align"
+
+    def setup(self):
+        # whole-image 2x2 roi_align over a linear ramp: averages quadrants
+        x = np.arange(16, dtype="f4").reshape(1, 1, 4, 4)
+        rois = np.array([[0.0, 0.0, 4.0, 4.0]], "f4")
+        self.inputs = {"X": [("x", x)], "ROIs": [("rois", rois)]}
+        self.attrs = {"pooled_height": 2, "pooled_width": 2,
+                      "spatial_scale": 1.0, "sampling_ratio": 2}
+        # bilinear on the ramp img[y,x]=4y+x at sample points {0.5,1.5}
+        # per bin axis: bin(0,0) -> mean(4y+x) = 5; out-of-range samples
+        # clamp to the border (reference roi_align clamp), so bins
+        # touching the right/bottom edge average x=2.5 and x=3 -> 6.75
+        out = np.array([[[[5.0, 6.75], [12.0, 13.75]]]], "f4")
+        self.outputs = {"Out": [("out", out)]}
+
+    def test_output(self):
+        self.check_output(atol=1e-4, rtol=1e-4)
+
+
+# --------------------------------------------------------------------------
+# sequence (dense semantics)
+# --------------------------------------------------------------------------
+
+
+class TestSequencePoolSum(OpTest):
+    op_type = "sequence_pool"
+
+    def setup(self):
+        rs = np.random.RandomState(20)
+        x = rs.randn(3, 4, 5).astype("f4")
+        self.inputs = {"X": [("x", x)]}
+        self.attrs = {"pooltype": "SUM"}
+        self.outputs = {"Out": [("out", x.sum(1))]}
+
+    def test_output(self):
+        self.check_output(no_check_set=["MaxIndex"])
+
+
+class TestSequencePad(OpTest):
+    op_type = "sequence_pad"
+
+    def setup(self):
+        x = np.arange(12, dtype="f4").reshape(6, 2)  # 2 seqs of 3 rows
+        length = np.array([3, 2], "i8")
+        pv = np.array([0.0], "f4")
+        out = x.reshape(2, 3, 2).copy()
+        out[1, 2] = 0.0  # beyond length 2
+        self.inputs = {"X": [("x", x)], "PadValue": [("pv", pv)],
+                       "Length": [("len", length)]}
+        self.attrs = {"padded_length": -1}
+        self.outputs = {"Out": [("out", out)], "Length": [("lo", length)]}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestSequenceConv(OpTest):
+    op_type = "sequence_conv"
+
+    def setup(self):
+        rs = np.random.RandomState(21)
+        x = rs.randn(5, 3).astype("f4")
+        f = rs.randn(9, 2).astype("f4")
+        t = x.shape[0]
+        cols = []
+        for k in range(3):
+            shift = -1 + k
+            g = np.zeros_like(x)
+            for r in range(t):
+                rr = r + shift
+                if 0 <= rr < t:
+                    g[r] = x[rr]
+            cols.append(g)
+        out = np.concatenate(cols, 1) @ f
+        self.inputs = {"X": [("x", x)], "Filter": [("f", f)]}
+        self.attrs = {"contextLength": 3, "contextStart": -1}
+        self.outputs = {"Out": [("out", out)]}
+
+    def test_output(self):
+        self.check_output()
+
+
+# --------------------------------------------------------------------------
+# misc
+# --------------------------------------------------------------------------
+
+
+class TestConvShift(OpTest):
+    op_type = "conv_shift"
+
+    def setup(self):
+        rs = np.random.RandomState(22)
+        x = rs.randn(2, 6).astype("f4")
+        y = rs.randn(2, 3).astype("f4")
+        b, d = x.shape
+        k = y.shape[1]
+        out = np.zeros_like(x)
+        for bi in range(b):
+            for i in range(d):
+                for j in range(k):
+                    out[bi, i] += x[bi, (i + j - k // 2) % d] * y[bi, j]
+        self.inputs = {"X": [("x", x)], "Y": [("y", y)]}
+        self.outputs = {"Out": [("out", out)]}
+
+    def test_output(self):
+        self.check_output(atol=1e-5, rtol=1e-4)
+
+
+class TestMultiplex(OpTest):
+    op_type = "multiplex"
+
+    def setup(self):
+        rs = np.random.RandomState(23)
+        xs = [rs.randn(3, 4).astype("f4") for _ in range(2)]
+        ids = np.array([[1], [0], [1]], "i4")
+        out = np.stack([xs[ids[i, 0]][i] for i in range(3)])
+        self.inputs = {"Ids": [("ids", ids)],
+                       "X": [("x0", xs[0]), ("x1", xs[1])]}
+        self.outputs = {"Out": [("out", out)]}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestDiagV2(OpTest):
+    op_type = "diag_v2"
+
+    def setup(self):
+        x = np.array([1.0, 2.0, 3.0], "f4")
+        self.inputs = {"X": [("x", x)]}
+        self.attrs = {"offset": 0, "padding_value": 0.0}
+        self.outputs = {"Out": [("out", np.diag(x))]}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestBroadcastTo(OpTest):
+    op_type = "broadcast_to"
+
+    def setup(self):
+        x = np.arange(3, dtype="f4").reshape(1, 3)
+        self.inputs = {"X": [("x", x)]}
+        self.attrs = {"shape": [4, 3]}
+        self.outputs = {"Out": [("out", np.broadcast_to(x, (4, 3)))]}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestGatherTree(OpTest):
+    op_type = "gather_tree"
+
+    def setup(self):
+        ids = np.array(
+            [[[2, 2]], [[3, 4]], [[5, 6]]], "i8")  # [T=3, B=1, W=2]
+        parents = np.array(
+            [[[0, 0]], [[1, 0]], [[1, 0]]], "i8")
+        # walk back from last step: beam0 parent 1 -> step1 id 4's parent 0
+        out = np.array([[[2, 2]], [[4, 3]], [[5, 6]]], "i8")
+        self.inputs = {"Ids": [("ids", ids)], "Parents": [("par", parents)]}
+        self.outputs = {"Out": [("out", out)]}
+
+    def test_output(self):
+        self.check_output()
