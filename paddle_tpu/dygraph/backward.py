@@ -18,6 +18,44 @@ import jax.numpy as jnp
 from .tensor import Tensor
 
 
+def _t_add(a: Tensor, b: Tensor) -> Tensor:
+    """Tensor addition recorded on the tape (grad accumulation must stay
+    differentiable under create_graph)."""
+    from . import eager
+
+    return eager.apply_jax(jnp.add, a, b)
+
+
+def _node_backward_recorded(node, fwd_float, grads):
+    """Run one node's VJP as a tape-recorded operation: the returned input
+    grads are Tensors whose own grad_nodes re-enter the engine, which is
+    exactly what makes grad-of-grad work (TPU-native equivalent of the
+    reference's double-grad op graph, partial_grad_engine.cc)."""
+    from . import eager
+
+    cot_tensors = []
+    for i in node.float_out_idx:
+        t = node.out_tensors[i]
+        g = grads.get(id(t))
+        if g is None:
+            g = Tensor(jnp.zeros_like(t._value), stop_gradient=True)
+        elif not isinstance(g, Tensor):
+            g = Tensor(g, stop_gradient=True)
+        cot_tensors.append(g)
+
+    n_in = len(node.in_tensors)
+
+    def bwd(*vals):
+        prim, cots = vals[:n_in], vals[n_in:]
+        _, vjp_fn = jax.vjp(fwd_float, *prim)
+        return tuple(vjp_fn(tuple(cots)))
+
+    bwd.__name__ = f"{node.op_type}_double_grad"
+    outs = eager.apply_jax(bwd, *(list(node.in_tensors) + cot_tensors),
+                           n_out=n_in)
+    return outs if isinstance(outs, list) else [outs]
+
+
 def _reachable_nodes(roots: List[Tensor]):
     seen = set()
     order = []
@@ -37,15 +75,24 @@ def _reachable_nodes(roots: List[Tensor]):
 def run_backward(roots: List[Tensor], seeds: Optional[List] = None,
                  inputs: Optional[List[Tensor]] = None,
                  retain_graph: bool = False,
-                 accumulate_leaf: bool = True) -> Dict[int, object]:
-    """Core engine.  Returns {id(tensor): raw grad} for every tensor touched.
+                 accumulate_leaf: bool = True,
+                 create_graph: bool = False) -> Dict[int, object]:
+    """Core engine.  Returns {id(tensor): grad} for every tensor touched —
+    raw jax values normally, tape-recorded Tensors under ``create_graph``
+    (so a second grad() differentiates the backward itself; reference
+    partial_grad_engine.cc double-grad role).
 
     `seeds[i]` is the cotangent for `roots[i]` (defaults to ones, matching
     the reference's scalar-loss seeding in BasicEngine::Init).
     """
+    if create_graph:
+        retain_graph = True
     seeds = seeds or [None] * len(roots)
     grads: Dict[int, object] = {}
     keep: Dict[int, Tensor] = {}
+
+    def as_val(s):
+        return s._value if isinstance(s, Tensor) else s
 
     for t, s in zip(roots, seeds):
         if s is None:
@@ -54,8 +101,14 @@ def run_backward(roots: List[Tensor], seeds: Optional[List] = None,
                     f"grad can be implicitly created only for scalar outputs; "
                     f"got shape {t.shape} (pass grad_tensor)")
             s = jnp.ones_like(t._value)
-        g = grads.get(id(t))
-        grads[id(t)] = s if g is None else g + s
+        if create_graph:
+            s = s if isinstance(s, Tensor) else Tensor(s, stop_gradient=True)
+            g = grads.get(id(t))
+            grads[id(t)] = s if g is None else _t_add(g, s)
+        else:
+            s = as_val(s)
+            g = grads.get(id(t))
+            grads[id(t)] = s if g is None else g + s
         keep[id(t)] = t
 
     nodes = _reachable_nodes(roots)
@@ -73,29 +126,35 @@ def run_backward(roots: List[Tensor], seeds: Optional[List] = None,
     while ready:
         node = ready.popleft()
         executed += 1
-        # cotangents for this node's float outputs
-        cots = []
-        for i in node.float_out_idx:
-            t = node.out_tensors[i]
-            g = grads.get(id(t))
-            cots.append(jnp.zeros_like(t._value) if g is None else
-                        jnp.asarray(g, dtype=t._value.dtype))
-
-        primals = [t._value for t in node.in_tensors]
 
         def fwd_float(*vals, _node=node):
             outs = _node.fwd(*vals)
             return tuple(outs[i] for i in _node.float_out_idx)
 
-        _, vjp_fn = jax.vjp(fwd_float, *primals)
-        in_grads = vjp_fn(tuple(cots))
+        if create_graph:
+            in_grads = _node_backward_recorded(node, fwd_float, grads)
+        else:
+            # cotangents for this node's float outputs
+            cots = []
+            for i in node.float_out_idx:
+                t = node.out_tensors[i]
+                g = grads.get(id(t))
+                cots.append(jnp.zeros_like(t._value) if g is None else
+                            jnp.asarray(g, dtype=t._value.dtype))
+
+            primals = [t._value for t in node.in_tensors]
+            _, vjp_fn = jax.vjp(fwd_float, *primals)
+            in_grads = vjp_fn(tuple(cots))
 
         for t, g in zip(node.in_tensors, in_grads):
             if t.stop_gradient and t.grad_node is None:
                 pass  # constant input: discard
             else:
                 prev = grads.get(id(t))
-                grads[id(t)] = g if prev is None else prev + g
+                if create_graph:
+                    grads[id(t)] = g if prev is None else _t_add(prev, g)
+                else:
+                    grads[id(t)] = g if prev is None else prev + g
                 keep[id(t)] = t
             if t.grad_node is not None and id(t.grad_node) in nodes:
                 pending[id(t.grad_node)] -= 1
@@ -129,12 +188,9 @@ def run_backward(roots: List[Tensor], seeds: Optional[List] = None,
 def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
          create_graph=False, only_inputs=True, allow_unused=False,
          no_grad_vars=None):
-    """`paddle.grad` (reference partial_grad_engine.cc / dygraph base.grad).
-
-    create_graph (double grad) is not supported yet — documented gap.
-    """
-    if create_graph:
-        raise NotImplementedError("create_graph=True (double grad) not yet supported")
+    """`paddle.grad` (reference partial_grad_engine.cc / dygraph
+    base.grad).  ``create_graph=True`` records the backward on the tape so
+    the returned grads are themselves differentiable (double grad)."""
     outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
     inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
     if grad_outputs is not None:
@@ -144,7 +200,7 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
         seeds = None
     retain = True if retain_graph is None else retain_graph
     grads = run_backward(list(outputs), seeds, retain_graph=retain,
-                         accumulate_leaf=False)
+                         accumulate_leaf=False, create_graph=create_graph)
     result = []
     for t in inputs:
         g = grads.get(id(t))
@@ -154,6 +210,8 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
                     f"input {t.name} is unreachable from outputs "
                     "(set allow_unused=True to get None)")
             result.append(None)
+        elif isinstance(g, Tensor):
+            result.append(g)
         else:
             result.append(Tensor(g, stop_gradient=True))
     return result
