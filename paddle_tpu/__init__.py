@@ -67,5 +67,6 @@ from . import jit  # noqa: F401
 from . import profiler  # noqa: F401
 from . import text  # noqa: F401
 from .serialization import load, save  # noqa: F401
+from .framework.flags import get_flags, set_flags  # noqa: F401
 
 __version__ = "0.1.0"

@@ -32,6 +32,7 @@ from .scope import Scope, global_scope
 logger = logging.getLogger(__name__)
 
 RNG_VAR = "@RNG_KEY@"
+NAN_FLAGS_VAR = "@NAN_FLAGS@"
 
 # ops executed host-side by an interpretive walk (file I/O cannot live
 # inside a compiled XLA computation); reference runs these through the
@@ -89,7 +90,51 @@ class _Compiled:
     # multi-process SPMD: converts process-local feed/state values into
     # global jax.Arrays over the mesh before the executable call
     globalize: object = None
+    # FLAGS_check_nan_inf: (op type, build site) per scanned op, parallel
+    # to the extra NAN_FLAGS fetch
+    nan_ops: Tuple = ()
     n_calls: int = 0
+
+
+def _sub_external_reads(program, block_idx: int) -> List[str]:
+    """Names a sub-block reads from its surroundings (closures for the
+    lax.while_loop/lax.cond lowering)."""
+    sub = program.blocks[block_idx]
+    local_written: set = set()
+    ext: List[str] = []
+    for sop in sub.ops:
+        for n in sop.input_arg_names():
+            if n not in local_written and n not in ext:
+                ext.append(n)
+        for aname in ("sub_block", "sub_block_t", "sub_block_f"):
+            if sop.has_attr(aname):
+                for n in _sub_external_reads(program, int(sop.attr(aname))):
+                    if n not in local_written and n not in ext:
+                        ext.append(n)
+        local_written.update(sop.output_arg_names())
+    return ext
+
+
+def _prune_ops(program, fetch_names):
+    """Backward slice: keep only ops whose outputs (transitively) feed the
+    fetch list (reference framework/prune.h / Executor.run(use_prune)).
+    An eval fetch on a training program thus skips backward+optimizer ops
+    instead of silently advancing the parameters."""
+    block = program.global_block
+    needed = set(fetch_names)
+    keep = []
+    for op in reversed(block.ops):
+        if op.type in PSEUDO_OPS:
+            continue
+        if set(op.output_arg_names()) & needed:
+            keep.append(op)
+            needed.update(op.input_arg_names())
+            for aname in ("sub_block", "sub_block_t", "sub_block_f"):
+                if op.has_attr(aname):
+                    needed.update(
+                        _sub_external_reads(program, int(op.attr(aname))))
+    keep.reverse()
+    return keep
 
 
 def _feed_spec(block, feed: Dict[str, np.ndarray]):
@@ -136,6 +181,7 @@ class Executor:
         scope: Optional[Scope] = None,
         return_numpy: bool = True,
         use_program_cache: bool = True,  # always cached; kept for API parity
+        use_prune: bool = False,
     ):
         import jax
 
@@ -159,7 +205,7 @@ class Executor:
 
         fetches = self._dispatch(program, feed, feed_arrays, spec,
                                  fetch_names, scope, multi_step=False,
-                                 scan_steps=None)
+                                 scan_steps=None, use_prune=use_prune)
 
         # localsgd strategy: periodic cross-replica parameter averaging
         # (set by LocalSGDMetaOptimizer; see fleet/collective_transpiler.py)
@@ -253,19 +299,27 @@ class Executor:
 
     # ------------------------------------------------------------------
     def _dispatch(self, program, feed, feed_arrays, spec, fetch_names, scope,
-                  multi_step, scan_steps):
+                  multi_step, scan_steps, use_prune=False):
         """Shared run/run_steps tail: state analysis, compile-cache lookup,
         RNG seeding, the executable call, and scope write-back."""
         import jax
 
+        from . import flags
+
+        ops = _prune_ops(program, fetch_names) \
+            if use_prune and fetch_names else None
+        nan_scan = bool(flags.flag("check_nan_inf"))
+
         # state the program will read from the scope (the full op walk is
         # cached; cache hits only re-check that the state vars still exist)
-        akey = (program.fingerprint(), frozenset(feed), id(scope))
+        akey = (program.fingerprint(), frozenset(feed), scope.serial,
+                fetch_names if ops is not None else None)
         cached = self._analysis_cache.get(akey)
         if cached is not None and all(scope.has_var(n) for n in cached[0]):
             state_in, state_out = cached
         else:
-            state_in, state_out = self._analyze_state(program, set(feed), scope)
+            state_in, state_out = self._analyze_state(program, set(feed),
+                                                      scope, ops=ops)
             self._analysis_cache[akey] = (state_in, state_out)
         state_spec = tuple(
             (n, tuple(np.shape(scope.get_var(n))), str(np.asarray(scope.get_var(n)).dtype))
@@ -284,12 +338,15 @@ class Executor:
             type(self.place).__name__,
             self.place.device_id,
             id(mesh),
+            ops is not None,
+            nan_scan,
         )
         entry = self._cache.get(key)
         if entry is None:
             entry = self._compile(program, spec, state_in, state_out,
                                   fetch_names, mesh=mesh,
-                                  multi_step=multi_step, scan_steps=scan_steps)
+                                  multi_step=multi_step, scan_steps=scan_steps,
+                                  ops=ops, nan_scan=nan_scan)
             self._cache[key] = entry
 
         # rng key lives in the scope so runs are deterministic/resumable
@@ -313,6 +370,16 @@ class Executor:
             scope.set_var(n, v)
         if entry.uses_rng:
             scope.set_var(RNG_VAR, new_rng)
+        if entry.nan_ops:
+            flags = np.asarray(fetches[-1]).astype(bool)
+            fetches = fetches[:-1]
+            ok = flags.reshape(-1, len(entry.nan_ops)).all(axis=0)
+            if not ok.all():
+                i = int(np.argmin(ok))
+                op_type, site = entry.nan_ops[i]
+                raise RuntimeError(
+                    f"FLAGS_check_nan_inf: op {op_type!r} (built at {site}) "
+                    f"produced NaN/Inf (op #{i} of the compiled block)")
         return fetches
 
     # ------------------------------------------------------------------
@@ -359,46 +426,30 @@ class Executor:
         return []
 
     # ------------------------------------------------------------------
-    def _analyze_state(self, program: Program, feed_names: set, scope: Scope):
+    def _analyze_state(self, program: Program, feed_names: set, scope: Scope,
+                       ops=None):
         """Static use/def analysis of the root block (plus sub-blocks).
 
         state_in  = names read before written that are not feeds (must come
                     from the scope: parameters, optimizer state, ...)
         state_out = names written that should persist back into the scope
                     (persistable vars, or anything already living in scope).
+        ``ops`` restricts the walk to a pruned op list (use_prune).
         """
         written: set = set()
         state_in: List[str] = []
         state_out: List[str] = []
         seen_out: set = set()
 
-        def sub_external_reads(block_idx: int) -> List[str]:
-            """Names a sub-block reads from its surroundings (closures for
-            the lax.while_loop/lax.cond lowering): inputs not produced
-            earlier inside the sub-block, plus nested sub-blocks'."""
-            sub = program.blocks[block_idx]
-            local_written: set = set()
-            ext: List[str] = []
-            for sop in sub.ops:
-                for n in sop.input_arg_names():
-                    if n not in local_written and n not in ext:
-                        ext.append(n)
-                for aname in ("sub_block", "sub_block_t", "sub_block_f"):
-                    if sop.has_attr(aname):
-                        for n in sub_external_reads(int(sop.attr(aname))):
-                            if n not in local_written and n not in ext:
-                                ext.append(n)
-                local_written.update(sop.output_arg_names())
-            return ext
-
-        def visit_block(block):
-            for op in block.ops:
+        def visit_block(block, op_list):
+            for op in op_list:
                 if op.type in PSEUDO_OPS:
                     continue
                 reads = list(op.input_arg_names())
                 for aname in ("sub_block", "sub_block_t", "sub_block_f"):
                     if op.has_attr(aname):
-                        reads.extend(sub_external_reads(int(op.attr(aname))))
+                        reads.extend(
+                            _sub_external_reads(program, int(op.attr(aname))))
                 for name in reads:
                     if name in feed_names or name in written:
                         continue
@@ -419,27 +470,36 @@ class Executor:
                         seen_out.add(name)
                         state_out.append(name)
 
-        visit_block(program.global_block)
+        block = program.global_block
+        visit_block(block, ops if ops is not None else block.ops)
         return tuple(state_in), tuple(state_out)
 
     # ------------------------------------------------------------------
     def _compile(self, program, feed_spec, state_in, state_out, fetch_names,
-                 mesh=None, multi_step=False, scan_steps=None) -> _Compiled:
+                 mesh=None, multi_step=False, scan_steps=None, ops=None,
+                 nan_scan=False) -> _Compiled:
         import jax
+        import jax.numpy as jnp
 
         feed_names = tuple(n for n, _, _ in feed_spec)
         block = program.global_block
+        op_list = [op for op in (ops if ops is not None else block.ops)
+                   if op.type not in PSEUDO_OPS]
         out_set = set(state_out)
         state_mut = tuple(n for n in state_in if n in out_set)
         state_const = tuple(n for n in state_in if n not in out_set)
+        if nan_scan:
+            # per-op finite flags come back as an extra fetch; _dispatch
+            # raises host-side naming the first bad op (reference
+            # FLAGS_check_nan_inf, operator.cc:1129)
+            fetch_names = tuple(fetch_names) + (NAN_FLAGS_VAR,)
 
         def trace_block(env, rng, axis_env=(), ring_axes=None, fold_axes=()):
             ctx = LoweringContext(block, env, rng_key=rng, mesh=mesh,
                                   axis_env=axis_env, ring_axes=ring_axes,
                                   fold_axes=fold_axes)
-            for op in block.ops:
-                if op.type in PSEUDO_OPS:
-                    continue
+            flags = []
+            for op in op_list:
                 try:
                     get_lowering(op.type)(ctx, op)
                 except Exception as e:
@@ -447,6 +507,17 @@ class Executor:
                     raise type(e)(
                         f"while lowering op {op.type!r} (built at {site}): {e}"
                     ) from e
+                if nan_scan:
+                    ok = jnp.bool_(True)
+                    for n in op.output_arg_names():
+                        v = env.get(n)
+                        if v is not None and hasattr(v, "dtype") \
+                                and jnp.issubdtype(v.dtype, jnp.floating):
+                            ok = jnp.logical_and(ok, jnp.isfinite(v).all())
+                    flags.append(ok)
+            if nan_scan:
+                env[NAN_FLAGS_VAR] = jnp.stack(flags) if flags else \
+                    jnp.ones((0,), jnp.bool_)
             missing = [n for n in fetch_names if n not in env]
             if missing:
                 raise KeyError(f"fetch vars not produced by program: {missing}")
@@ -521,6 +592,9 @@ class Executor:
             fetch_names=fetch_names,
             uses_rng=True,
             globalize=globalize,
+            nan_ops=tuple(
+                (op.type, op.callstack[-1] if op.callstack else "?")
+                for op in op_list) if nan_scan else (),
         )
         return compiled
 
@@ -613,6 +687,13 @@ class Executor:
             fetches = []
             for n in fetch_names:
                 v = env[n]
+                if n == NAN_FLAGS_VAR:
+                    # AND across shards (pmin of the 0/1 flags)
+                    import jax.numpy as jnp
+
+                    fetches.append(
+                        lax.pmin(v.astype(jnp.int32), axis_names))
+                    continue
                 if n not in varying:
                     fetches.append(v)  # replica-invariant: local copy is it
                 elif getattr(v, "ndim", 0) == 0 or v.size == 1:

@@ -1,0 +1,86 @@
+"""Tier-1 config: the FLAGS_* registry (reference platform/flags.cc +
+global_value_getter_setter.cc, python paddle.set_flags/get_flags).
+
+Flags initialize from FLAGS_<name> environment variables (reference gflags
+env behavior) and are mutable at runtime via set_flags.  SURVEY §5 keeps
+the reference's 3-tier config shape: this module is tier 1; BuildStrategy/
+ExecutionStrategy are tier 2; DistributedStrategy proto is tier 3.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict
+
+_TRUTHY = {"1", "true", "True", "TRUE", "yes", "on"}
+
+
+def _parse(raw: str, default):
+    if isinstance(default, bool):
+        return raw in _TRUTHY
+    if isinstance(default, int):
+        return int(raw)
+    if isinstance(default, float):
+        return float(raw)
+    return raw
+
+
+class _Flag:
+    __slots__ = ("name", "value", "default", "help")
+
+    def __init__(self, name, default, help_=""):
+        self.name = name
+        self.default = default
+        self.help = help_
+        raw = os.environ.get("FLAGS_" + name)
+        self.value = _parse(raw, default) if raw is not None else default
+
+
+_REGISTRY: Dict[str, _Flag] = {}
+
+
+def define_flag(name: str, default, help_: str = ""):
+    if name in _REGISTRY:
+        raise KeyError(f"flag {name!r} already defined")
+    _REGISTRY[name] = _Flag(name, default, help_)
+
+
+def get_flags(flags):
+    """paddle.get_flags parity: str or list -> {name: value}."""
+    names = [flags] if isinstance(flags, str) else list(flags)
+    out = {}
+    for n in names:
+        key = n[6:] if n.startswith("FLAGS_") else n
+        if key not in _REGISTRY:
+            raise KeyError(f"unknown flag {n!r}")
+        out[n] = _REGISTRY[key].value
+    return out
+
+
+def set_flags(flags: Dict):
+    """paddle.set_flags parity: {FLAGS_name or name: value}."""
+    for n, v in flags.items():
+        key = n[6:] if n.startswith("FLAGS_") else n
+        if key not in _REGISTRY:
+            raise KeyError(f"unknown flag {n!r}")
+        f = _REGISTRY[key]
+        f.value = _parse(v, f.default) if isinstance(v, str) else type(f.default)(v)
+
+
+def flag(name: str):
+    """Internal fast accessor."""
+    return _REGISTRY[name].value
+
+
+# ---- the registry (reference platform/flags.cc equivalents that are
+# meaningful under XLA; memory/GC/cudnn knobs are N/A by design) ----------
+define_flag("check_nan_inf", False,
+            "scan every op output for NaN/Inf after each executor run "
+            "(reference operator.cc:1129 + nan_inf_utils_detail)")
+define_flag("benchmark", False, "sync + time each executor call")
+define_flag("paddle_num_threads", 1, "host-side intra-op threads (XLA-owned)")
+define_flag("use_tpu", True, "prefer the TPU backend when available")
+define_flag("eager_delete_tensor_gb", 0.0, "N/A under XLA (kept for parity)")
+define_flag("allocator_strategy", "xla", "memory is PJRT/XLA-owned")
+define_flag("cpu_deterministic", False,
+            "force deterministic reductions on CPU runs")
+define_flag("seed", 0, "global random seed override (0 = program seed)")

@@ -8,6 +8,7 @@ state tuple once per compiled step.
 """
 from __future__ import annotations
 
+import itertools
 from typing import Dict, Optional
 
 import numpy as np
@@ -41,11 +42,17 @@ class _VarView:
         return _TensorView(self._scope, self._name)
 
 
+_scope_serial = itertools.count()
+
+
 class Scope:
     def __init__(self, parent: Optional["Scope"] = None):
         self._vars: Dict[str, object] = {}
         self._parent = parent
         self._kids = []
+        # monotone id for executor caches: id() of a GC'd scope can be
+        # recycled by a new scope and silently serve stale analysis
+        self.serial = next(_scope_serial)
 
     # -- core -------------------------------------------------------------
     def has_var(self, name: str) -> bool:

@@ -1,0 +1,84 @@
+"""Tier-1 flags, the check_nan_inf per-op scan, and fetch-list pruning.
+
+Reference parity: platform/flags.cc + paddle.set_flags,
+FLAGS_check_nan_inf (operator.cc:1129, nan_inf_utils_detail.cc), and
+Executor.run(use_prune) / framework/prune.h.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.framework.program import Program, program_guard
+
+
+def test_set_get_flags_roundtrip():
+    assert pt.get_flags("FLAGS_check_nan_inf")["FLAGS_check_nan_inf"] is False
+    pt.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        assert pt.get_flags(["check_nan_inf"])["check_nan_inf"] is True
+    finally:
+        pt.set_flags({"FLAGS_check_nan_inf": False})
+    with pytest.raises(KeyError):
+        pt.set_flags({"FLAGS_no_such_flag": 1})
+
+
+def test_check_nan_inf_names_the_bad_op():
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = layers.data("x", [3])
+        y = layers.log(x)  # log of a negative input -> NaN
+        z = layers.scale(y, 2.0)
+    exe = pt.Executor(pt.CPUPlace())
+    scope = pt.framework.Scope()
+    exe.run(startup, scope=scope)
+    pt.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        with pytest.raises(RuntimeError, match="log"):
+            exe.run(main, feed={"x": np.array([[-1.0, 2.0, 3.0]], "f4")},
+                    fetch_list=[z], scope=scope)
+        # clean inputs pass the scan
+        out = exe.run(main, feed={"x": np.ones((1, 3), "f4")},
+                      fetch_list=[z], scope=scope)
+        assert np.isfinite(np.asarray(out[0])).all()
+    finally:
+        pt.set_flags({"FLAGS_check_nan_inf": False})
+
+
+def test_use_prune_skips_optimizer_ops():
+    """Eval fetch on a training program must not advance params/optimizer
+    state when use_prune=True (reference Executor.run(use_prune))."""
+    from paddle_tpu.optimizer import MomentumOptimizer
+
+    main, startup = Program(), Program()
+    main.random_seed = 1
+    with program_guard(main, startup):
+        x = layers.data("x", [4])
+        y = layers.data("y", [1])
+        pred = layers.fc(x, 1, bias_attr=False)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        MomentumOptimizer(0.1, 0.9).minimize(loss)
+    exe = pt.Executor(pt.CPUPlace())
+    scope = pt.framework.Scope()
+    exe.run(startup, scope=scope)
+
+    pname = next(n for n in scope.local_var_names() if ".w" in n)
+    w_before = np.asarray(scope.get_var(pname)).copy()
+    rs = np.random.RandomState(0)
+    feed = {"x": rs.randn(8, 4).astype("f4"), "y": rs.randn(8, 1).astype("f4")}
+
+    # pruned eval: loss computed, params untouched
+    l1 = exe.run(main, feed=feed, fetch_list=[loss], scope=scope,
+                 use_prune=True)[0]
+    np.testing.assert_array_equal(np.asarray(scope.get_var(pname)), w_before)
+
+    # unpruned training run: params move
+    l2 = exe.run(main, feed=feed, fetch_list=[loss], scope=scope)[0]
+    assert not np.array_equal(np.asarray(scope.get_var(pname)), w_before)
+    np.testing.assert_allclose(np.asarray(l1), np.asarray(l2), rtol=1e-6)
+
+
+def test_scope_serial_distinct():
+    s1 = pt.framework.Scope()
+    s2 = pt.framework.Scope()
+    assert s1.serial != s2.serial
