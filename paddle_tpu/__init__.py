@@ -62,9 +62,11 @@ from .framework import (  # noqa: F401
     program_guard,
 )
 
+from . import distribution  # noqa: F401
 from . import inference  # noqa: F401
 from . import jit  # noqa: F401
 from . import profiler  # noqa: F401
+from . import static  # noqa: F401
 from . import text  # noqa: F401
 from .serialization import load, save  # noqa: F401
 from .framework.flags import get_flags, set_flags  # noqa: F401

@@ -1,0 +1,145 @@
+"""``paddle.distribution``: Uniform / Normal / Categorical.
+
+Reference parity: python/paddle/distribution.py (:41 Distribution, :168
+Uniform, :393 Normal, :646 Categorical).  TPU-native: sampling uses the
+dygraph RNG key stream (threefry) and all math is jnp; tensors in/out are
+dygraph Tensors so the API composes with the eager autograd tape.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .dygraph import base as _base
+from .dygraph.tensor import Tensor
+
+__all__ = ["Distribution", "Uniform", "Normal", "Categorical"]
+
+
+def _as_value(x):
+    if isinstance(x, Tensor):
+        return x._value
+    return jnp.asarray(np.asarray(x, dtype="float32"))
+
+
+class Distribution:
+    """Abstract base (reference distribution.py:41)."""
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def probs(self, value):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        raise NotImplementedError
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _as_value(low)
+        self.high = _as_value(high)
+
+    def sample(self, shape=(), seed=0):
+        key = jax.random.PRNGKey(seed) if seed else _base.next_eager_key()
+        shape = tuple(shape) + jnp.broadcast_shapes(self.low.shape,
+                                                    self.high.shape)
+        u = jax.random.uniform(key, shape)
+        return Tensor(self.low + u * (self.high - self.low),
+                      stop_gradient=True)
+
+    def entropy(self):
+        return Tensor(jnp.log(self.high - self.low), stop_gradient=True)
+
+    def log_prob(self, value):
+        v = _as_value(value)
+        inside = (v >= self.low) & (v < self.high)
+        lp = jnp.where(inside, -jnp.log(self.high - self.low), -jnp.inf)
+        return Tensor(lp, stop_gradient=True)
+
+    def probs(self, value):
+        return Tensor(jnp.exp(_as_value(self.log_prob(value))),
+                      stop_gradient=True)
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _as_value(loc)
+        self.scale = _as_value(scale)
+
+    def sample(self, shape=(), seed=0):
+        key = jax.random.PRNGKey(seed) if seed else _base.next_eager_key()
+        shape = tuple(shape) + jnp.broadcast_shapes(self.loc.shape,
+                                                    self.scale.shape)
+        z = jax.random.normal(key, shape)
+        return Tensor(self.loc + z * self.scale, stop_gradient=True)
+
+    def entropy(self):
+        return Tensor(0.5 + 0.5 * math.log(2 * math.pi)
+                      + jnp.log(self.scale), stop_gradient=True)
+
+    def log_prob(self, value):
+        v = _as_value(value)
+        var = self.scale * self.scale
+        lp = (-jnp.square(v - self.loc) / (2 * var)
+              - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+        return Tensor(lp, stop_gradient=True)
+
+    def probs(self, value):
+        return Tensor(jnp.exp(_as_value(self.log_prob(value))),
+                      stop_gradient=True)
+
+    def kl_divergence(self, other):
+        if not isinstance(other, Normal):
+            raise NotImplementedError("KL(Normal || non-Normal)")
+        var_ratio = jnp.square(self.scale / other.scale)
+        t1 = jnp.square((self.loc - other.loc) / other.scale)
+        return Tensor(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)),
+                      stop_gradient=True)
+
+
+class Categorical(Distribution):
+    def __init__(self, logits, name=None):
+        self.logits = _as_value(logits)
+
+    def _logp(self):
+        return jax.nn.log_softmax(self.logits, axis=-1)
+
+    def sample(self, shape=(), seed=0):
+        key = jax.random.PRNGKey(seed) if seed else _base.next_eager_key()
+        out = jax.random.categorical(key, self.logits, shape=tuple(shape)
+                                     + self.logits.shape[:-1])
+        return Tensor(out.astype(jnp.int64), stop_gradient=True)
+
+    def entropy(self):
+        logp = self._logp()
+        return Tensor(-jnp.sum(jnp.exp(logp) * logp, axis=-1),
+                      stop_gradient=True)
+
+    def log_prob(self, value):
+        idx = _as_value(value).astype(jnp.int32)
+        logp = self._logp()
+        if logp.ndim == 1:
+            return Tensor(logp[idx], stop_gradient=True)
+        return Tensor(jnp.take_along_axis(logp, idx[..., None], axis=-1)
+                      [..., 0], stop_gradient=True)
+
+    def probs(self, value):
+        return Tensor(jnp.exp(_as_value(self.log_prob(value))),
+                      stop_gradient=True)
+
+    def kl_divergence(self, other):
+        if not isinstance(other, Categorical):
+            raise NotImplementedError
+        p = jnp.exp(self._logp())
+        return Tensor(jnp.sum(p * (self._logp() - other._logp()), axis=-1),
+                      stop_gradient=True)

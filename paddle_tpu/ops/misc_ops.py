@@ -251,8 +251,17 @@ def _py_func(ctx, op):
         var = ctx.block._find_var_recursive(n)
         from ..framework import dtypes as _dt
 
-        specs.append(jax.ShapeDtypeStruct(
-            tuple(int(s) for s in var.shape), _dt.to_np(var.dtype)))
+        shape = [int(s) for s in var.shape]
+        for i, s in enumerate(shape):
+            if s < 0:
+                # dynamic dim: resolve from the first input (batch dim)
+                if not xs or i >= xs[0].ndim:
+                    raise ValueError(
+                        f"py_func output {n!r} has dynamic dim {i} that "
+                        f"cannot be resolved from the inputs; declare a "
+                        f"static shape on the output var")
+                shape[i] = int(xs[0].shape[i])
+        specs.append(jax.ShapeDtypeStruct(tuple(shape), _dt.to_np(var.dtype)))
     outs = jax.pure_callback(lambda *a: fn(*a), tuple(specs), *xs)
     for n, v in zip(out_names, outs):
         ctx.set(n, v)

@@ -1,0 +1,153 @@
+"""``paddle.static`` namespace (reference python/paddle/static/__init__.py)
+assembled over the existing IR/Executor machinery.
+
+BuildStrategy / ExecutionStrategy / CompiledProgram survive as honest
+shims: every pass/fusion/memory knob they carry is XLA's job in this
+framework (SURVEY §2.2 TPU equivalent row), so the classes record the
+settings for API compatibility and the Executor compiles identically.
+"""
+from __future__ import annotations
+
+import contextlib
+
+from ..fluid import scope_guard  # noqa: F401
+from ..framework import (  # noqa: F401
+    Executor,
+    Program,
+    Scope,
+    Variable,
+    default_main_program,
+    default_startup_program,
+    global_scope,
+    program_guard,
+)
+from ..framework.backward import append_backward, calc_gradient  # noqa: F401
+from ..framework import unique_name  # noqa: F401
+from ..fluid.io import (  # noqa: F401
+    load_inference_model,
+    save_inference_model,
+)
+from ..hapi.model import InputSpec  # noqa: F401
+from ..layers import data  # noqa: F401
+from ..param_attr import WeightNormParamAttr  # noqa: F401
+from ..serialization import load, save  # noqa: F401
+
+# static nn layer surface (reference paddle.static.nn)
+from .. import layers as nn  # noqa: F401
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """Reference paddle.static.gradients -> fluid calc_gradient."""
+    return calc_gradient(targets, inputs, target_gradients, no_grad_set)
+
+
+@contextlib.contextmanager
+def name_scope(prefix=None):
+    """Reference fluid.name_scope: prefixes generated var names."""
+    with unique_name.guard(prefix + "/" if prefix else None):
+        yield
+
+
+def cpu_places(device_count=None):
+    from ..framework.place import CPUPlace
+
+    n = device_count or 1
+    return [CPUPlace() for _ in range(n)]
+
+
+def cuda_places(device_ids=None):
+    # CUDA does not exist here; map to the TPU place list for script parity
+    from ..framework.place import TPUPlace
+
+    ids = device_ids if device_ids is not None else [0]
+    return [TPUPlace(i) for i in ids]
+
+
+def tpu_places(device_ids=None):
+    from ..framework.place import TPUPlace
+
+    ids = device_ids if device_ids is not None else [0]
+    return [TPUPlace(i) for i in ids]
+
+
+class BuildStrategy:
+    """Tier-2 config shim (reference details/build_strategy.h): pass
+    toggles are recorded; XLA owns fusion/memory/scheduling."""
+
+    def __init__(self):
+        self.reduce_strategy = 0
+        self.gradient_scale_strategy = 0
+        self.debug_graphviz_path = ""
+        self.enable_inplace = True
+        self.fuse_all_reduce_ops = True
+        self.fuse_elewise_add_act_ops = True
+        self.memory_optimize = True
+        self.sync_batch_norm = False
+        self.enable_auto_fusion = True
+
+
+class ExecutionStrategy:
+    """Tier-2 config shim (reference execution_strategy.h)."""
+
+    def __init__(self):
+        self.num_threads = 1
+        self.num_iteration_per_drop_scope = 1
+        self.num_iteration_per_run = 1
+        self.use_thread_barrier = False
+
+
+class CompiledProgram:
+    """Reference fluid.compiler.CompiledProgram: wraps a Program with
+    build/exec strategies.  The Executor accepts it anywhere a Program
+    goes; with_data_parallel maps to the mesh executor (the reference's
+    ParallelExecutor role is the shard_map path)."""
+
+    def __init__(self, program, build_strategy=None):
+        self._program = program
+        self._build_strategy = build_strategy or BuildStrategy()
+        self._places = None
+
+    def with_data_parallel(self, loss_name=None, build_strategy=None,
+                           exec_strategy=None, share_vars_from=None,
+                           places=None):
+        self._build_strategy = build_strategy or self._build_strategy
+        self._places = places
+        return self
+
+    # duck-type as a Program for Executor.run
+    def __getattr__(self, name):
+        return getattr(self._program, name)
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Reference paddle.static.py_func: embed a host python callable; see
+    ops/misc_ops.py py_func lowering (jax.pure_callback)."""
+    from ..layer_helper import LayerHelper
+    from ..ops import misc_ops
+
+    fid = id(func)
+    misc_ops.register_py_func(fid, func)
+    helper = LayerHelper("py_func")
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    helper.append_op("py_func", {"X": list(xs)}, {"Out": list(outs)},
+                     {"forward_callable_id": fid})
+    return out
+
+
+class Print:  # pragma: no cover - debugging helper
+    def __new__(cls, input, *a, **k):
+        return input
+
+
+__all__ = [
+    "append_backward", "gradients", "Executor", "global_scope",
+    "scope_guard", "BuildStrategy", "CompiledProgram", "ExecutionStrategy",
+    "ParallelExecutor", "program_guard", "WeightNormParamAttr",
+    "default_main_program", "default_startup_program", "Program", "data",
+    "InputSpec", "save", "load", "save_inference_model",
+    "load_inference_model", "cpu_places", "cuda_places", "tpu_places",
+    "Variable", "name_scope", "py_func", "nn",
+]
+
+ParallelExecutor = CompiledProgram  # role collapsed into the mesh Executor

@@ -6,5 +6,6 @@ builder scripts (python/paddle/fluid/tests/unittests/dist_transformer.py,
 contrib ERNIE configs) plus a ``paddle.text`` dataset package.  The static
 BERT builder here is the BASELINE.json config-3 flagship workload.
 """
+from . import datasets  # noqa: F401
 from . import static_models  # noqa: F401
 from .static_models import bert_base_pretrain_program, bert_encoder  # noqa: F401

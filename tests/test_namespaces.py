@@ -1,0 +1,124 @@
+"""2.0 namespace surface: paddle.static / paddle.jit / paddle.text /
+paddle.distribution mirror the reference layout.
+
+Reference parity: python/paddle/static/__init__.py __all__,
+python/paddle/distribution.py, python/paddle/text/.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+def test_static_namespace_surface():
+    import paddle_tpu.static as static
+
+    for name in ["Executor", "Program", "program_guard", "data", "InputSpec",
+                 "save_inference_model", "load_inference_model",
+                 "append_backward", "gradients", "BuildStrategy",
+                 "CompiledProgram", "ExecutionStrategy", "scope_guard",
+                 "global_scope", "default_main_program",
+                 "default_startup_program", "cpu_places", "name_scope",
+                 "py_func", "nn"]:
+        assert hasattr(static, name), name
+
+
+def test_static_trains_through_namespace():
+    import paddle_tpu.static as static
+
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [4])
+        y = static.nn.fc(x, 2)
+        loss = static.nn.mean(y)
+        static.append_backward(loss)
+    exe = static.Executor(pt.CPUPlace())
+    scope = static.Scope()
+    exe.run(startup, scope=scope)
+    out = exe.run(main, feed={"x": np.ones((2, 4), "f4")},
+                  fetch_list=[loss], scope=scope)
+    assert np.isfinite(out[0]).all()
+
+
+def test_compiled_program_duck_types():
+    import paddle_tpu.static as static
+
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [4])
+        y = static.nn.fc(x, 2)
+    cp = static.CompiledProgram(main).with_data_parallel(loss_name=None)
+    exe = static.Executor(pt.CPUPlace())
+    scope = static.Scope()
+    exe.run(startup, scope=scope)
+    out = exe.run(cp._program, feed={"x": np.ones((2, 4), "f4")},
+                  fetch_list=[y], scope=scope)
+    assert out[0].shape == (2, 2)
+
+
+def test_distribution_normal_uniform_categorical():
+    from paddle_tpu.distribution import Categorical, Normal, Uniform
+
+    n = Normal(0.0, 1.0)
+    lp = np.asarray(n.log_prob(0.0).numpy())
+    np.testing.assert_allclose(lp, -0.5 * np.log(2 * np.pi), rtol=1e-5)
+    ent = np.asarray(n.entropy().numpy())
+    np.testing.assert_allclose(ent, 0.5 + 0.5 * np.log(2 * np.pi), rtol=1e-5)
+    n2 = Normal(1.0, 2.0)
+    kl = np.asarray(n.kl_divergence(n2).numpy())
+    want = 0.5 * (0.25 + 0.25 - 1 - np.log(0.25))
+    np.testing.assert_allclose(kl, want, rtol=1e-5)
+
+    u = Uniform(0.0, 2.0)
+    np.testing.assert_allclose(np.asarray(u.log_prob(1.0).numpy()),
+                               -np.log(2.0), rtol=1e-6)
+    s = u.sample([100], seed=7)
+    sv = np.asarray(s.numpy())
+    assert (sv >= 0).all() and (sv < 2).all()
+
+    c = Categorical(np.log(np.array([0.2, 0.3, 0.5], "f4")))
+    np.testing.assert_allclose(np.asarray(c.log_prob(np.array([2])).numpy()),
+                               [np.log(0.5)], rtol=1e-5)
+    ent = np.asarray(c.entropy().numpy())
+    want = -(0.2 * np.log(0.2) + 0.3 * np.log(0.3) + 0.5 * np.log(0.5))
+    np.testing.assert_allclose(ent, want, rtol=1e-4)
+
+
+def test_text_datasets_offline_contract(tmp_path):
+    from paddle_tpu.text.datasets import Imdb, UCIHousing
+
+    with pytest.raises(RuntimeError, match="egress"):
+        UCIHousing(data_file=None)
+    with pytest.raises(FileNotFoundError):
+        Imdb(data_file=str(tmp_path / "nope.tgz"))
+    # real parse path on a synthetic housing file (reference format:
+    # whitespace-separated rows of 14 floats)
+    rows = np.random.RandomState(0).rand(50, 14).astype("f4")
+    f = tmp_path / "housing.data"
+    np.savetxt(f, rows)
+    ds = UCIHousing(data_file=str(f), mode="train")
+    assert len(ds) == 40
+    feat, lbl = ds[0]
+    assert feat.shape == (13,) and lbl.shape == (1,)
+    ds_test = UCIHousing(data_file=str(f), mode="test")
+    assert len(ds_test) == 10
+
+
+def test_py_func_static():
+    import paddle_tpu.static as static
+
+    def double_it(x):
+        return (np.asarray(x) * 2.0).astype("f4")
+
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [3])
+        out = main.global_block.create_var(name="pf_out", shape=[-1, 3],
+                                           dtype="float32")
+        static.py_func(double_it, x, out)
+    exe = static.Executor(pt.CPUPlace())
+    scope = static.Scope()
+    exe.run(startup, scope=scope)
+    res = exe.run(main, feed={"x": np.ones((2, 3), "f4")},
+                  fetch_list=[out], scope=scope)
+    np.testing.assert_allclose(np.asarray(res[0]), 2.0 * np.ones((2, 3)))
