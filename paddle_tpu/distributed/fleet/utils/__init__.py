@@ -1,0 +1,8 @@
+"""fleet.utils: filesystem clients + the HTTP KV rendezvous server.
+
+Reference parity: python/paddle/distributed/fleet/utils/fs.py (LocalFS,
+HDFSClient) and the http_server KV used by RoleMaker's gloo rendezvous
+(role_maker.py:172).
+"""
+from .fs import HDFSClient, LocalFS  # noqa: F401
+from .http_server import KVHandler, KVHTTPServer, KVServer  # noqa: F401

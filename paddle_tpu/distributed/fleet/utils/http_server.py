@@ -1,0 +1,77 @@
+"""HTTP KV server for rendezvous (reference fleet/utils/http_server.py).
+
+RoleMaker's gloo bootstrap in the reference exchanges endpoints through
+this KV; here jax.distributed's coordination service is the primary
+rendezvous, but the KV server survives as transport for custom cluster
+glue (and is exercised by the test suite over real localhost HTTP).
+"""
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class KVHandler(BaseHTTPRequestHandler):
+    def log_message(self, *args):  # quiet
+        pass
+
+    def _key(self):
+        return self.path.lstrip("/")
+
+    def do_GET(self):
+        with self.server.kv_lock:
+            val = self.server.kv.get(self._key())
+        if val is None:
+            self.send_response(404)
+            self.end_headers()
+            return
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(val)))
+        self.end_headers()
+        self.wfile.write(val)
+
+    def do_PUT(self):
+        length = int(self.headers.get("Content-Length", 0))
+        data = self.rfile.read(length)
+        with self.server.kv_lock:
+            self.server.kv[self._key()] = data
+        self.send_response(200)
+        self.end_headers()
+
+    do_POST = do_PUT
+
+    def do_DELETE(self):
+        with self.server.kv_lock:
+            self.server.kv.pop(self._key(), None)
+        self.send_response(200)
+        self.end_headers()
+
+
+class KVHTTPServer(ThreadingHTTPServer):
+    def __init__(self, port, handler=KVHandler):
+        super().__init__(("", port), handler)
+        self.kv = {}
+        self.kv_lock = threading.Lock()
+
+
+class KVServer:
+    """Reference KVServer: start/stop a background KV HTTP server."""
+
+    def __init__(self, port, size=None):
+        self.http_server = KVHTTPServer(port, KVHandler)
+        self.listen_thread = None
+
+    @property
+    def port(self):
+        return self.http_server.server_address[1]
+
+    def start(self):
+        self.listen_thread = threading.Thread(
+            target=self.http_server.serve_forever, daemon=True)
+        self.listen_thread.start()
+
+    def stop(self):
+        self.http_server.shutdown()
+        if self.listen_thread is not None:
+            self.listen_thread.join()
+        self.http_server.server_close()

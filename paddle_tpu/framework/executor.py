@@ -213,6 +213,16 @@ class Executor:
         if localsgd is not None:
             localsgd.average_step(self, scope=scope)
 
+        # auto-checkpoint hook (reference executor.py:1200): cheap env
+        # check; does nothing unless configured
+        import os as _os
+
+        if _os.environ.get("PADDLE_RUNNING_ENV") == \
+                "PADDLE_EDL_AUTO_CHECKPOINT" or _acp_configured():
+            from ..incubate.checkpoint import auto_checkpoint as _acp
+
+            _acp.on_executor_run(self, program, scope, fed=bool(feed))
+
         if return_numpy:
             return [np.asarray(v) for v in fetches]
         return list(fetches)
@@ -788,6 +798,13 @@ class Executor:
 
 def _is_jax_array(x) -> bool:
     return hasattr(x, "sharding") and hasattr(x, "dtype")
+
+
+def _acp_configured() -> bool:
+    import sys
+
+    acp = sys.modules.get("paddle_tpu.incubate.checkpoint.auto_checkpoint")
+    return acp is not None and acp._cfg is not None
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,2 @@
+"""``paddle.incubate``-role namespace (reference fluid/incubate)."""
+from . import checkpoint  # noqa: F401

@@ -1,0 +1,170 @@
+"""Auto-checkpoint: periodic save from Executor.run with deterministic
+resume.
+
+Role parity: reference fluid/incubate/checkpoint/auto_checkpoint.py:71
+(`AutoCheckpointChecker`, `train_epoch_range`, the `_auto_checkpoint`
+hook in Executor.run at executor.py:1200).  TPU-native simplifications:
+checkpoints go through the existing var_io format (the fresh-process
+resume parity test is the oracle), the RNG key and an epoch/step counter
+are saved alongside the persistables, and the rank-0 process writes on
+multi-process runs.
+
+Enable via env (reference contract) or explicitly::
+
+    PADDLE_RUNNING_ENV=PADDLE_EDL_AUTO_CHECKPOINT \
+    PADDLE_EDL_HDFS_CHECKPOINT_PATH=/ckpt/dir  python train.py
+
+    # or
+    auto_checkpoint.configure(dir, save_interval_s=10)
+    for epoch in auto_checkpoint.train_epoch_range("job1", 10):
+        exe.run(...)   # saves on the configured cadence, resumes on boot
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Optional
+
+import numpy as np
+
+_cfg = None
+
+
+class _Config:
+    def __init__(self, dirname, save_interval_s=10.0, every_n_steps=None):
+        self.dirname = dirname
+        self.save_interval_s = save_interval_s
+        self.every_n_steps = every_n_steps
+        self.last_save = 0.0
+        self.step = 0
+        self.epoch_state = {}
+
+
+def _env_config() -> Optional[_Config]:
+    if os.environ.get("PADDLE_RUNNING_ENV") != "PADDLE_EDL_AUTO_CHECKPOINT":
+        return None
+    path = os.environ.get("PADDLE_EDL_HDFS_CHECKPOINT_PATH")
+    if not path:
+        return None
+    interval = float(os.environ.get("PADDLE_EDL_SAVE_CHECKPOINT_INTER", "10"))
+    return _Config(path, save_interval_s=interval)
+
+
+def configure(dirname, save_interval_s=10.0, every_n_steps=None):
+    """Programmatic enable (tests / single scripts)."""
+    global _cfg
+    _cfg = _Config(dirname, save_interval_s, every_n_steps)
+    return _cfg
+
+
+def disable():
+    global _cfg
+    _cfg = None
+
+
+def _active() -> Optional[_Config]:
+    global _cfg
+    if _cfg is None:
+        _cfg = _env_config()
+    return _cfg
+
+
+def _is_rank0() -> bool:
+    return int(os.environ.get("PADDLE_TRAINER_ID", "0") or 0) == 0
+
+
+def _ckpt_dir(cfg):
+    return os.path.join(cfg.dirname, "auto_ckpt")
+
+
+def save_checkpoint(exe, program, scope, cfg=None):
+    """Write persistables + RNG + counters (reference save_checkpoint)."""
+    from ...fluid import io as fluid_io
+    from ...framework.executor import RNG_VAR
+    from ...framework.scope import global_scope
+
+    cfg = cfg or _active()
+    scope = scope or global_scope()
+    out = _ckpt_dir(cfg)
+    os.makedirs(out, exist_ok=True)
+    from ...fluid import scope_guard
+
+    with scope_guard(scope):
+        fluid_io.save_persistables(exe, out, main_program=program,
+                                   filename="persistables")
+    meta = {"step": cfg.step, "epoch_state": cfg.epoch_state,
+            "time": time.time()}
+    rng = scope.get_var(RNG_VAR) if scope.has_var(RNG_VAR) else None
+    if rng is not None:
+        meta["rng"] = np.asarray(rng).tolist()
+    tmp = os.path.join(out, "meta.json.tmp")
+    with open(tmp, "w") as f:
+        json.dump(meta, f)
+    os.replace(tmp, os.path.join(out, "meta.json"))  # atomic publish
+
+
+def load_checkpoint(exe, program, scope, cfg=None) -> Optional[dict]:
+    """Restore a previous run's state; returns the meta dict or None."""
+    from ...fluid import io as fluid_io
+    from ...framework.executor import RNG_VAR
+    from ...framework.scope import global_scope
+
+    cfg = cfg or _active()
+    out = _ckpt_dir(cfg)
+    meta_path = os.path.join(out, "meta.json")
+    if not os.path.exists(meta_path):
+        return None
+    scope = scope or global_scope()
+    from ...fluid import scope_guard
+
+    with scope_guard(scope):
+        fluid_io.load_persistables(exe, out, main_program=program,
+                                   filename="persistables")
+    with open(meta_path) as f:
+        meta = json.load(f)
+    if "rng" in meta:
+        import jax.numpy as jnp
+
+        scope.set_var(RNG_VAR, jnp.asarray(np.asarray(meta["rng"],
+                                                      np.uint32)))
+    cfg.step = int(meta.get("step", 0))
+    cfg.epoch_state = dict(meta.get("epoch_state", {}))
+    return meta
+
+
+def on_executor_run(exe, program, scope, fed=True):
+    """The Executor.run hook (reference executor.py:1200): counts steps
+    and saves on the configured cadence from rank 0.  Only fed runs count
+    as steps — startup/init programs carry no feeds."""
+    cfg = _active()
+    if cfg is None or not _is_rank0() or not fed:
+        return
+    cfg.step += 1
+    due = False
+    if cfg.every_n_steps:
+        due = cfg.step % cfg.every_n_steps == 0
+    else:
+        due = (time.time() - cfg.last_save) >= cfg.save_interval_s
+    if due:
+        save_checkpoint(exe, program, scope, cfg)
+        cfg.last_save = time.time()
+
+
+class train_epoch_range:
+    """Reference `acp.train_epoch_range(name, max_epoch)`: iterate epochs,
+    skipping the ones a restored checkpoint already finished."""
+
+    def __init__(self, name, max_epoch_num):
+        self.name = name
+        self.max = max_epoch_num
+
+    def __iter__(self):
+        cfg = _active()
+        start = 0
+        if cfg is not None:
+            start = int(cfg.epoch_state.get(self.name, 0))
+        for e in range(start, self.max):
+            yield e
+            if cfg is not None:
+                cfg.epoch_state[self.name] = e + 1
