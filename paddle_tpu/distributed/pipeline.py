@@ -15,17 +15,21 @@ ranks, and each stage's backward is `jax.vjp` of its traced forward.
 GPipe flush schedule: K micro-batch forwards fill the pipe, then K
 backwards drain it; per-stage gradients are psum'd over the axis and feed
 the program's own optimizer ops, so parameters stay replicated and every
-rank applies the identical update (memory-sharded stage params are a
-later milestone; correctness parity with the non-pipelined program is
-the v1 contract).
+rank applies the identical update.
 
-v1 restrictions (loud errors, not silent wrongness):
-- every stage boundary passes exactly ONE activation tensor and all
-  boundaries share one shape/dtype (equal-width trunks — true for
-  transformer stacks; ppermute is SPMD and needs rank-uniform buffers);
-- no RNG ops (dropout) inside staged forwards;
-- the 'pp' axis carries only pipeline parallelism (dp x pp composition
-  is a later milestone).
+v2 capabilities (v1's restrictions lifted):
+- dropout/RNG inside stages: the key is fold_in(program_key, stage,
+  microbatch), so the backward vjp replay regenerates identical masks;
+- state written in staged forwards (batch_norm running stats) is carried
+  tick-to-tick on the owning rank and published from it at the end;
+- boundaries may pass MULTIPLE float tensors with non-uniform shapes:
+  each boundary packs into one flat carrier buffer padded to the widest
+  boundary (rank-uniform, ppermute-able), unpacked by the next stage;
+- dp x pp meshes: feeds shard over 'dp', the schedule runs per dp
+  shard, grads psum over both axes.
+
+Remaining restrictions (loud errors): loss-only fetches; boundary
+tensors must be floating point.
 """
 from __future__ import annotations
 
@@ -37,7 +41,7 @@ def analyze_stages(program, n_stages: int):
 
     Untagged ops inherit the previous op's stage (build order), starting
     at stage 0.  Returns (stage_ops, boundary_vars): boundary_vars[s] is
-    the single activation passed from stage s to s+1.
+    the LIST of activations stage s hands to later stages.
     """
     meta = getattr(program, "_pipeline", None)
     fwd_end = meta["fwd_end"] if meta else len(program.global_block.ops)
@@ -65,22 +69,24 @@ def analyze_stages(program, n_stages: int):
         stage_ops[cur].append(op)
 
     boundaries = []
+    produced_upto = set()
     for s in range(n_stages - 1):
-        produced_here = {n for op in stage_ops[s]
-                         for n in op.output_arg_names()}
+        produced_upto |= {n for op in stage_ops[s]
+                          for n in op.output_arg_names()}
         consumed = set()
         for later in range(s + 1, n_stages):
             for op in stage_ops[later]:
                 for n in op.input_arg_names():
-                    if n in produced_here:
+                    if n in produced_upto:
                         consumed.add(n)
+        # cumulative: vars produced at ANY stage <= s and consumed later
+        # ride every intervening boundary (skip connections pass through)
         act = sorted(consumed)
-        if len(act) != 1:
+        if not act:
             raise ValueError(
-                f"pipeline stage boundary {s}->{s + 1} must pass exactly "
-                f"one activation tensor, found {act or 'none'}; restructure "
-                f"the model so each stage hands one tensor to the next")
-        boundaries.append(act[0])
+                f"pipeline stage boundary {s}->{s + 1} passes no tensors; "
+                f"every stage must feed the next")
+        boundaries.append(act)
     return stage_ops, boundaries
 
 
@@ -106,6 +112,8 @@ def build_pipeline_fn(program, mesh, feed_names, state_mut, state_const,
         raise ValueError(
             f"pipeline execution needs a 'pp' mesh axis; got "
             f"{mesh.axis_names}")
+    dp_axis = "dp" if "dp" in mesh.axis_names else None
+    dp_size = int(mesh.shape[dp_axis]) if dp_axis else 1
     S = int(mesh.shape[pp_axis])
     K = int(n_microbatches)
     stage_ops, boundaries = analyze_stages(program, S)
@@ -120,25 +128,25 @@ def build_pipeline_fn(program, mesh, feed_names, state_mut, state_const,
     opt_ops = [op for op in block.ops[bwd_end:]
                if op.type not in PSEUDO_OPS]
 
-    # v1: stage forwards run in throwaway per-microbatch envs, so state
-    # they write (batch_norm running stats) would be silently dropped —
-    # reject such programs loudly
+    # state written inside staged forwards (batch_norm running stats):
+    # carried tick-to-tick on the owning stage's rank, published at the end
     state_out_set = set(state_out)
     param_names = set(grad_of)
-    fwd_state_writes = sorted({
-        n for ops in stage_ops for op in ops
-        for n in op.output_arg_names()
-        if n in state_out_set and n not in param_names
-    } - {n for op in opt_ops for n in op.output_arg_names()})
-    if fwd_state_writes:
-        raise NotImplementedError(
-            f"pipeline v1 cannot persist state written inside staged "
-            f"forwards (e.g. batch_norm running stats): {fwd_state_writes}; "
-            f"use use_global_stats/layer_norm, or train non-pipelined")
+    opt_writes = {n for op in opt_ops for n in op.output_arg_names()}
+    carried_owner: Dict[str, int] = {}
+    for s, ops in enumerate(stage_ops):
+        for op in ops:
+            for n in op.output_arg_names():
+                if n in state_out_set and n not in param_names \
+                        and n not in opt_writes:
+                    carried_owner[n] = s
+    carried_names = sorted(carried_owner)
 
-    def trace_ops(ops, env):
-        ctx = LoweringContext(block, env, rng_key=None, mesh=mesh,
-                              axis_env=(pp_axis,))
+    def trace_ops(ops, env, rng_key=None):
+        axes = (pp_axis,) + ((dp_axis,) if dp_axis else ())
+        ctx = LoweringContext(block, env, rng_key=rng_key, mesh=mesh,
+                              axis_env=axes,
+                              fold_axes=(dp_axis,) if dp_axis else ())
         for op in ops:
             try:
                 get_lowering(op.type)(ctx, op)
@@ -168,79 +176,122 @@ def build_pipeline_fn(program, mesh, feed_names, state_mut, state_const,
                     f"count {K}")
             mb_feeds[n] = v.reshape((K, b // K) + v.shape[1:])
 
-        def stage_fwd(s, prm, act_in, mb_idx):
-            """Uniform output: (boundary_act_or_zeros, loss_or_zero)."""
+        # ---- probe boundary structures stage by stage -------------------
+        mb_structs = {n: jax.ShapeDtypeStruct((v.shape[1],) + v.shape[2:],
+                                              v.dtype)
+                      for n, v in mb_feeds.items()}
+
+        def probe_stage(s, in_structs):
+            def f(acts_in):
+                env = dict(base_env)
+                env.update(params)
+                for n, sd in mb_structs.items():
+                    env[n] = jnp.zeros(sd.shape, sd.dtype)
+                if s > 0:
+                    env.update(dict(zip(boundaries[s - 1], acts_in)))
+                trace_ops(stage_ops[s], env,
+                          rng_key=jax.random.PRNGKey(0))
+                return tuple(jnp.asarray(env[n]) for n in boundaries[s])
+
+            dummy = tuple(jnp.zeros(sd.shape, sd.dtype)
+                          for sd in (in_structs or ()))
+            return jax.eval_shape(f, dummy)
+
+        bnd_structs = []  # per boundary: tuple of ShapeDtypeStructs
+        prev = None
+        for s in range(S - 1):
+            prev = probe_stage(s, prev)
+            bnd_structs.append(prev)
+        for structs, names in zip(bnd_structs, boundaries):
+            for sd, n in zip(structs, names):
+                if not jnp.issubdtype(sd.dtype, jnp.floating):
+                    raise NotImplementedError(
+                        f"pipeline boundary tensor {n!r} has non-float "
+                        f"dtype {sd.dtype}; route integer data to every "
+                        f"stage via feeds instead")
+
+        # ---- flat f32 carrier buffer, padded to the widest boundary -----
+        def _size(sd):
+            n = 1
+            for d in sd.shape:
+                n *= int(d)
+            return n
+
+        widths = [sum(_size(sd) for sd in structs)
+                  for structs in bnd_structs]
+        width = max(widths) if widths else 1
+        zero_act = jnp.zeros((width,), jnp.float32)
+
+        def pack(s, vals):
+            flat = [jnp.ravel(v).astype(jnp.float32) for v in vals]
+            buf = jnp.concatenate(flat) if flat else zero_act
+            return jnp.pad(buf, (0, width - buf.shape[0]))
+
+        def unpack(s, buf):
+            vals = []
+            off = 0
+            for sd in bnd_structs[s]:
+                n = _size(sd)
+                vals.append(buf[off:off + n].reshape(sd.shape)
+                            .astype(sd.dtype))
+                off += n
+            return vals
+
+        def stage_key(rng_key, s, mb_idx):
+            # deterministic per (stage, microbatch): the backward vjp
+            # replays the forward with the same key -> identical dropout
+            # masks (the correctness crux of RNG under GPipe)
+            return jax.random.fold_in(jax.random.fold_in(rng_key, mb_idx), s)
+
+        def stage_fwd(s, prm, carried, act_buf, mb_idx, rng_key):
+            """Uniform output across branches:
+            (out_buf, loss, new_carried)."""
             env = dict(base_env)
+            env.update(carried)
             env.update(prm)
             for n, v in mb_feeds.items():
                 env[n] = lax.dynamic_index_in_dim(v, mb_idx, 0,
                                                   keepdims=False)
             if s > 0:
-                env[boundaries[s - 1]] = act_in
-            trace_ops(stage_ops[s], env)
+                env.update(dict(zip(boundaries[s - 1], unpack(s - 1, act_buf))))
+            trace_ops(stage_ops[s], env, rng_key=stage_key(rng_key, s, mb_idx))
+            new_carried = {
+                n: (env[n] if carried_owner[n] == s else carried[n])
+                for n in carried_names
+            }
             if s < S - 1:
-                return (jnp.asarray(env[boundaries[s]]),
-                        jnp.zeros((), jnp.float32))
+                out_buf = pack(s, [env[n] for n in boundaries[s]])
+                return out_buf, jnp.zeros((), jnp.float32), new_carried
             loss = jnp.asarray(env[loss_name], jnp.float32).reshape(())
-            return (jnp.zeros(act_shape, act_dtype), loss)
-
-        # boundary shape (uniformity enforced): probe stage chain
-        mb_structs = {n: jax.ShapeDtypeStruct((v.shape[1],) + v.shape[2:],
-                                              v.dtype)
-                      for n, v in mb_feeds.items()}
-
-        def probe_stage(s, act_sd):
-            def f(act_in):
-                env = {n: jnp.zeros(sd.shape, sd.dtype)
-                       for n, sd in mb_structs.items()}
-                env.update(base_env)
-                env.update(params)
-                # feeds win over state on name clash
-                for n, sd in mb_structs.items():
-                    env[n] = jnp.zeros(sd.shape, sd.dtype)
-                if s > 0:
-                    env[boundaries[s - 1]] = act_in
-                trace_ops(stage_ops[s], env)
-                return jnp.asarray(env[boundaries[s]])
-
-            return jax.eval_shape(
-                f, act_sd if act_sd is not None
-                else jax.ShapeDtypeStruct((), jnp.float32))
-
-        act_sd = None
-        for s in range(S - 1):
-            sd = probe_stage(s, act_sd)
-            if act_sd is not None and (sd.shape, sd.dtype) != \
-                    (act_sd.shape, act_sd.dtype):
-                raise ValueError(
-                    f"pipeline boundary {s} activation "
-                    f"{sd.dtype}{sd.shape} differs from earlier boundary "
-                    f"{act_sd.dtype}{act_sd.shape}; v1 needs uniform "
-                    f"boundary shapes")
-            act_sd = sd
-        act_shape, act_dtype = act_sd.shape, act_sd.dtype
-        zero_act = jnp.zeros(act_shape, act_dtype)
+            return zero_act, loss, new_carried
 
         branches = [
-            (lambda prm, a, i, s=s: stage_fwd(s, prm, a, i))
+            (lambda prm, c, a, i, k, s=s: stage_fwd(s, prm, c, a, i, k))
             for s in range(S)
         ]
 
-        def switch_fwd(prm, act_in, mb_idx):
-            return lax.switch(r, branches, prm, act_in, mb_idx)
+        def switch_fwd(prm, carried, act_buf, mb_idx, rng_key):
+            return lax.switch(r, branches, prm, carried, act_buf, mb_idx,
+                              rng_key)
 
         fwd_perm = [(i, i + 1) for i in range(S - 1)]
         bwd_perm = [(i + 1, i) for i in range(S - 1)]
 
         # ---- forward fill (K + S - 1 ticks) -----------------------------
         T = K + S - 1
-        saved_in = jnp.zeros((K,) + act_shape, act_dtype)
+        saved_in = jnp.zeros((K, width), jnp.float32)
         losses = jnp.zeros((K,), jnp.float32)
+        carried = {n: base_env[n] for n in carried_names}
         recv = zero_act
         for t in range(T):
             mb = jnp.clip(t - r, 0, K - 1)
             active = jnp.logical_and(t - r >= 0, t - r < K)
-            act_out, loss_mb = switch_fwd(params, recv, mb)
+            act_out, loss_mb, new_carried = switch_fwd(
+                params, carried, recv, mb, rng)
+            carried = {
+                n: jnp.where(active, new_carried[n], carried[n])
+                for n in carried_names
+            }
             # remember this tick's stage INPUT for the backward vjp
             prev = lax.dynamic_index_in_dim(saved_in, mb, 0, keepdims=False)
             upd = jnp.where(active, recv, prev)
@@ -251,9 +302,14 @@ def build_pipeline_fn(program, mesh, feed_names, state_mut, state_const,
             recv = lax.ppermute(send, pp_axis, fwd_perm)
 
         # ---- backward drain (K + S - 1 ticks) ---------------------------
+        # backward replays the forward with the SAME carried snapshot the
+        # vjp does not need exact per-tick stats (grads of running-stat
+        # updates are zero: they are stop-gradient outputs)
         def stage_bwd(prm, act_in, mb_idx, g_act, g_loss):
             def f(prm_, act_in_):
-                return switch_fwd(prm_, act_in_, mb_idx)
+                out_buf, loss, _ = switch_fwd(prm_, carried, act_in_,
+                                              mb_idx, rng)
+                return out_buf, loss
 
             _, vjp = jax.vjp(f, prm, act_in)
             gp, gact = vjp((g_act, g_loss))
@@ -280,11 +336,29 @@ def build_pipeline_fn(program, mesh, feed_names, state_mut, state_const,
             g_send = jnp.where(active, gact, zero_act)
             g_recv = lax.ppermute(g_send, pp_axis, bwd_perm)
 
-        # grads live on the owning stage's rank; psum replicates them so
-        # every rank applies the identical optimizer update
-        grad_acc = jax.tree.map(lambda g: lax.psum(g, pp_axis), grad_acc)
+        # grads live on the owning stage's rank; psum over pp replicates
+        # them, psum over dp completes data parallelism
+        grad_axes = (pp_axis,) + ((dp_axis,) if dp_axis else ())
+        grad_acc = jax.tree.map(
+            lambda g: lax.psum(g, grad_axes)
+            / (dp_size if dp_axis else 1), grad_acc)
+
+        # publish carried state from its owning rank (other ranks still
+        # hold the initial value); under dp the shards saw different data
+        # so running stats are pmean'd — same approximation sync-free BN
+        # makes in the reference's multi-device path
+        final_carried = {}
+        for n in carried_names:
+            owner = carried_owner[n]
+            v = carried[n]
+            picked = jnp.where(r == owner, v, jnp.zeros_like(v))
+            out = lax.psum(picked, pp_axis)
+            if dp_axis:
+                out = lax.pmean(out, dp_axis)
+            final_carried[n] = out
 
         env = dict(base_env)
+        env.update(final_carried)
         for pname, gname in grad_of.items():
             env[gname] = grad_acc[pname]
         trace_ops(opt_ops, env)
@@ -292,14 +366,19 @@ def build_pipeline_fn(program, mesh, feed_names, state_mut, state_const,
         # full-batch mean loss, present on the last rank; psum-broadcast
         loss_sum = jnp.where(r == S - 1, losses.sum(), 0.0)
         mean_loss = lax.psum(loss_sum, pp_axis) / K
+        if dp_axis:
+            mean_loss = lax.pmean(mean_loss, dp_axis)
         fetches = tuple(mean_loss for _ in fetch_names)
         new_state = tuple(env[n] for n in state_out)
-        return fetches, new_state, rng
+        new_rng = jax.random.split(rng, 2)[0]
+        return fetches, new_state, new_rng
 
+    in_feed_specs = tuple(
+        (P(dp_axis) if dp_axis else P()) for _ in feed_names)
     return shard_map(
         traced,
         mesh=mesh,
-        in_specs=(tuple(P() for _ in feed_names),
+        in_specs=(in_feed_specs,
                   tuple(P() for _ in state_mut),
                   tuple(P() for _ in state_const),
                   P()),

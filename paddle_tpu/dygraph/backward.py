@@ -40,7 +40,13 @@ def _node_backward_recorded(node, fwd_float, grads):
         if g is None:
             g = Tensor(jnp.zeros_like(t._value), stop_gradient=True)
         elif not isinstance(g, Tensor):
-            g = Tensor(g, stop_gradient=True)
+            g = Tensor(jnp.asarray(g, dtype=t._value.dtype),
+                       stop_gradient=True)
+        elif g._value.dtype != t._value.dtype:
+            # vjp rejects cotangents whose dtype differs from the primal
+            # (same coercion the non-recorded path applies)
+            g = eager.apply_jax(
+                lambda v, dt=t._value.dtype: v.astype(dt), g)
         cot_tensors.append(g)
 
     n_in = len(node.in_tensors)

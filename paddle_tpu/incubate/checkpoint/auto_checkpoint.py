@@ -39,6 +39,7 @@ class _Config:
         self.last_save = 0.0
         self.step = 0
         self.epoch_state = {}
+        self.resume_attempted = False
 
 
 def _env_config() -> Optional[_Config]:
@@ -149,6 +150,17 @@ def on_executor_run(exe, program, scope, fed=True):
     if due:
         save_checkpoint(exe, program, scope, cfg)
         cfg.last_save = time.time()
+
+
+def maybe_resume(exe, program, scope, fed=True):
+    """Pre-run hook: on a restarted job, restore the previous snapshot
+    BEFORE the first counted step executes (the env-mode resume contract;
+    reference AutoCheckpointChecker restores epoch ranges the same way)."""
+    cfg = _active()
+    if cfg is None or not fed or cfg.resume_attempted:
+        return
+    cfg.resume_attempted = True
+    load_checkpoint(exe, program, scope, cfg)
 
 
 class train_epoch_range:

@@ -73,31 +73,185 @@ class TestPipelineParity:
         got = _train(main2, startup2, loss2, X, Y, steps=4, mesh=mesh)
         np.testing.assert_allclose(base, got, rtol=1e-4, atol=1e-6)
 
-    def test_boundary_must_be_single_tensor(self):
+    def test_multi_tensor_boundary_parity(self):
+        """v2: boundaries may pass several tensors (packed carrier)."""
         import jax
 
         from paddle_tpu.initializer import ConstantInitializer
         from paddle_tpu.param_attr import ParamAttr
 
-        main, startup = Program(), Program()
-        with unique_name.guard(), program_guard(main, startup):
-            x = layers.data("x", [8])
-            y = layers.data("y", [1])
-            with device_guard("stage:0"):
-                h1 = layers.fc(x, 8, param_attr=ParamAttr(
-                    initializer=ConstantInitializer(0.1)), bias_attr=False)
-                h2 = layers.fc(x, 8, param_attr=ParamAttr(
-                    initializer=ConstantInitializer(0.1)), bias_attr=False)
-            with device_guard("stage:1"):
-                both = layers.elementwise_add(h1, h2)  # two boundary vars
-                pred = layers.fc(both, 1, bias_attr=False)
-                loss = layers.mean(layers.square_error_cost(pred, y))
-            PipelineOptimizer(MomentumOptimizer(0.05, 0.9),
-                              num_microbatches=2).minimize(loss)
-        mesh = jax.sharding.Mesh(np.array(jax.devices()[:2]), ("pp",))
+        def build():
+            main, startup = Program(), Program()
+            main.random_seed = 1
+            with unique_name.guard(), program_guard(main, startup):
+                x = layers.data("x", [8])
+                y = layers.data("y", [1])
+                with device_guard("stage:0"):
+                    h1 = layers.fc(x, 8, param_attr=ParamAttr(
+                        initializer=ConstantInitializer(0.1)),
+                        bias_attr=False)
+                    h2 = layers.fc(x, 12, param_attr=ParamAttr(
+                        initializer=ConstantInitializer(0.05)),
+                        bias_attr=False)  # two boundary vars, ragged widths
+                with device_guard("stage:1"):
+                    h2s = layers.fc(h2, 8, param_attr=ParamAttr(
+                        initializer=ConstantInitializer(0.03)),
+                        bias_attr=False)
+                    both = layers.elementwise_add(h1, h2s)
+                    pred = layers.fc(both, 1, param_attr=ParamAttr(
+                        initializer=ConstantInitializer(0.2)),
+                        bias_attr=False)
+                    loss = layers.mean(layers.square_error_cost(pred, y))
+                PipelineOptimizer(MomentumOptimizer(0.05, 0.9),
+                                  num_microbatches=2).minimize(loss)
+            return main, startup, loss
+
         X, Y = _data(8)
-        with pytest.raises(ValueError, match="exactly.*one activation|one tensor"):
-            _train(main, startup, loss, X, Y, steps=1, mesh=mesh)
+        base = _train(*build(), X, Y, steps=3)
+        mesh = jax.sharding.Mesh(np.array(jax.devices()[:2]), ("pp",))
+        got = _train(*build(), X, Y, steps=3, mesh=mesh)
+        np.testing.assert_allclose(base, got, rtol=1e-4, atol=1e-6)
+
+    def test_skip_connection_across_three_stages(self):
+        """v2: a stage-0 output consumed at stage 2 rides through the
+        intermediate boundary (pass-through packing)."""
+        import jax
+
+        from paddle_tpu.initializer import ConstantInitializer
+        from paddle_tpu.param_attr import ParamAttr
+
+        def build():
+            main, startup = Program(), Program()
+            main.random_seed = 1
+            with unique_name.guard(), program_guard(main, startup):
+                x = layers.data("x", [8])
+                y = layers.data("y", [1])
+                with device_guard("stage:0"):
+                    h0 = layers.fc(x, 8, param_attr=ParamAttr(
+                        initializer=ConstantInitializer(0.1)),
+                        bias_attr=False)
+                with device_guard("stage:1"):
+                    h1 = layers.fc(h0, 8, act="relu", param_attr=ParamAttr(
+                        initializer=ConstantInitializer(0.07)),
+                        bias_attr=False)
+                with device_guard("stage:2"):
+                    res = layers.elementwise_add(h0, h1)  # skip from stage 0
+                    pred = layers.fc(res, 1, param_attr=ParamAttr(
+                        initializer=ConstantInitializer(0.2)),
+                        bias_attr=False)
+                    loss = layers.mean(layers.square_error_cost(pred, y))
+                PipelineOptimizer(MomentumOptimizer(0.05, 0.9),
+                                  num_microbatches=2).minimize(loss)
+            return main, startup, loss
+
+        X, Y = _data(8)
+        base = _train(*build(), X, Y, steps=3)
+        mesh = jax.sharding.Mesh(np.array(jax.devices()[:3]), ("pp",))
+        got = _train(*build(), X, Y, steps=3, mesh=mesh)
+        np.testing.assert_allclose(base, got, rtol=1e-4, atol=1e-6)
+
+    def test_dropout_pipeline_deterministic_and_trains(self):
+        """v2: dropout inside stages — deterministic across identical
+        runs (fwd/bwd masks match by construction) and the loss drops."""
+        import jax
+
+        from paddle_tpu.initializer import ConstantInitializer
+        from paddle_tpu.param_attr import ParamAttr
+
+        def build():
+            main, startup = Program(), Program()
+            main.random_seed = 7
+            with unique_name.guard(), program_guard(main, startup):
+                x = layers.data("x", [8])
+                y = layers.data("y", [1])
+                with device_guard("stage:0"):
+                    h = layers.fc(x, 16, act="relu", param_attr=ParamAttr(
+                        initializer=ConstantInitializer(0.1)),
+                        bias_attr=False)
+                    h = layers.dropout(h, 0.25)
+                with device_guard("stage:1"):
+                    pred = layers.fc(h, 1, param_attr=ParamAttr(
+                        initializer=ConstantInitializer(0.2)),
+                        bias_attr=False)
+                    loss = layers.mean(layers.square_error_cost(pred, y))
+                PipelineOptimizer(MomentumOptimizer(0.05, 0.9),
+                                  num_microbatches=2).minimize(loss)
+            return main, startup, loss
+
+        X, Y = _data(16)
+        mesh = jax.sharding.Mesh(np.array(jax.devices()[:2]), ("pp",))
+        a = _train(*build(), X, Y, steps=6, mesh=mesh)
+        b = _train(*build(), X, Y, steps=6, mesh=mesh)
+        np.testing.assert_allclose(a, b, rtol=1e-6)
+        assert a[-1] < a[0], a
+
+    def test_batch_norm_running_stats_carried(self):
+        """v2: state written inside staged forwards (BN running stats) is
+        carried per microbatch on the owning rank and persists to the
+        scope, matching the non-pipelined run."""
+        import jax
+
+        from paddle_tpu.initializer import ConstantInitializer
+        from paddle_tpu.param_attr import ParamAttr
+
+        def build():
+            main, startup = Program(), Program()
+            main.random_seed = 1
+            with unique_name.guard(), program_guard(main, startup):
+                x = layers.data("x", [8])
+                y = layers.data("y", [1])
+                with device_guard("stage:0"):
+                    h = layers.fc(x, 8, param_attr=ParamAttr(
+                        initializer=ConstantInitializer(0.1)),
+                        bias_attr=False)
+                    h = layers.batch_norm(h)
+                with device_guard("stage:1"):
+                    pred = layers.fc(h, 1, param_attr=ParamAttr(
+                        initializer=ConstantInitializer(0.2)),
+                        bias_attr=False)
+                    loss = layers.mean(layers.square_error_cost(pred, y))
+                PipelineOptimizer(MomentumOptimizer(0.05, 0.9),
+                                  num_microbatches=2).minimize(loss)
+            return main, startup, loss
+
+        def run(mesh):
+            main, startup, loss = build()
+            sc = pt.framework.Scope()
+            exe = pt.Executor(pt.CPUPlace(), mesh=mesh)
+            exe.run(startup, scope=sc)
+            X, Y = _data(8)
+            losses = [float(np.asarray(
+                exe.run(main, feed={"x": X, "y": Y}, fetch_list=[loss],
+                        scope=sc)[0]).item()) for _ in range(3)]
+            # running mean/var are the BN layer's global vars (.gv_0/.gv_1)
+            mean_name = next(n for n in sorted(sc.local_var_names())
+                             if "batch_norm" in n and ".gv_" in n)
+            return losses, np.asarray(sc.get_var(mean_name))
+
+        # GPipe BN normalizes each MICROBATCH (reference semantics too),
+        # so exact loss parity with the full-batch run does not hold;
+        # the v2 contract is: stats update, persist, and are
+        # deterministic, and training proceeds.
+        mesh = jax.sharding.Mesh(np.array(jax.devices()[:2]), ("pp",))
+        pp_losses, pp_mean = run(mesh)
+        pp_losses2, pp_mean2 = run(mesh)
+        assert np.isfinite(pp_losses).all() and pp_losses[-1] < pp_losses[0]
+        assert np.any(pp_mean != 0.0), "running mean never updated"
+        np.testing.assert_allclose(pp_mean, pp_mean2, rtol=1e-6)
+        np.testing.assert_allclose(pp_losses, pp_losses2, rtol=1e-6)
+
+    def test_dp_x_pp_composition_parity(self):
+        """v2: 2x2 dp x pp mesh matches the single-device run."""
+        import jax
+
+        X, Y = _data(32)
+        main, startup, loss = _build(2)
+        base = _train(main, startup, loss, X, Y, steps=3)
+        mesh = jax.sharding.Mesh(
+            np.array(jax.devices()[:4]).reshape(2, 2), ("dp", "pp"))
+        main2, startup2, loss2 = _build(2)
+        got = _train(main2, startup2, loss2, X, Y, steps=3, mesh=mesh)
+        np.testing.assert_allclose(base, got, rtol=1e-4, atol=1e-6)
 
 
 class TestPipelineFleet:
