@@ -55,6 +55,34 @@ _LIST_OUT_OPS = {"split": "Out", "unstack": "Y", "meshgrid": "Out",
 _TRACE_REC = None
 
 
+# bound on first use (amp imports the framework; keep eager import-light)
+_AMP_STATE = None
+
+
+def _amp_policy(op_type):
+    """Dygraph autocast policy (reference imperative/amp_auto_cast.cc
+    NeedCast:51): returns (cast_dtype_or_None, gray_follow_dtype_or_None)
+    CAPTURED AT RECORD TIME — backward replay outside the auto_cast scope
+    must cast exactly as the forward did.  Casting happens INSIDE the
+    recorded fwd closure so vjp differentiates through it (grads reach
+    fp32 master params)."""
+    global _AMP_STATE
+    if _AMP_STATE is None:
+        from ..amp import amp_state
+
+        _AMP_STATE = amp_state()
+    st = _AMP_STATE
+    if not st.enabled:
+        return None, None
+    if op_type in st.lists.white_list:
+        return st.dtype, None
+    if op_type in st.lists.black_list:
+        return "float32", None
+    if op_type in getattr(st.lists, "gray_follow_cast", ()):
+        return None, st.dtype
+    return None, None
+
+
 class _EagerOp:
     """Duck-typed Operator (framework/program.py:174) for eager dispatch."""
 
@@ -216,10 +244,29 @@ def run_op(op_type: str, inputs: Dict[str, object], attrs: Optional[dict] = None
 
     op = _EagerOp(op_type, in_names, out_names, attrs)
     rng_key = base.next_eager_key()
+    amp_dtype, amp_gray_dtype = _amp_policy(op_type)
 
     def fwd(*vals):
         env = dict(const_env)
         env.update(zip(diff_names, vals))
+        cast_to = amp_dtype
+        if amp_gray_dtype is not None:
+            # gray-follow (mirrors static_amp's rewrite): once one input
+            # is low precision, cast the fp32 rest down so promotion
+            # cannot lift the chain back to fp32
+            low = any(
+                jnp.asarray(env[n]).dtype in (jnp.bfloat16, jnp.float16)
+                for names in in_names.values() for n in names
+                if env.get(n) is not None)
+            if low:
+                cast_to = amp_gray_dtype
+        if cast_to is not None:
+            for names in in_names.values():
+                for n in names:
+                    v = env.get(n)
+                    if v is not None and jnp.issubdtype(
+                            jnp.asarray(v).dtype, jnp.floating):
+                        env[n] = jnp.asarray(v).astype(cast_to)
         ctx = LoweringContext(_EAGER_BLOCK, env, rng_key=rng_key)
         rule(ctx, op)
         return tuple(env.get(n) for n in flat_out_names)

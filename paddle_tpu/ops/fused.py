@@ -22,13 +22,17 @@ import jax.numpy as jnp
 from ..framework.lowering import register_lower
 
 
-def _plain_attention(q, k, v, bias, sm_scale):
+def _plain_attention(q, k, v, bias, sm_scale, causal=False):
     """Reference composition: softmax((q k^T) * scale + bias) v, fp32
     softmax internals, inputs' dtype out."""
     dt = q.dtype
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * sm_scale
     if bias is not None:
         s = s + bias.astype(jnp.float32)
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, sk), bool))
+        s = jnp.where(mask[None, None], s, jnp.float32(-1e30))
     p = jax.nn.softmax(s, axis=-1).astype(dt)
     return jnp.einsum("bhqk,bhkd->bhqd", p, v)
 
@@ -59,8 +63,28 @@ def _fused_mha(ctx, op):
         return jnp.transpose(x.reshape(b, s, n_heads, d), (0, 2, 1, 3))
 
     qh, kh, vh = heads(q), heads(k), heads(v)
+    causal = bool(op.attr("causal", False))
 
-    if jax.default_backend() == "tpu" and _flash_ok(s, s, d):
+    if bool(op.attr("sequence_parallel", False)):
+        # EXPLICIT opt-in: the caller asserts the op runs inside an 'sp'
+        # shard_map with q/k/v sequence-sharded (shard i holds global
+        # positions [i*S_local, (i+1)*S_local)); presence of an sp axis
+        # alone is not enough — replicated inputs would make each rank
+        # compute a different wrong answer
+        from ..distributed.ring_attention import ring_attention
+
+        if "sp" not in getattr(ctx, "axis_env", ()):
+            raise ValueError(
+                "fused_multihead_attention(sequence_parallel=True) needs "
+                "an 'sp' mesh axis in scope (run under a sequence-sharded "
+                "shard_map)")
+        if bias is not None:
+            raise NotImplementedError(
+                "fused attention under sequence parallelism does not take "
+                "an additive bias yet (pack sequences; causal via attr)")
+        out = ring_attention(qh, kh, vh, axis_name="sp", sm_scale=sm_scale,
+                             causal=causal)
+    elif jax.default_backend() == "tpu" and _flash_ok(s, s, d):
         from jax.experimental.pallas.ops.tpu.flash_attention import (
             flash_attention,
         )
@@ -77,9 +101,10 @@ def _fused_mha(ctx, op):
             ab = jnp.broadcast_to(
                 (bias.astype(jnp.float32) / sm_scale).astype(qh.dtype),
                 (b, n_heads, s, s))
-        out = flash_attention(qh, kh, vh, ab=ab, sm_scale=sm_scale)
+        out = flash_attention(qh, kh, vh, ab=ab, sm_scale=sm_scale,
+                              causal=causal)
     else:
-        out = _plain_attention(qh, kh, vh, bias, sm_scale)
+        out = _plain_attention(qh, kh, vh, bias, sm_scale, causal=causal)
 
     out = jnp.transpose(out, (0, 2, 1, 3)).reshape(b, s, hidden)
     ctx.set_out(op, "Out", out)

@@ -1,0 +1,62 @@
+"""Dygraph AMP: auto_cast actually casts; grads reach fp32 masters.
+
+Reference parity: imperative/amp_auto_cast.cc (NeedCast:51) +
+python/paddle/amp/auto_cast.py amp_guard.
+"""
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import amp, nn
+from paddle_tpu.dygraph.tensor import Tensor
+
+
+def test_auto_cast_runs_white_ops_low_precision():
+    lin = nn.Linear(8, 4)
+    x = Tensor(np.random.RandomState(0).randn(2, 8).astype("f4"),
+               stop_gradient=False)
+    with amp.auto_cast(dtype="bfloat16"):
+        y = lin(x)
+    assert str(y.dtype) == "bfloat16", y.dtype
+    # outside the guard: fp32 again
+    y2 = lin(x)
+    assert str(y2.dtype) == "float32"
+
+
+def test_auto_cast_grads_are_fp32_and_close_to_fp32_run():
+    rs = np.random.RandomState(1)
+    lin = nn.Linear(8, 1)
+    x = Tensor(rs.randn(16, 8).astype("f4"))
+
+    with amp.auto_cast(dtype="bfloat16"):
+        loss = pt.tensor.math.sum(lin(x))
+    loss.backward()
+    g_amp = np.asarray(lin.weight.grad.numpy())
+    assert g_amp.dtype == np.float32  # master param grad dtype
+
+    lin.clear_gradients()
+    loss2 = pt.tensor.math.sum(lin(x))
+    loss2.backward()
+    g_fp32 = np.asarray(lin.weight.grad.numpy())
+    np.testing.assert_allclose(g_amp, g_fp32, rtol=2e-2, atol=1e-2)
+
+
+def test_backward_after_scope_exit_uses_recorded_dtype():
+    """The standard pattern: forward under auto_cast(float16), backward
+    OUTSIDE the scope — the replay must cast exactly as the forward did
+    (policy captured at record time, not read live)."""
+    rs = np.random.RandomState(3)
+    lin = nn.Linear(8, 4)
+    x = Tensor(rs.randn(2, 8).astype("f4"))
+    with amp.auto_cast(dtype="float16"):
+        loss = pt.tensor.math.sum(lin(x))
+    loss.backward()  # scope exited; default dtype differs
+    g = np.asarray(lin.weight.grad.numpy())
+    assert g.dtype == np.float32 and np.isfinite(g).all()
+
+
+def test_black_list_op_stays_fp32():
+    x = Tensor(np.random.RandomState(2).rand(4, 4).astype("f4") + 0.5)
+    with amp.auto_cast(dtype="bfloat16"):
+        # softmax_with_cross_entropy is black -> runs fp32 even under amp
+        out = pt.log(x)
+    assert str(out.dtype) == "float32"
