@@ -311,3 +311,6 @@ class DataLoader:
 
 def get_worker_info():
     return None  # single-process host pipeline (workers are threads)
+
+
+from .data_feed import MultiSlotDataFeed  # noqa: E402,F401

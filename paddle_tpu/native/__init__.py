@@ -1,0 +1,94 @@
+"""Native (C++) runtime components.
+
+Role parity: the reference's data-feed hot loop is C++
+(framework/data_feed.cc); so is ours.  The extension is compiled on
+first use with the system toolchain (build.py) and cached next to the
+sources; when no compiler is available the pure-python fallback keeps
+behavior identical (slower, same bytes out).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+# lazy: importing this package must not pay a compiler subprocess;
+# the extension is built/loaded on the first parse call
+_ext = None
+_ext_tried = False
+
+
+def _get_ext():
+    global _ext, _ext_tried
+    if not _ext_tried:
+        from . import build
+
+        _ext = build.load_extension()
+        _ext_tried = True
+    return _ext
+
+
+def parse_multislot(data: bytes, slot_types: str):
+    """Parse MultiSlot text data into per-slot (values, lod) arrays.
+
+    ``slot_types``: one char per slot — 'f' float32 values, 'u' uint64
+    ids.  Returns (n_instances, [(values_ndarray, lod_ndarray), ...]);
+    lod holds cumulative offsets (len n_instances+1), reference LoD
+    level-0 semantics.
+    """
+    if isinstance(data, str):
+        data = data.encode()
+    ext = _get_ext()
+    if ext is not None:
+        n, packed = ext.parse_multislot(data, slot_types)
+        out = []
+        for t, (vals, lod) in zip(slot_types, packed):
+            dt = np.float32 if t == "f" else np.uint64
+            out.append((np.frombuffer(vals, dtype=dt),
+                        np.frombuffer(lod, dtype=np.int64)))
+        return n, out
+    return _parse_multislot_py(data, slot_types)
+
+
+def _parse_multislot_py(data: bytes, slot_types: str):
+    """Pure-python fallback — same outputs AND same errors as the
+    extension (malformed input must not silently flip behavior between
+    environments with and without a compiler)."""
+    vals = [[] for _ in slot_types]
+    lods = [[0] for _ in slot_types]
+    n = 0
+    for line in data.split(b"\n"):
+        toks = line.split()
+        if not toks:
+            continue
+        i = 0
+        for s, t in enumerate(slot_types):
+            try:
+                cnt = int(toks[i])
+            except (IndexError, ValueError):
+                raise ValueError(f"bad slot count at line {n}")
+            if cnt < 0:
+                raise ValueError(f"bad slot count at line {n}")
+            i += 1
+            if i + cnt > len(toks):
+                raise ValueError(
+                    f"bad {'float' if t == 'f' else 'id'} value at line {n}")
+            conv = float if t == "f" else int
+            try:
+                vals[s].extend(conv(x) for x in toks[i:i + cnt])
+            except ValueError:
+                raise ValueError(
+                    f"bad {'float' if t == 'f' else 'id'} value at line {n}")
+            i += cnt
+            lods[s].append(len(vals[s]))
+        if i != len(toks):
+            raise ValueError(f"trailing tokens at line {n}")
+        n += 1
+    out = []
+    for s, t in enumerate(slot_types):
+        dt = np.float32 if t == "f" else np.uint64
+        out.append((np.asarray(vals[s], dtype=dt),
+                    np.asarray(lods[s], dtype=np.int64)))
+    return n, out
+
+
+def has_native() -> bool:
+    return _get_ext() is not None

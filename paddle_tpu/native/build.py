@@ -1,0 +1,63 @@
+"""On-demand build of the native extension.
+
+One g++ invocation, cached by source mtime; no pybind11/cmake (the
+extension uses the plain CPython C API).  Returns None when no compiler
+is present — callers fall back to pure python with identical semantics.
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+import shutil
+import subprocess
+import sys
+import sysconfig
+
+_SRC = os.path.join(os.path.dirname(__file__), "src", "data_feed.cc")
+_OUT_DIR = os.path.join(os.path.dirname(__file__), "_build")
+
+
+def _so_path() -> str:
+    tag = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
+    return os.path.join(_OUT_DIR, "_data_feed" + tag)
+
+
+def _needs_build(so: str) -> bool:
+    return (not os.path.exists(so)
+            or os.path.getmtime(so) < os.path.getmtime(_SRC))
+
+
+def build() -> str:
+    so = _so_path()
+    if not _needs_build(so):
+        return so
+    cxx = os.environ.get("CXX") or shutil.which("g++") or shutil.which("c++")
+    if cxx is None:
+        raise RuntimeError("no C++ compiler found")
+    os.makedirs(_OUT_DIR, exist_ok=True)
+    include = sysconfig.get_paths()["include"]
+    tmp = f"{so}.tmp{os.getpid()}.so"  # per-process: publish stays atomic
+    cmd = [cxx, "-O2", "-std=c++17", "-shared", "-fPIC",
+           f"-I{include}", _SRC, "-o", tmp]
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"native build failed: {' '.join(cmd)}\n{proc.stderr}")
+    os.replace(tmp, so)  # atomic publish for concurrent builders
+    return so
+
+
+def load_extension():
+    """Build (if needed) and import the extension; None on any failure
+    (callers use the python fallback)."""
+    try:
+        so = build()
+    except RuntimeError:
+        return None
+    spec = importlib.util.spec_from_file_location("_data_feed", so)
+    mod = importlib.util.module_from_spec(spec)
+    try:
+        spec.loader.exec_module(mod)
+    except ImportError:
+        return None
+    return mod
