@@ -367,6 +367,24 @@ _OPTIMIZER_OP_TYPES = {
     "rmsprop", "ftrl", "lamb", "lars_momentum", "dgc_momentum", "dpsgd",
 }
 
+# param-shaped accumulator input slots per optimizer op (reference
+# operators/optimizers/*_op.cc input declarations); Beta*Pow and loss-
+# scale scalars are [1]-shaped and deliberately absent
+_OPTIMIZER_ACC_SLOTS = {
+    "sgd": (),
+    "momentum": ("Velocity",),
+    "lars_momentum": ("Velocity",),
+    "dgc_momentum": ("Velocity",),
+    "adam": ("Moment1", "Moment2"),
+    "adamw": ("Moment1", "Moment2"),
+    "lamb": ("Moment1", "Moment2"),
+    "adamax": ("Moment", "InfNorm"),
+    "adagrad": ("Moment",),
+    "adadelta": ("AvgSquaredGrad", "AvgSquaredUpdate"),
+    "rmsprop": ("MeanSquare", "MeanGrad", "Moment"),
+    "ftrl": ("SquaredAccumulator", "LinearAccumulator"),
+}
+
 
 class ShardingMetaOptimizer(MetaOptimizerBase):
     """ZeRO-1 optimizer-state sharding (reference
@@ -520,12 +538,21 @@ class ShardingMetaOptimizer(MetaOptimizerBase):
             # (survives clone/proto round-trips, unlike a python attr)
             outs_set = set(op.output_arg_names())
             sharded_accs = []
+            acc_slots = _OPTIMIZER_ACC_SLOTS.get(op.type)
             for slot, names in list(op.inputs.items()):
                 if slot == "Param":
                     op.inputs[slot] = [p_shard]
                 elif slot == "Grad":
                     op.inputs[slot] = [g_shard]
+                elif acc_slots is not None:
+                    # exact accumulator identification by slot name —
+                    # a same-shaped persistable input in a non-acc slot
+                    # (e.g. a MasterParam) must NOT be sharded blindly
+                    if slot in acc_slots:
+                        sharded_accs.extend(names)
                 else:
+                    # unknown optimizer type: fall back to the shape
+                    # heuristic (persistable, param-shaped, read+written)
                     for nm in names:
                         v = block._find_var_recursive(nm)
                         if (v is not None and v.persistable

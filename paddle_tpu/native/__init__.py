@@ -42,8 +42,10 @@ def parse_multislot(data: bytes, slot_types: str):
         out = []
         for t, (vals, lod) in zip(slot_types, packed):
             dt = np.float32 if t == "f" else np.uint64
-            out.append((np.frombuffer(vals, dtype=dt),
-                        np.frombuffer(lod, dtype=np.int64)))
+            # copy(): frombuffer over bytes is read-only; consumers must
+            # see WRITABLE arrays in both native and fallback paths
+            out.append((np.frombuffer(vals, dtype=dt).copy(),
+                        np.frombuffer(lod, dtype=np.int64).copy()))
         return n, out
     return _parse_multislot_py(data, slot_types)
 
@@ -71,9 +73,19 @@ def _parse_multislot_py(data: bytes, slot_types: str):
             if i + cnt > len(toks):
                 raise ValueError(
                     f"bad {'float' if t == 'f' else 'id'} value at line {n}")
-            conv = float if t == "f" else int
             try:
-                vals[s].extend(conv(x) for x in toks[i:i + cnt])
+                for x in toks[i:i + cnt]:
+                    if b"_" in x:  # python literals allow _, strtox doesn't
+                        raise ValueError
+                    if t == "f":
+                        vals[s].append(float(x))
+                    else:
+                        # match strtoull semantics: plain digits only
+                        # (no python underscore literals), negatives wrap
+                        # into uint64 like the C path
+                        if not x.lstrip(b"-+").isdigit():
+                            raise ValueError
+                        vals[s].append(int(x) & 0xFFFFFFFFFFFFFFFF)
             except ValueError:
                 raise ValueError(
                     f"bad {'float' if t == 'f' else 'id'} value at line {n}")

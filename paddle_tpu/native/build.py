@@ -52,7 +52,9 @@ def load_extension():
     (callers use the python fallback)."""
     try:
         so = build()
-    except RuntimeError:
+    except (RuntimeError, OSError):
+        # any build-environment failure (missing compiler, unwritable
+        # dir, bad CXX) means fallback, never a caller crash
         return None
     spec = importlib.util.spec_from_file_location("_data_feed", so)
     mod = importlib.util.module_from_spec(spec)

@@ -47,7 +47,13 @@ bool parse_buffer(const char* data, Py_ssize_t len,
     const char* line_end = static_cast<const char*>(
         memchr(p, '\n', static_cast<size_t>(end - p)));
     if (line_end == nullptr) line_end = end;
-    if (line_end > p) {  // skip empty lines
+    // skip blank lines, including CRLF/whitespace-only ones (parity with
+    // the python fallback's token-split semantics)
+    const char* first = p;
+    while (first < line_end &&
+           (*first == ' ' || *first == '\t' || *first == '\r'))
+      ++first;
+    if (first < line_end) {
       line.assign(p, static_cast<size_t>(line_end - p));
       const char* q = line.c_str();
       for (auto& slot : slots) {

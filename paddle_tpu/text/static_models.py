@@ -36,7 +36,7 @@ def _attention(x, attn_mask, hidden, n_heads, dropout_prob, name,
     fused_multihead_attention op (Pallas flash kernel on TPU; note the
     fused path has no attention-probs dropout — the standard flash
     trade-off); otherwise the reference matmul/softmax/dropout chain."""
-    b, s = int(x.shape[0]), int(x.shape[1])
+    s = int(x.shape[1])
     d = hidden // n_heads
 
     q = _dense(x, hidden, name=name + "_q")
@@ -50,8 +50,9 @@ def _attention(x, attn_mask, hidden, n_heads, dropout_prob, name,
         return _dense(ctxv, hidden, name=name + "_out")
 
     def split_heads(t, n):
-        # [B, S, H] -> [B, heads, S, d]
-        t = layers.reshape(t, [b, s, n_heads, d], name=n + "_r")
+        # [B, S, H] -> [B, heads, S, d]; 0 copies the batch dim so the
+        # program shards over dp without baking the global batch size
+        t = layers.reshape(t, [0, s, n_heads, d], name=n + "_r")
         return layers.transpose(t, [0, 2, 1, 3], name=n + "_t")
 
     q, k, v = (split_heads(t, name + sfx)
@@ -66,7 +67,7 @@ def _attention(x, attn_mask, hidden, n_heads, dropout_prob, name,
         probs = layers.dropout(probs, dropout_prob, name=name + "_pd")
     ctxv = layers.matmul(probs, v, name=name + "_pv")  # [B, heads, S, d]
     ctxv = layers.transpose(ctxv, [0, 2, 1, 3], name=name + "_ct")
-    ctxv = layers.reshape(ctxv, [b, s, hidden], name=name + "_cr")
+    ctxv = layers.reshape(ctxv, [0, s, hidden], name=name + "_cr")
     return _dense(ctxv, hidden, name=name + "_out")
 
 
@@ -183,7 +184,7 @@ def bert_base_pretrain_program(batch_size=64, seq_len=128, vocab_size=30522,
 
         # --- NSP head on [CLS] (position 0): tanh pool -> 2-way
         cls = layers.slice(seq_out, axes=[1], starts=[0], ends=[1])
-        cls = layers.reshape(cls, [batch_size, hidden])
+        cls = layers.reshape(cls, [0, hidden])
         pooled = _dense(cls, hidden, act="tanh", name="pooler")
         nsp_logits = _dense(pooled, 2, name="nsp_out")
         nsp_loss = layers.mean(
