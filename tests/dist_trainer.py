@@ -53,6 +53,57 @@ def make_batch():
     return rs.randn(32, 8).astype("f4"), rs.randn(32, 1).astype("f4")
 
 
+def run_dygraph(out_path, steps):
+    """Dygraph DataParallel over real processes (reference
+    TestParallelDyGraphRunnerBase oracle, test_dist_base.py:379):
+    scale_loss + apply_collective_grads across ranks."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu.distributed.parallel import DataParallel
+    from paddle_tpu.distributed.parallel_env import init_parallel_env
+    from paddle_tpu.dygraph.tensor import Tensor
+    from paddle_tpu import nn
+
+    init_parallel_env()
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    nranks = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+
+    net = nn.Linear(8, 1, bias_attr=False)
+    # deterministic init shared by every rank and the oracle
+    net.weight._set_raw(jnp.asarray(np.full((8, 1), 0.1, "f4")))
+    model = DataParallel(net)
+
+    X, Y = make_batch()
+    per = len(X) // nranks
+    Xl = X[rank * per:(rank + 1) * per]
+    Yl = Y[rank * per:(rank + 1) * per]
+
+    losses = []
+    lr = 0.05
+    for _ in range(steps):
+        pred = model(Tensor(Xl))
+        diff = pred - Tensor(Yl)
+        loss = pt.tensor.math.mean(diff * diff)
+        scaled = model.scale_loss(loss)
+        scaled.backward()
+        model.apply_collective_grads()
+        # manual SGD (keeps the oracle trivial)
+        w = net.weight
+        w._set_raw(w._value - lr * w.grad._value)
+        w.grad = None
+        # every rank reports the FULL-batch loss: mean of local losses
+        from jax.experimental import multihost_utils
+
+        all_losses = multihost_utils.process_allgather(
+            np.asarray(loss._value))
+        losses.append(float(np.mean(all_losses)))
+
+    with open(out_path, "w") as f:
+        json.dump({"rank": rank, "losses": losses}, f)
+
+
 def main():
     # CPU backend must be forced through live config: the container's
     # sitecustomize imports jax (axon TPU plugin) before this runs
@@ -69,6 +120,9 @@ def main():
 
     out_path = sys.argv[1]
     steps = int(sys.argv[2]) if len(sys.argv) > 2 else 5
+    if os.environ.get("PADDLE_TPU_TEST_DYGRAPH") == "1":
+        run_dygraph(out_path, steps)
+        return
     localsgd = os.environ.get("PADDLE_TPU_TEST_LOCALSGD") == "1"
 
     mesh = init_parallel_env()

@@ -104,6 +104,32 @@ def test_two_process_loss_parity(tmp_path):
 
 
 
+def test_two_process_dygraph_data_parallel_parity(tmp_path):
+    """Dygraph DataParallel over 2 REAL processes (reference
+    TestParallelDyGraphRunnerBase oracle): scale_loss +
+    apply_collective_grads must reproduce the single-process full-batch
+    trajectory."""
+    import jax.numpy as jnp
+
+    results = _run_cluster(tmp_path, nproc=2, steps=5,
+                           extra_env={"PADDLE_TPU_TEST_DYGRAPH": "1"})
+    # single-process oracle: same model, manual SGD on the full batch
+    from tests.dist_trainer import make_batch
+
+    X, Y = make_batch()
+    w = np.full((8, 1), 0.1, "f4")
+    base = []
+    for _ in range(5):
+        pred = X @ w
+        diff = pred - Y
+        base.append(float(np.mean(diff * diff)))
+        grad = 2.0 * X.T @ diff / len(X)
+        w = w - 0.05 * grad
+    for res in results:
+        np.testing.assert_allclose(res["losses"], base, rtol=1e-4,
+                                   atol=1e-6)
+
+
 def test_two_process_localsgd_runs_and_converges(tmp_path):
     """LocalSGD's first end-to-end execution: k_steps=2 param averaging
     across 2 real processes; losses must be finite and decreasing (exact
