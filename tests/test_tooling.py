@@ -1,0 +1,40 @@
+"""L11 tooling gates: API signature spec + op-registry compat check.
+
+Reference parity: tools/print_signatures.py + check_api_approvals.sh
+(signature diffs need deliberate approval) and tools/check_op_desc.py /
+op_version_registry (removing an op breaks saved programs).
+"""
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(script, *args):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", script), *args],
+        capture_output=True, text=True, env=env)
+
+
+def test_api_spec_is_current():
+    """Any public-signature change must ship an updated API.spec in the
+    same commit (run tools/print_signatures.py --update)."""
+    p = _run("print_signatures.py", "--check")
+    assert p.returncode == 0, p.stderr
+
+
+def test_op_registry_never_shrinks():
+    """Ops may be added freely; removing one breaks saved programs and
+    must fail the gate."""
+    p = _run("check_op_desc.py", "--check")
+    assert p.returncode == 0, p.stderr
+
+
+def test_op_spec_counts_grads():
+    spec = open(os.path.join(ROOT, "OPS.spec")).read().splitlines()
+    assert len(spec) >= 350
+    kinds = {ln.split()[1] for ln in spec}
+    assert kinds <= {"explicit_grad", "grad_maker", "generic_vjp"}

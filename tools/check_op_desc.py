@@ -1,0 +1,70 @@
+"""Op-registry compatibility checker (reference tools/check_op_desc.py +
+framework/op_version_registry.h).
+
+Dumps every registered lowering (name + grad availability) to OPS.spec;
+--check fails when an op DISAPPEARS (removing an op breaks saved
+programs — the compat contract; adding ops is always fine).
+
+Usage:
+    python tools/check_op_desc.py --update   # refresh OPS.spec
+    python tools/check_op_desc.py --check    # gate: no op removed
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+SPEC = os.path.join(ROOT, "OPS.spec")
+
+
+def collect() -> list[str]:
+    import paddle_tpu  # noqa: F401  (registers all lowerings)
+    from paddle_tpu.framework.backward import GRAD_MAKERS
+    from paddle_tpu.framework.lowering import LOWERINGS
+
+    lines = []
+    for name in sorted(LOWERINGS):
+        grad = "explicit_grad" if name + "_grad" in LOWERINGS else (
+            "grad_maker" if name in GRAD_MAKERS else "generic_vjp")
+        lines.append(f"{name} {grad}")
+    return lines
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--check", action="store_true")
+    ap.add_argument("--update", action="store_true")
+    args = ap.parse_args(argv)
+    lines = collect()
+    text = "\n".join(lines) + "\n"
+    if args.update:
+        with open(SPEC, "w") as f:
+            f.write(text)
+        print(f"wrote {len(lines)} ops to {SPEC}")
+        return 0
+    if args.check:
+        if not os.path.exists(SPEC):
+            print("OPS.spec missing; run --update", file=sys.stderr)
+            return 1
+        with open(SPEC) as f:
+            old = {ln.split()[0] for ln in f if ln.strip()}
+        now = {ln.split()[0] for ln in lines}
+        removed = sorted(old - now)
+        if removed:
+            print(f"ops REMOVED from the registry (breaks saved "
+                  f"programs): {removed}", file=sys.stderr)
+            return 1
+        added = sorted(now - old)
+        print(f"op registry ok: {len(now)} ops "
+              f"({len(added)} new since OPS.spec)")
+        return 0
+    sys.stdout.write(text)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

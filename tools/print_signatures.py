@@ -1,0 +1,117 @@
+"""API-stability gate: dump every public API signature to a stable text
+form (reference tools/print_signatures.py + check_api_approvals.sh —
+signature diffs require explicit approval).
+
+Usage:
+    python tools/print_signatures.py            # print to stdout
+    python tools/print_signatures.py --check    # diff against API.spec
+    python tools/print_signatures.py --update   # rewrite API.spec
+
+CI contract (tests/test_tooling.py): the committed API.spec must match
+the live package — any signature change must be made deliberately by
+running --update in the same commit.
+"""
+from __future__ import annotations
+
+import argparse
+import hashlib
+import inspect
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+SPEC = os.path.join(ROOT, "API.spec")
+
+MODULES = [
+    "paddle_tpu",
+    "paddle_tpu.nn",
+    "paddle_tpu.nn.functional",
+    "paddle_tpu.tensor",
+    "paddle_tpu.optimizer",
+    "paddle_tpu.static",
+    "paddle_tpu.jit",
+    "paddle_tpu.amp",
+    "paddle_tpu.metric",
+    "paddle_tpu.io",
+    "paddle_tpu.distribution",
+    "paddle_tpu.distributed",
+    "paddle_tpu.distributed.fleet",
+    "paddle_tpu.layers",
+    "paddle_tpu.profiler",
+]
+
+
+def _sig(obj) -> str:
+    try:
+        return str(inspect.signature(obj))
+    except (ValueError, TypeError):
+        return "(...)"
+
+
+def collect() -> list[str]:
+    import importlib
+
+    lines = []
+    for modname in MODULES:
+        mod = importlib.import_module(modname)
+        public = getattr(mod, "__all__", None)
+        if public is None:
+            public = [n for n in dir(mod) if not n.startswith("_")]
+        for name in sorted(public):
+            obj = getattr(mod, name, None)
+            if obj is None or inspect.ismodule(obj):
+                continue
+            qual = f"{modname}.{name}"
+            if inspect.isclass(obj):
+                lines.append(f"{qual} (class) __init__{_sig(obj.__init__)}")
+                for m in sorted(vars(obj)):
+                    if m.startswith("_"):
+                        continue
+                    attr = vars(obj)[m]
+                    if inspect.isfunction(attr):
+                        lines.append(f"{qual}.{m}{_sig(attr)}")
+            elif callable(obj):
+                lines.append(f"{qual}{_sig(obj)}")
+    return lines
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--check", action="store_true")
+    ap.add_argument("--update", action="store_true")
+    args = ap.parse_args(argv)
+    lines = collect()
+    text = "\n".join(lines) + "\n"
+    if args.update:
+        with open(SPEC, "w") as f:
+            f.write(text)
+        print(f"wrote {len(lines)} signatures to {SPEC}")
+        return 0
+    if args.check:
+        if not os.path.exists(SPEC):
+            print("API.spec missing; run --update", file=sys.stderr)
+            return 1
+        with open(SPEC) as f:
+            want = f.read()
+        if want != text:
+            import difflib
+
+            diff = list(difflib.unified_diff(
+                want.splitlines(), text.splitlines(),
+                "API.spec", "live", lineterm=""))
+            print("\n".join(diff[:80]), file=sys.stderr)
+            print(f"\nAPI signatures changed ({len(diff)} diff lines); "
+                  f"if intentional run: python tools/print_signatures.py "
+                  f"--update", file=sys.stderr)
+            return 1
+        print(f"API.spec up to date "
+              f"(md5 {hashlib.md5(text.encode()).hexdigest()})")
+        return 0
+    sys.stdout.write(text)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
