@@ -1,0 +1,95 @@
+"""Sharded checkpoint: save/restore ZeRO-sharded state over the mesh.
+
+Beyond-reference (SURVEY §5 failure-recovery row): the ZeRO optimizer
+state lives sharded over the dp axis; the checkpoint must round-trip it
+distributed and resume the exact loss trajectory.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.distributed.checkpoint import load_sharded, save_sharded
+from paddle_tpu.distributed.parallel_env import (init_parallel_env,
+                                                 reset_mesh)
+from paddle_tpu.framework import unique_name
+from paddle_tpu.framework.program import Program, program_guard
+
+
+@pytest.fixture
+def mesh8():
+    reset_mesh()
+    mesh = init_parallel_env()
+    yield mesh
+    reset_mesh()
+
+
+def _build_sharded():
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.initializer import ConstantInitializer
+    from paddle_tpu.optimizer import MomentumOptimizer
+    from paddle_tpu.param_attr import ParamAttr
+
+    main, startup = Program(), Program()
+    main.random_seed = 1
+    with unique_name.guard(), program_guard(main, startup):
+        x = layers.data("x", [8])
+        y = layers.data("y", [1])
+        h = layers.fc(x, 16, act="relu", param_attr=ParamAttr(
+            initializer=ConstantInitializer(0.1)), bias_attr=False)
+        pred = layers.fc(h, 1, param_attr=ParamAttr(
+            initializer=ConstantInitializer(0.2)), bias_attr=False)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        strat = fleet.DistributedStrategy()
+        strat.sharding = True
+        fleet.init(is_collective=True, strategy=strat)
+        fleet.distributed_optimizer(MomentumOptimizer(0.05, 0.9))
+        fleet.minimize(loss)
+    return main, startup, loss
+
+
+def _data():
+    rs = np.random.RandomState(0)
+    return rs.randn(32, 8).astype("f4"), rs.randn(32, 1).astype("f4")
+
+
+def test_zero_sharded_state_roundtrip(tmp_path, mesh8):
+    X, Y = _data()
+
+    def fresh():
+        main, startup, loss = _build_sharded()
+        exe = pt.Executor(pt.CPUPlace(), mesh=mesh8)
+        scope = pt.framework.Scope()
+        exe.run(startup, scope=scope)
+        return main, startup, loss, exe, scope
+
+    def step(exe, main, loss, scope):
+        return float(np.asarray(exe.run(
+            main, feed={"x": X, "y": Y}, fetch_list=[loss],
+            scope=scope)[0]).ravel()[0])
+
+    # uninterrupted 6-step oracle
+    main, _, loss, exe, scope = fresh()
+    full = [step(exe, main, loss, scope) for _ in range(6)]
+
+    # run A: 3 steps, save (state includes dp-sharded accumulators)
+    main, _, loss, exe, scope = fresh()
+    for _ in range(3):
+        step(exe, main, loss, scope)
+    saved = save_sharded(scope, str(tmp_path))
+    assert saved, "nothing saved"
+    # at least one saved array is genuinely sharded over the mesh
+    import jax
+
+    sharded = [n for n in saved
+               if hasattr(scope.get_var(n), "sharding")
+               and not scope.get_var(n).sharding.is_fully_replicated]
+    assert sharded, "expected dp-sharded optimizer state in the checkpoint"
+
+    # run B: fresh process-equivalent; one step materializes the sharded
+    # layout, then restore and continue
+    main2, _, loss2, exe2, scope2 = fresh()
+    step(exe2, main2, loss2, scope2)
+    load_sharded(scope2, str(tmp_path))
+    resumed = [step(exe2, main2, loss2, scope2) for _ in range(3)]
+    np.testing.assert_allclose(resumed, full[3:6], rtol=1e-5, atol=1e-7)
