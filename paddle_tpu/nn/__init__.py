@@ -18,6 +18,7 @@ from .layer.common import (  # noqa: F401
 )
 from .layer.container import LayerList, ParameterList, Sequential  # noqa: F401
 from .layer.conv import Conv2D, Conv2DTranspose  # noqa: F401
+from .layer.rnn import GRU, LSTM, RNNBase, SimpleRNN  # noqa: F401
 from .layer.loss import (  # noqa: F401
     BCEWithLogitsLoss, CrossEntropyLoss, KLDivLoss, L1Loss, MSELoss, NLLLoss,
     SmoothL1Loss,

@@ -1,0 +1,198 @@
+"""RNN layer classes: SimpleRNN / LSTM / GRU over the fused `rnn` op.
+
+Role parity: reference python/paddle/nn/layer/rnn.py (RNNBase:1000,
+LSTM/GRU/SimpleRNN classes) whose cudnn path emits the `rnn` op with a
+flat WeightList.  TPU-native: the op lowers to `lax.scan` per
+(layer, direction) with the whole-sequence input projection batched onto
+the MXU (ops/rnn_ops.py); the same WeightList layout is kept so programs
+round-trip.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...dispatch import op_call
+from ...dygraph.layers import Layer
+from ...dygraph.tensor import Tensor
+
+
+_GATES = {"LSTM": 4, "GRU": 3, "RNN_TANH": 1, "RNN_RELU": 1}
+
+
+class RNNBase(Layer):
+    def __init__(self, mode, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        if mode not in _GATES:
+            raise ValueError(f"unknown rnn mode {mode!r}")
+        if direction in ("forward",):
+            self._n_dir = 1
+        elif direction in ("bidirect", "bidirectional"):
+            self._n_dir = 2
+        else:
+            raise ValueError(f"unknown direction {direction!r}")
+        self.mode = mode
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = float(dropout)
+        g = _GATES[mode]
+
+        # bias_*_attr=False omits BOTH bias vectors (the flat WeightList
+        # layout has no hole for a lone missing bias)
+        self._use_bias = bias_ih_attr is not False \
+            and bias_hh_attr is not False
+        ws, bs = [], []
+        for layer in range(num_layers):
+            for d in range(self._n_dir):
+                in_sz = input_size if layer == 0 \
+                    else hidden_size * self._n_dir
+                k = 1.0 / np.sqrt(hidden_size)
+                w_ih = self.create_parameter(
+                    [g * hidden_size, in_sz], attr=weight_ih_attr,
+                    default_initializer=_uniform(k))
+                w_hh = self.create_parameter(
+                    [g * hidden_size, hidden_size], attr=weight_hh_attr,
+                    default_initializer=_uniform(k))
+                ws += [w_ih, w_hh]
+                if self._use_bias:
+                    b_ih = self.create_parameter(
+                        [g * hidden_size], attr=bias_ih_attr, is_bias=True,
+                        default_initializer=_uniform(k))
+                    b_hh = self.create_parameter(
+                        [g * hidden_size], attr=bias_hh_attr, is_bias=True,
+                        default_initializer=_uniform(k))
+                    bs += [b_ih, b_hh]
+        # reference WeightList layout: all [w_ih, w_hh] pairs, then all
+        # [b_ih, b_hh] pairs (nn/layer/rnn.py flatten_parameters)
+        self._weight_list = ws + bs
+        for i, p in enumerate(self._weight_list):
+            setattr(self, f"_flat_w_{i}", p)
+
+    # -- helpers ----------------------------------------------------------
+    def _zero_state(self, x, n_layers=None):
+        import jax.numpy as jnp
+
+        dt = x._value.dtype if isinstance(x, Tensor) else jnp.float32
+        batch = x.shape[0] if self.time_major is False else x.shape[1]
+        nl = self.num_layers if n_layers is None else n_layers
+        shape = (nl * self._n_dir, batch, self.hidden_size)
+        return Tensor(jnp.zeros(shape, dt), stop_gradient=True)
+
+    def _run_op(self, x, states, weights, n_layers, input_size):
+        n_state = 2 if self.mode == "LSTM" else 1
+        return op_call(
+            "rnn",
+            {"Input": x, "PreState": states, "WeightList": list(weights)},
+            {"mode": self.mode, "hidden_size": self.hidden_size,
+             "num_layers": n_layers, "is_bidirec": self._n_dir == 2,
+             "input_size": input_size, "dropout_prob": 0.0},
+            outs=("Out", "State"),
+            out_counts={"State": n_state},
+        )
+
+    def _layer_weights(self, layer):
+        nd = self._n_dir
+        ws = self._weight_list[2 * layer * nd:2 * (layer + 1) * nd]
+        if self._use_bias:
+            off = 2 * self.num_layers * nd
+            ws = ws + self._weight_list[off + 2 * layer * nd:
+                                        off + 2 * (layer + 1) * nd]
+        return ws
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...tensor.manipulation import concat, transpose
+
+        if sequence_length is not None:
+            raise NotImplementedError(
+                "sequence_length is not supported yet: the scan runs all "
+                "T steps; mask padded outputs downstream or pack "
+                "sequences (silent wrong states would be worse)")
+        x = inputs
+        if not self.time_major:
+            x = transpose(x, [1, 0, 2])  # op wants [T, B, I]
+        if initial_states is None:
+            if self.mode == "LSTM":
+                initial_states = (self._zero_state(inputs),
+                                  self._zero_state(inputs))
+            else:
+                initial_states = self._zero_state(inputs)
+        states = (list(initial_states)
+                  if isinstance(initial_states, (list, tuple))
+                  else [initial_states])
+
+        use_dropout = (self.dropout > 0.0 and self.num_layers > 1
+                       and getattr(self, "training", True))
+        if not use_dropout:
+            out, state = self._run_op(x, states, self._weight_list,
+                                      self.num_layers, self.input_size)
+        else:
+            # reference semantics: dropout BETWEEN layers (not after the
+            # last); run one op per layer so the dropout op's saved-mask
+            # gradient path applies
+            from .. import functional as F
+
+            nd = self._n_dir
+            y = x
+            finals = [[] for _ in range(len(states))]
+            for layer in range(self.num_layers):
+                sub_states = [s[layer * nd:(layer + 1) * nd]
+                              for s in states]
+                in_sz = self.input_size if layer == 0 \
+                    else self.hidden_size * nd
+                y, st = self._run_op(y, sub_states,
+                                     self._layer_weights(layer), 1, in_sz)
+                st = st if isinstance(st, (list, tuple)) else [st]
+                for i, s in enumerate(st):
+                    finals[i].append(s)
+                if layer < self.num_layers - 1:
+                    y = F.dropout(y, p=self.dropout, training=True)
+            out = y
+            state = [concat(f, axis=0) for f in finals]
+        if not self.time_major:
+            out = transpose(out, [1, 0, 2])
+        if self.mode == "LSTM":
+            return out, tuple(state)
+        return out, (state[0] if isinstance(state, (list, tuple)) else state)
+
+
+def _uniform(k):
+    from ...initializer import UniformInitializer
+
+    return UniformInitializer(-k, k)
+
+
+class SimpleRNN(RNNBase):
+    """Reference paddle.nn.SimpleRNN."""
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kw):
+        if activation not in ("tanh", "relu"):
+            raise ValueError(
+                f"SimpleRNN activation must be 'tanh' or 'relu', got "
+                f"{activation!r}")
+        mode = "RNN_RELU" if activation == "relu" else "RNN_TANH"
+        super().__init__(mode, input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kw)
+
+
+class LSTM(RNNBase):
+    """Reference paddle.nn.LSTM."""
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, **kw):
+        super().__init__("LSTM", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kw)
+
+
+class GRU(RNNBase):
+    """Reference paddle.nn.GRU."""
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, **kw):
+        super().__init__("GRU", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kw)

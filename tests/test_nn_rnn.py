@@ -1,0 +1,81 @@
+"""paddle.nn.LSTM/GRU/SimpleRNN layer classes vs numpy oracles.
+
+Reference parity: python/paddle/nn/layer/rnn.py (RNNBase cudnn path
+emitting the `rnn` op with the flat WeightList layout).
+"""
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import nn
+from paddle_tpu.dygraph.tensor import Tensor
+
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def test_lstm_layer_matches_numpy():
+    B, T, I, H = 2, 5, 3, 4
+    rs = np.random.RandomState(0)
+    lstm = nn.LSTM(I, H)
+    x = rs.randn(B, T, I).astype("f4")
+
+    out, (h_n, c_n) = lstm(Tensor(x))
+    assert out.shape == [B, T, H]
+    assert h_n.shape == [1, B, H] and c_n.shape == [1, B, H]
+
+    w_ih = np.asarray(lstm._weight_list[0].numpy())
+    w_hh = np.asarray(lstm._weight_list[1].numpy())
+    b_ih = np.asarray(lstm._weight_list[2].numpy())
+    b_hh = np.asarray(lstm._weight_list[3].numpy())
+    h = np.zeros((B, H), "f4")
+    c = np.zeros((B, H), "f4")
+    outs = []
+    for t in range(T):
+        g = x[:, t] @ w_ih.T + b_ih + h @ w_hh.T + b_hh
+        i, f, gg, o = np.split(g, 4, -1)
+        c = _sigmoid(f) * c + _sigmoid(i) * np.tanh(gg)
+        h = _sigmoid(o) * np.tanh(c)
+        outs.append(h)
+    want = np.stack(outs, axis=1)
+    np.testing.assert_allclose(np.asarray(out.numpy()), want, rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(h_n.numpy())[0], h, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_gru_bidirectional_shapes_and_grad():
+    B, T, I, H = 2, 4, 3, 5
+    rs = np.random.RandomState(1)
+    gru = nn.GRU(I, H, num_layers=2, direction="bidirectional")
+    x = Tensor(rs.randn(B, T, I).astype("f4"), stop_gradient=False)
+    out, h_n = gru(x)
+    assert out.shape == [B, T, 2 * H]
+    assert h_n.shape == [4, B, H]  # num_layers * 2 directions
+    loss = pt.tensor.math.sum(out * out)
+    loss.backward()
+    g = gru._weight_list[0].grad
+    assert g is not None and np.isfinite(np.asarray(g.numpy())).all()
+
+
+def test_simple_rnn_trains():
+    B, T, I, H = 4, 6, 3, 8
+    rs = np.random.RandomState(2)
+    net = nn.SimpleRNN(I, H)
+    head = nn.Linear(H, 1)
+    x = Tensor(rs.randn(B, T, I).astype("f4"))
+    y = Tensor(rs.randn(B, 1).astype("f4"))
+    losses = []
+    for _ in range(10):
+        out, _ = net(x)
+        last = out[:, -1]
+        pred = head(last)
+        diff = pred - y
+        loss = pt.tensor.math.mean(diff * diff)
+        losses.append(float(np.asarray(loss.numpy()).ravel()[0]))
+        loss.backward()
+        for p in list(net.parameters()) + list(head.parameters()):
+            if p.grad is not None:
+                p._set_raw(p._value - 0.05 * p.grad._value)
+                p.grad = None
+    assert losses[-1] < losses[0], losses
