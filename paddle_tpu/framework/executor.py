@@ -789,24 +789,50 @@ class Executor:
         if multiproc:
             from jax.sharding import NamedSharding
 
+            proc = jax.process_index()
+            # contiguous process blocks along dp (mesh devices are built
+            # process-major, see parallel_env.init_parallel_env); only
+            # valid when processes tile the dp axis alone — a mesh whose
+            # OTHER axes span processes would make the dp block span
+            # several processes and the slice below wrong
+            procs_on_dp = sorted({d.process_index
+                                  for d in mesh.devices.flat})
             if sharded_state:
-                raise NotImplementedError(
-                    "ZeRO-sharded optimizer state is not yet supported on "
-                    "multi-process meshes; use a single-process mesh or "
-                    "disable sharding")
+                dp_idx = axis_names.index(dp_axis)
+                rows = np.moveaxis(mesh.devices, dp_idx, 0)
+                if any(len({d.process_index for d in np.ravel(row)}) != 1
+                       for row in rows):
+                    raise NotImplementedError(
+                        f"ZeRO-sharded state on a multi-process mesh "
+                        f"requires each '{dp_axis}' position to belong to "
+                        f"exactly one process (processes must tile the dp "
+                        f"axis); reshape the mesh or disable sharding")
+            proc_pos = procs_on_dp.index(proc)
 
-            def to_global(val, pspec):
+            def to_global(val, pspec, state_name=None):
                 if _is_jax_array(val) and not getattr(
                         val, "is_fully_addressable", True):
                     return val  # already a global array (prior step output)
+                arr = np.asarray(val)
+                if state_name is not None and state_name in sharded_state \
+                        and arr.shape:
+                    # ZeRO state: every process initialized the FULL
+                    # array (replicated startup); hand jax only the
+                    # slice this process's dp block owns
+                    blk = arr.shape[0] // len(procs_on_dp)
+                    arr = arr[proc_pos * blk:(proc_pos + 1) * blk]
                 return jax.make_array_from_process_local_data(
-                    NamedSharding(mesh, pspec), np.asarray(val))
+                    NamedSharding(mesh, pspec), arr)
 
             def globalize(feed_vals, mut_vals, const_vals, rng):
                 feeds = tuple(to_global(v, s)
                               for v, s in zip(feed_vals, feed_specs_final))
-                muts = tuple(to_global(v, P()) for v in mut_vals)
-                consts = tuple(to_global(v, P()) for v in const_vals)
+                muts = tuple(
+                    to_global(v, state_spec(n), state_name=n)
+                    for n, v in zip(state_mut, mut_vals))
+                consts = tuple(
+                    to_global(v, state_spec(n), state_name=n)
+                    for n, v in zip(state_const, const_vals))
                 return feeds, muts, consts, to_global(rng, P())
 
         return fn, globalize

@@ -73,13 +73,12 @@ class RNNBase(Layer):
             setattr(self, f"_flat_w_{i}", p)
 
     # -- helpers ----------------------------------------------------------
-    def _zero_state(self, x, n_layers=None):
+    def _zero_state(self, x):
         import jax.numpy as jnp
 
         dt = x._value.dtype if isinstance(x, Tensor) else jnp.float32
         batch = x.shape[0] if self.time_major is False else x.shape[1]
-        nl = self.num_layers if n_layers is None else n_layers
-        shape = (nl * self._n_dir, batch, self.hidden_size)
+        shape = (self.num_layers * self._n_dir, batch, self.hidden_size)
         return Tensor(jnp.zeros(shape, dt), stop_gradient=True)
 
     def _run_op(self, x, states, weights, n_layers, input_size):

@@ -133,6 +133,8 @@ def main():
     if localsgd:
         strategy.localsgd = True
         strategy.localsgd_configs = {"k_steps": 2}
+    if os.environ.get("PADDLE_TPU_TEST_SHARDING") == "1":
+        strategy.sharding = True
     main_p, startup, loss = build_model(use_fleet=True, strategy=strategy)
 
     # deterministic global batch, shard by rank (trainer-local data)

@@ -130,6 +130,19 @@ def test_two_process_dygraph_data_parallel_parity(tmp_path):
                                    atol=1e-6)
 
 
+def test_two_process_zero_sharding_parity(tmp_path):
+    """ZeRO-1 over 2 REAL processes: reduce-scattered grads + dp-sharded
+    optimizer state must still reproduce the single-process trajectory
+    (each process feeds jax only its dp block of the replicated-startup
+    state)."""
+    results = _run_cluster(tmp_path, nproc=2, steps=5,
+                           extra_env={"PADDLE_TPU_TEST_SHARDING": "1"})
+    base = _single_process_losses(steps=5)
+    for res in results:
+        np.testing.assert_allclose(res["losses"], base, rtol=1e-4,
+                                   atol=1e-6)
+
+
 def test_two_process_localsgd_runs_and_converges(tmp_path):
     """LocalSGD's first end-to-end execution: k_steps=2 param averaging
     across 2 real processes; losses must be finite and decreasing (exact
