@@ -4,6 +4,7 @@ Reference parity: imperative/amp_auto_cast.cc (NeedCast:51) +
 python/paddle/amp/auto_cast.py amp_guard.
 """
 import numpy as np
+import pytest
 
 import paddle_tpu as pt
 from paddle_tpu import amp, nn
@@ -52,6 +53,47 @@ def test_backward_after_scope_exit_uses_recorded_dtype():
     loss.backward()  # scope exited; default dtype differs
     g = np.asarray(lin.weight.grad.numpy())
     assert g.dtype == np.float32 and np.isfinite(g).all()
+
+
+def test_grad_scaler_scales_unscales_and_skips_inf_steps():
+    """fp16-style dynamic loss scaling: scaled backward, unscale to the
+    true grads, inf grads skip the update and shrink the scale
+    (reference amp/grad_scaler.py state machine)."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.optimizer import SGD
+
+    rs = np.random.RandomState(4)
+    lin = nn.Linear(4, 1, bias_attr=False)
+    opt = SGD(learning_rate=0.1, parameters=lin.parameters())
+    scaler = amp.GradScaler(init_loss_scaling=2.0 ** 8,
+                            decr_every_n_nan_or_inf=1)
+    x = Tensor(rs.randn(3, 4).astype("f4"))
+
+    # normal step: unscaled grad equals the plain-backward grad
+    loss = pt.tensor.math.sum(lin(x))
+    scaled = scaler.scale(loss)
+    assert float(np.asarray(scaled.numpy()).ravel()[0]) == pytest.approx(
+        256.0 * float(np.asarray(loss.numpy()).ravel()[0]), rel=1e-6)
+    w_before = np.asarray(lin.weight.numpy()).copy()
+    scaled.backward()
+    scaler.step(opt)
+    opt.clear_grad()
+    want_grad = np.asarray(x.numpy()).sum(0, keepdims=True).T
+    got_w = np.asarray(lin.weight.numpy())
+    np.testing.assert_allclose(got_w, w_before - 0.1 * want_grad,
+                               rtol=1e-5, atol=1e-6)
+
+    # poisoned step: inf grad -> update skipped, scale halved
+    w_before = got_w.copy()
+    scale_before = scaler.get_loss_scaling()
+    bad = Tensor(np.array([[np.inf, 0, 0, 0]], "f4"))
+    loss2 = pt.tensor.math.sum(lin(bad))
+    scaler.scale(loss2).backward()
+    scaler.step(opt)
+    opt.clear_grad()
+    np.testing.assert_array_equal(np.asarray(lin.weight.numpy()), w_before)
+    assert scaler.get_loss_scaling() < scale_before
 
 
 def test_black_list_op_stays_fp32():
