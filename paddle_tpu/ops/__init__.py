@@ -9,6 +9,7 @@ from . import (  # noqa: F401
     collective,
     control_flow,
     creation,
+    detection_ops,
     fused,
     grad_generic,
     interp_ops,

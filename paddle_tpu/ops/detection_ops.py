@@ -1,0 +1,299 @@
+"""Detection ops: anchors, box coding, IoU, YOLO decoding.
+
+Reference parity: operators/detection/ — the dense, statically-shaped
+subset (prior_box, anchor_generator, box_coder, iou_similarity,
+yolo_box, box_clip).  NMS-style ops with data-dependent output shapes
+(multiclass_nms, generate_proposals, bipartite_match) are rejected
+loudly: XLA needs static shapes; decode-then-top-k pipelines cover the
+TPU serving path.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.lowering import register_lower
+
+
+@register_lower("prior_box")
+def _prior_box(ctx, op):
+    """SSD prior boxes (reference detection/prior_box_op.h): per feature-
+    map cell, boxes for each (min_size, aspect_ratio) pair + optional
+    max_size geometric means."""
+    feat = ctx.in1(op, "Input")  # [N, C, H, W]
+    image = ctx.in1(op, "Image")  # [N, C, IH, IW]
+    min_sizes = [float(s) for s in op.attr("min_sizes", [])]
+    max_sizes = [float(s) for s in op.attr("max_sizes", []) or []]
+    ars = [float(a) for a in op.attr("aspect_ratios", [1.0])]
+    variances = [float(v) for v in op.attr("variances",
+                                           [0.1, 0.1, 0.2, 0.2])]
+    flip = bool(op.attr("flip", True))
+    clip = bool(op.attr("clip", True))
+    step_w = float(op.attr("step_w", 0.0))
+    step_h = float(op.attr("step_h", 0.0))
+    offset = float(op.attr("offset", 0.5))
+    min_max_ar_first = bool(op.attr("min_max_aspect_ratios_order", False))
+
+    h, w = feat.shape[2], feat.shape[3]
+    ih, iw = image.shape[2], image.shape[3]
+    sw = step_w if step_w > 0 else iw / w
+    sh = step_h if step_h > 0 else ih / h
+
+    # expanded aspect ratios (reference ExpandAspectRatios: 1.0 first,
+    # then each ratio and optionally its flip, deduped)
+    out_ars = [1.0]
+    for ar in ars:
+        if any(abs(ar - e) < 1e-6 for e in out_ars):
+            continue
+        out_ars.append(ar)
+        if flip:
+            out_ars.append(1.0 / ar)
+
+    # per-cell (width, height) list in the reference emission order
+    whs = []
+    for mi, ms in enumerate(min_sizes):
+        if min_max_ar_first:
+            raise NotImplementedError(
+                "prior_box min_max_aspect_ratios_order=True layout not "
+                "implemented")
+        for ar in out_ars:
+            whs.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+        if max_sizes:
+            mx = max_sizes[mi]  # positional pairing (duplicates legal)
+            whs.append((np.sqrt(ms * mx), np.sqrt(ms * mx)))
+    whs = np.asarray(whs, np.float32)  # [P, 2]
+    p = whs.shape[0]
+
+    cx = (jnp.arange(w, dtype=jnp.float32) + offset) * sw
+    cy = (jnp.arange(h, dtype=jnp.float32) + offset) * sh
+    cxg, cyg = jnp.meshgrid(cx, cy)  # [H, W]
+    cxg = cxg[..., None]  # [H, W, 1]
+    cyg = cyg[..., None]
+    bw = jnp.asarray(whs[:, 0]) / 2.0  # [P]
+    bh = jnp.asarray(whs[:, 1]) / 2.0
+    boxes = jnp.stack([
+        (cxg - bw) / iw, (cyg - bh) / ih,
+        (cxg + bw) / iw, (cyg + bh) / ih,
+    ], axis=-1)  # [H, W, P, 4]
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32),
+                           (h, w, p, 4))
+    ctx.set_out(op, "Boxes", boxes)
+    ctx.set_out(op, "Variances", var)
+
+
+@register_lower("anchor_generator")
+def _anchor_generator(ctx, op):
+    """RCNN anchors — exact reference math (anchor_generator_op.h:53-75):
+    rounded base sizes from the stride area, scale by anchor_size/stride,
+    -1 half-extents, centers at idx*stride + offset*(stride-1)."""
+    feat = ctx.in1(op, "Input")  # [N, C, H, W]
+    sizes = [float(s) for s in op.attr("anchor_sizes", [])]
+    ars = [float(a) for a in op.attr("aspect_ratios", [])]
+    variances = [float(v) for v in op.attr("variances",
+                                           [0.1, 0.1, 0.2, 0.2])]
+    stride = [float(s) for s in op.attr("stride", [16.0, 16.0])]
+    offset = float(op.attr("offset", 0.5))
+    h, w = feat.shape[2], feat.shape[3]
+    sw, sh = stride[0], stride[1]
+
+    whs = []
+    for ar in ars:  # ratio-major loop order (reference idx order)
+        for size in sizes:
+            base_w = np.round(np.sqrt(sw * sh / ar))
+            base_h = np.round(base_w * ar)
+            whs.append((size / sw * base_w, size / sh * base_h))
+    whs = np.asarray(whs, np.float32)
+    p = whs.shape[0]
+    cx = jnp.arange(w, dtype=jnp.float32) * sw + offset * (sw - 1)
+    cy = jnp.arange(h, dtype=jnp.float32) * sh + offset * (sh - 1)
+    cxg, cyg = jnp.meshgrid(cx, cy)
+    cxg, cyg = cxg[..., None], cyg[..., None]
+    bw = 0.5 * (jnp.asarray(whs[:, 0]) - 1.0)
+    bh = 0.5 * (jnp.asarray(whs[:, 1]) - 1.0)
+    anchors = jnp.stack([cxg - bw, cyg - bh, cxg + bw, cyg + bh], axis=-1)
+    ctx.set_out(op, "Anchors", anchors)
+    ctx.set_out(op, "Variances", jnp.broadcast_to(
+        jnp.asarray(variances, jnp.float32), (h, w, p, 4)))
+
+
+@register_lower("iou_similarity")
+def _iou_similarity(ctx, op):
+    """Pairwise IoU (reference detection/iou_similarity_op.h):
+    X [N, 4] vs Y [M, 4] -> [N, M]."""
+    x = ctx.in1(op, "X")
+    y = ctx.in1(op, "Y")
+    box_normalized = bool(op.attr("box_normalized", True))
+    d = 0.0 if box_normalized else 1.0
+
+    def area(b):
+        return (b[..., 2] - b[..., 0] + d) * (b[..., 3] - b[..., 1] + d)
+
+    xi = x[:, None, :]  # [N, 1, 4]
+    yi = y[None, :, :]  # [1, M, 4]
+    ix1 = jnp.maximum(xi[..., 0], yi[..., 0])
+    iy1 = jnp.maximum(xi[..., 1], yi[..., 1])
+    ix2 = jnp.minimum(xi[..., 2], yi[..., 2])
+    iy2 = jnp.minimum(xi[..., 3], yi[..., 3])
+    iw = jnp.maximum(ix2 - ix1 + d, 0.0)
+    ih = jnp.maximum(iy2 - iy1 + d, 0.0)
+    inter = iw * ih
+    union = area(x)[:, None] + area(y)[None, :] - inter
+    ctx.set_out(op, "Out", inter / jnp.maximum(union, 1e-10))
+
+
+@register_lower("box_coder")
+def _box_coder(ctx, op):
+    """Encode/decode target boxes against priors (reference
+    detection/box_coder_op.h)."""
+    prior = ctx.in1(op, "PriorBox")  # [M, 4]
+    prior_var = ctx.in1(op, "PriorBoxVar")  # [M, 4] or None
+    target = ctx.in1(op, "TargetBox")
+    code_type = op.attr("code_type", "encode_center_size")
+    box_normalized = bool(op.attr("box_normalized", True))
+    axis = int(op.attr("axis", 0))
+    d = 0.0 if box_normalized else 1.0
+
+    pw = prior[:, 2] - prior[:, 0] + d
+    ph = prior[:, 3] - prior[:, 1] + d
+    pcx = prior[:, 0] + pw * 0.5
+    pcy = prior[:, 1] + ph * 0.5
+    if prior_var is not None:
+        pv = prior_var
+    else:
+        # variance may come as the 4-float attr instead of the tensor
+        # input (mutually exclusive in the reference; SSD exports use
+        # the attr form)
+        var_attr = op.attr("variance", []) or []
+        if var_attr:
+            pv = jnp.broadcast_to(
+                jnp.asarray([float(v) for v in var_attr], prior.dtype),
+                (prior.shape[0], 4))
+        else:
+            pv = jnp.ones((prior.shape[0], 4), prior.dtype)
+
+    if "encode" in code_type:
+        # target [N, 4] vs priors [M, 4] -> [N, M, 4]
+        tw = target[:, 2] - target[:, 0] + d
+        th = target[:, 3] - target[:, 1] + d
+        tcx = target[:, 0] + tw * 0.5
+        tcy = target[:, 1] + th * 0.5
+        ox = (tcx[:, None] - pcx[None, :]) / pw[None, :] / pv[None, :, 0]
+        oy = (tcy[:, None] - pcy[None, :]) / ph[None, :] / pv[None, :, 1]
+        ow = jnp.log(jnp.abs(tw[:, None] / pw[None, :])) / pv[None, :, 2]
+        oh = jnp.log(jnp.abs(th[:, None] / ph[None, :])) / pv[None, :, 3]
+        out = jnp.stack([ox, oy, ow, oh], axis=-1)
+    else:
+        # decode: target [N, M, 4] deltas against priors broadcast on axis
+        if axis == 0:
+            pcx_b, pcy_b = pcx[None, :], pcy[None, :]
+            pw_b, ph_b = pw[None, :], ph[None, :]
+            pv_b = pv[None, :, :]
+        else:
+            pcx_b, pcy_b = pcx[:, None], pcy[:, None]
+            pw_b, ph_b = pw[:, None], ph[:, None]
+            pv_b = pv[:, None, :]
+        dcx = pv_b[..., 0] * target[..., 0] * pw_b + pcx_b
+        dcy = pv_b[..., 1] * target[..., 1] * ph_b + pcy_b
+        dw = jnp.exp(pv_b[..., 2] * target[..., 2]) * pw_b
+        dh = jnp.exp(pv_b[..., 3] * target[..., 3]) * ph_b
+        out = jnp.stack([dcx - dw / 2, dcy - dh / 2,
+                         dcx + dw / 2 - d, dcy + dh / 2 - d], axis=-1)
+    ctx.set_out(op, "OutputBox", out)
+
+
+@register_lower("yolo_box")
+def _yolo_box(ctx, op):
+    """YOLOv3 head decoding (reference detection/yolo_box_op.h)."""
+    x = ctx.in1(op, "X")  # [N, A*(5+C), H, W]
+    img_size = ctx.in1(op, "ImgSize")  # [N, 2] (h, w) int
+    anchors = [int(a) for a in op.attr("anchors", [])]
+    class_num = int(op.attr("class_num", 1))
+    conf_thresh = float(op.attr("conf_thresh", 0.01))
+    downsample = int(op.attr("downsample_ratio", 32))
+    clip_bbox = bool(op.attr("clip_bbox", True))
+    scale = float(op.attr("scale_x_y", 1.0))
+    bias = -0.5 * (scale - 1.0)
+
+    n, c, h, w = x.shape
+    a = len(anchors) // 2
+    xr = x.reshape(n, a, 5 + class_num, h, w)
+    img_h = img_size[:, 0].astype(x.dtype)[:, None, None, None]
+    img_w = img_size[:, 1].astype(x.dtype)[:, None, None, None]
+    in_h = downsample * h
+    in_w = downsample * w
+
+    gx = jnp.arange(w, dtype=x.dtype)[None, None, None, :]
+    gy = jnp.arange(h, dtype=x.dtype)[None, None, :, None]
+    aw = jnp.asarray(anchors[0::2], x.dtype)[None, :, None, None]
+    ah = jnp.asarray(anchors[1::2], x.dtype)[None, :, None, None]
+
+    bx = (gx + jax.nn.sigmoid(xr[:, :, 0]) * scale + bias) * img_w / w
+    by = (gy + jax.nn.sigmoid(xr[:, :, 1]) * scale + bias) * img_h / h
+    bw = jnp.exp(xr[:, :, 2]) * aw * img_w / in_w
+    bh = jnp.exp(xr[:, :, 3]) * ah * img_h / in_h
+    conf = jax.nn.sigmoid(xr[:, :, 4])
+
+    x1 = bx - bw / 2
+    y1 = by - bh / 2
+    x2 = bx + bw / 2
+    y2 = by + bh / 2
+    if clip_bbox:
+        x1 = jnp.maximum(x1, 0.0)
+        y1 = jnp.maximum(y1, 0.0)
+        x2 = jnp.minimum(x2, img_w - 1)
+        y2 = jnp.minimum(y2, img_h - 1)
+    boxes = jnp.stack([x1, y1, x2, y2], axis=-1)  # [N, A, H, W, 4]
+    # reference zeroes boxes whose conf < thresh
+    keep = (conf >= conf_thresh)[..., None].astype(x.dtype)
+    boxes = boxes * keep
+    scores = (conf[..., None]
+              * jax.nn.sigmoid(jnp.moveaxis(xr[:, :, 5:], 2, -1)))
+    scores = scores * keep
+    ctx.set_out(op, "Boxes", boxes.reshape(n, a * h * w, 4))
+    ctx.set_out(op, "Scores", scores.reshape(n, a * h * w, class_num))
+
+
+@register_lower("box_clip")
+def _box_clip(ctx, op):
+    boxes = ctx.in1(op, "Input")  # [N, 4] (single image) or [B, N, 4]
+    im_info = ctx.in1(op, "ImInfo")  # [B, 3] (h, w, scale)
+    # reference rounds the rescaled extent before the -1
+    h = jnp.round(im_info[:, 0] / im_info[:, 2]) - 1.0
+    w = jnp.round(im_info[:, 1] / im_info[:, 2]) - 1.0
+    if boxes.ndim == 2:
+        if im_info.shape[0] != 1:
+            raise NotImplementedError(
+                "box_clip with a flat [N,4] box tensor and multiple "
+                "images needs LoD segments, which dense tensors do not "
+                "carry; pass [B,N,4] batched boxes instead")
+        h0, w0 = h[0], w[0]
+        out = jnp.stack([
+            jnp.clip(boxes[:, 0], 0, w0), jnp.clip(boxes[:, 1], 0, h0),
+            jnp.clip(boxes[:, 2], 0, w0), jnp.clip(boxes[:, 3], 0, h0),
+        ], axis=-1)
+    else:
+        hb = h[:, None]
+        wb = w[:, None]
+        out = jnp.stack([
+            jnp.clip(boxes[..., 0], 0, wb), jnp.clip(boxes[..., 1], 0, hb),
+            jnp.clip(boxes[..., 2], 0, wb), jnp.clip(boxes[..., 3], 0, hb),
+        ], axis=-1)
+    ctx.set_out(op, "Output", out)
+
+
+def _dynamic_shape_reject(name):
+    def rule(ctx, op):
+        raise NotImplementedError(
+            f"{name} produces data-dependent output shapes, which XLA "
+            f"static shapes cannot express; use the dense decode ops "
+            f"(yolo_box/box_coder) + top-k style selection instead")
+
+    return rule
+
+
+for _n in ("multiclass_nms", "multiclass_nms2", "generate_proposals",
+           "bipartite_match", "matrix_nms"):
+    register_lower(_n)(_dynamic_shape_reject(_n))

@@ -1,0 +1,178 @@
+"""Detection ops vs numpy oracles (reference operators/detection/)."""
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+
+class TestIouSimilarity(OpTest):
+    op_type = "iou_similarity"
+
+    def setup(self):
+        x = np.array([[0, 0, 2, 2], [1, 1, 3, 3]], "f4")
+        y = np.array([[0, 0, 2, 2], [2, 2, 4, 4], [0, 0, 4, 4]], "f4")
+
+        def iou(a, b):
+            ix = max(0.0, min(a[2], b[2]) - max(a[0], b[0]))
+            iy = max(0.0, min(a[3], b[3]) - max(a[1], b[1]))
+            inter = ix * iy
+            ua = ((a[2] - a[0]) * (a[3] - a[1])
+                  + (b[2] - b[0]) * (b[3] - b[1]) - inter)
+            return inter / ua
+
+        out = np.array([[iou(a, b) for b in y] for a in x], "f4")
+        self.inputs = {"X": [("x", x)], "Y": [("y", y)]}
+        self.attrs = {"box_normalized": True}
+        self.outputs = {"Out": [("out", out)]}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestBoxCoderDecode(OpTest):
+    op_type = "box_coder"
+
+    def setup(self):
+        prior = np.array([[0.1, 0.1, 0.5, 0.5], [0.2, 0.2, 0.8, 0.8]], "f4")
+        pvar = np.tile(np.array([[0.1, 0.1, 0.2, 0.2]], "f4"), (2, 1))
+        deltas = np.random.RandomState(0).randn(3, 2, 4).astype("f4") * 0.1
+
+        pw = prior[:, 2] - prior[:, 0]
+        ph = prior[:, 3] - prior[:, 1]
+        pcx = prior[:, 0] + pw / 2
+        pcy = prior[:, 1] + ph / 2
+        dcx = pvar[:, 0] * deltas[..., 0] * pw + pcx
+        dcy = pvar[:, 1] * deltas[..., 1] * ph + pcy
+        dw = np.exp(pvar[:, 2] * deltas[..., 2]) * pw
+        dh = np.exp(pvar[:, 3] * deltas[..., 3]) * ph
+        out = np.stack([dcx - dw / 2, dcy - dh / 2,
+                        dcx + dw / 2, dcy + dh / 2], axis=-1).astype("f4")
+        self.inputs = {"PriorBox": [("prior", prior)],
+                       "PriorBoxVar": [("pvar", pvar)],
+                       "TargetBox": [("t", deltas)]}
+        self.attrs = {"code_type": "decode_center_size",
+                      "box_normalized": True, "axis": 0}
+        self.outputs = {"OutputBox": [("out", out)]}
+
+    def test_output(self):
+        self.check_output(atol=1e-5, rtol=1e-4)
+
+
+class TestPriorBox(OpTest):
+    op_type = "prior_box"
+
+    def setup(self):
+        feat = np.zeros((1, 8, 2, 2), "f4")
+        image = np.zeros((1, 3, 32, 32), "f4")
+        min_sizes, ar = [4.0], [1.0]
+        # cells at step 16, offset .5 -> centers 8, 24; one box (ar=1)
+        boxes = np.zeros((2, 2, 1, 4), "f4")
+        for i in range(2):
+            for j in range(2):
+                cx, cy = (j + 0.5) * 16, (i + 0.5) * 16
+                boxes[i, j, 0] = [(cx - 2) / 32, (cy - 2) / 32,
+                                  (cx + 2) / 32, (cy + 2) / 32]
+        var = np.tile(np.array([0.1, 0.1, 0.2, 0.2], "f4"), (2, 2, 1, 1))
+        self.inputs = {"Input": [("feat", feat)], "Image": [("img", image)]}
+        self.attrs = {"min_sizes": min_sizes, "aspect_ratios": ar,
+                      "variances": [0.1, 0.1, 0.2, 0.2], "flip": True,
+                      "clip": True, "offset": 0.5}
+        self.outputs = {"Boxes": [("boxes", boxes)],
+                        "Variances": [("var", var)]}
+
+    def test_output(self):
+        self.check_output(atol=1e-5, rtol=1e-4)
+
+
+class TestYoloBox(OpTest):
+    op_type = "yolo_box"
+
+    def setup(self):
+        n, a, c, h, w = 1, 2, 3, 2, 2
+        rs = np.random.RandomState(0)
+        x = rs.randn(n, a * (5 + c), h, w).astype("f4")
+        img = np.array([[64, 64]], "i4")
+        anchors = [10, 13, 16, 30]
+        down = 32
+
+        def sig(v):
+            return 1.0 / (1.0 + np.exp(-v))
+
+        xr = x.reshape(n, a, 5 + c, h, w)
+        boxes = np.zeros((n, a, h, w, 4), "f4")
+        scores = np.zeros((n, a, h, w, c), "f4")
+        for ai in range(a):
+            for i in range(h):
+                for j in range(w):
+                    bx = (j + sig(xr[0, ai, 0, i, j])) * 64 / w
+                    by = (i + sig(xr[0, ai, 1, i, j])) * 64 / h
+                    bw = np.exp(xr[0, ai, 2, i, j]) * anchors[2 * ai] * 64 / (down * w)
+                    bh = np.exp(xr[0, ai, 3, i, j]) * anchors[2 * ai + 1] * 64 / (down * h)
+                    conf = sig(xr[0, ai, 4, i, j])
+                    bb = [max(bx - bw / 2, 0), max(by - bh / 2, 0),
+                          min(bx + bw / 2, 63), min(by + bh / 2, 63)]
+                    if conf >= 0.5:
+                        boxes[0, ai, i, j] = bb
+                        scores[0, ai, i, j] = conf * sig(xr[0, ai, 5:, i, j])
+        self.inputs = {"X": [("x", x)], "ImgSize": [("img", img)]}
+        self.attrs = {"anchors": anchors, "class_num": c,
+                      "conf_thresh": 0.5, "downsample_ratio": down,
+                      "clip_bbox": True, "scale_x_y": 1.0}
+        self.outputs = {
+            "Boxes": [("boxes", boxes.reshape(n, a * h * w, 4))],
+            "Scores": [("scores", scores.reshape(n, a * h * w, c))],
+        }
+
+    def test_output(self):
+        self.check_output(atol=1e-4, rtol=1e-4)
+
+
+class TestAnchorGenerator(OpTest):
+    op_type = "anchor_generator"
+
+    def setup(self):
+        feat = np.zeros((1, 8, 2, 2), "f4")
+        sizes, ars, stride, offset = [32.0], [1.0, 2.0], [16.0, 16.0], 0.5
+        # reference math (anchor_generator_op.h:53-75)
+        whs = []
+        for ar in ars:
+            for s in sizes:
+                base_w = round(np.sqrt(16 * 16 / ar))
+                base_h = round(base_w * ar)
+                whs.append((s / 16 * base_w, s / 16 * base_h))
+        anchors = np.zeros((2, 2, len(whs), 4), "f4")
+        for i in range(2):
+            for j in range(2):
+                xc = j * 16 + 0.5 * 15
+                yc = i * 16 + 0.5 * 15
+                for k, (aw, ah) in enumerate(whs):
+                    anchors[i, j, k] = [xc - 0.5 * (aw - 1),
+                                        yc - 0.5 * (ah - 1),
+                                        xc + 0.5 * (aw - 1),
+                                        yc + 0.5 * (ah - 1)]
+        var = np.tile(np.array([0.1, 0.1, 0.2, 0.2], "f4"),
+                      (2, 2, len(whs), 1))
+        self.inputs = {"Input": [("feat", feat)]}
+        self.attrs = {"anchor_sizes": sizes, "aspect_ratios": ars,
+                      "stride": stride, "offset": offset,
+                      "variances": [0.1, 0.1, 0.2, 0.2]}
+        self.outputs = {"Anchors": [("anchors", anchors)],
+                        "Variances": [("var", var)]}
+
+    def test_output(self):
+        self.check_output(atol=1e-4, rtol=1e-4)
+
+
+def test_nms_rejected_loudly():
+    from paddle_tpu.framework.lowering import LOWERINGS
+
+    class FakeOp:
+        type = "multiclass_nms"
+        inputs = {}
+        outputs = {}
+
+        def attr(self, *a, **k):
+            return None
+
+    with pytest.raises(NotImplementedError, match="data-dependent"):
+        LOWERINGS["multiclass_nms"](None, FakeOp())
