@@ -71,4 +71,4 @@ from . import text  # noqa: F401
 from .serialization import load, save  # noqa: F401
 from .framework.flags import get_flags, set_flags  # noqa: F401
 
-__version__ = "0.1.0"
+__version__ = "0.3.0"  # rounds track the continuous build
