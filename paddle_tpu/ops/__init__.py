@@ -9,6 +9,7 @@ from . import (  # noqa: F401
     collective,
     control_flow,
     creation,
+    deformable_ops,
     detection_ops,
     fused,
     grad_generic,

@@ -53,3 +53,36 @@ def resolve_shape_attr(shape, env_get=None):
 def as_scalar(x):
     """Ops like sgd receive learning rate as a [1] tensor."""
     return jnp.reshape(x, ()) if hasattr(x, "shape") and np.prod(x.shape) == 1 else x
+
+
+def bilinear_sample_chw(img, ys, xs, padding="zeros"):
+    """Bilinear sampling of img [C, H, W] at float coords ys/xs [...].
+
+    padding="zeros": out-of-range taps contribute 0 (reference
+    DmcnIm2colBilinear / grid_sampler zeros semantics — the validity
+    test runs on the UNCLIPPED coordinate, so coords in (-1, 0) get the
+    partial in-range contribution).  padding="border": coords clamp to
+    the edge pixel.  Shared by deformable conv and grid_sampler so the
+    subtle boundary semantics live in one place.
+    """
+    import jax.numpy as jnp
+
+    c, h, w = img.shape
+    y0 = jnp.floor(ys)
+    x0 = jnp.floor(xs)
+
+    def at(yy, xx):
+        yc = jnp.clip(yy, 0, h - 1).astype(jnp.int32)
+        xc = jnp.clip(xx, 0, w - 1).astype(jnp.int32)
+        vals = img[:, yc, xc]  # [C, ...]
+        if padding == "zeros":
+            valid = (yy >= 0) & (yy < h) & (xx >= 0) & (xx < w)
+            vals = vals * valid.astype(img.dtype)
+        return vals
+
+    wy = ys - y0
+    wx = xs - x0
+    return (at(y0, x0) * (1 - wy) * (1 - wx)
+            + at(y0, x0 + 1) * (1 - wy) * wx
+            + at(y0 + 1, x0) * wy * (1 - wx)
+            + at(y0 + 1, x0 + 1) * wy * wx)

@@ -1,0 +1,85 @@
+"""Deformable convolution v1/v2 via bilinear sampling + matmul.
+
+Reference parity: operators/deformable_conv_op.cu (v2, with modulation
+Mask) and deformable_conv_v1_op.cu.  TPU-native: the deformable im2col
+is a vectorized bilinear gather over all (kernel position, output
+location) pairs — XLA turns it into gathers — followed by ONE MXU
+matmul with the filter; backward comes from the generic vjp (gathers
+transpose to scatters).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.lowering import register_lower
+from .common import bilinear_sample_chw
+
+
+def _deformable_conv(ctx, op, with_mask):
+    x = ctx.in1(op, "Input")  # [N, C, H, W]
+    offset = ctx.in1(op, "Offset")  # [N, 2*dg*kh*kw, OH, OW]
+    mask = ctx.in1(op, "Mask") if with_mask else None  # [N, dg*kh*kw, OH, OW]
+    f = ctx.in1(op, "Filter")  # [O, C/g, kh, kw]
+    strides = [int(s) for s in op.attr("strides", [1, 1])]
+    paddings = [int(p) for p in op.attr("paddings", [0, 0])]
+    dilations = [int(d) for d in op.attr("dilations", [1, 1])]
+    groups = int(op.attr("groups", 1) or 1)
+    dg = int(op.attr("deformable_groups", 1) or 1)
+
+    n, c, h, w = x.shape
+    o, _cg, kh, kw = f.shape
+    oh = offset.shape[2]
+    ow = offset.shape[3]
+    kk = kh * kw
+
+    # base sampling grid per (kernel pos, output loc): [kh, kw, OH, OW]
+    ky = (jnp.arange(kh) * dilations[0])[:, None, None, None]
+    kx = (jnp.arange(kw) * dilations[1])[None, :, None, None]
+    oy = (jnp.arange(oh) * strides[0] - paddings[0])[None, None, :, None]
+    ox = (jnp.arange(ow) * strides[1] - paddings[1])[None, None, None, :]
+    gy = (ky + oy).astype(x.dtype)  # [kh, kw, OH, OW] (broadcast)
+    gx = (kx + ox).astype(x.dtype)
+    gy = jnp.broadcast_to(gy, (kh, kw, oh, ow)).reshape(kk, oh, ow)
+    gx = jnp.broadcast_to(gx, (kh, kw, oh, ow)).reshape(kk, oh, ow)
+
+    # offsets: [N, dg, kk, 2, OH, OW] with (dy, dx) pairs
+    off = offset.reshape(n, dg, kk, 2, oh, ow)
+    cpg = c // dg  # channels per deformable group
+
+    def per_image(img, off_i, mask_i):
+        # img [C, H, W]; off_i [dg, kk, 2, OH, OW]
+        cols = []
+        for g in range(dg):
+            ys = gy[None] + off_i[g, :, 0]  # [kk, OH, OW]
+            xs = gx[None] + off_i[g, :, 1]
+            sub = img[g * cpg:(g + 1) * cpg]
+            s = bilinear_sample_chw(sub, ys, xs)  # [cpg, kk, OH, OW]
+            if mask_i is not None:
+                s = s * mask_i[g][None]  # [1, kk, OH, OW]
+            cols.append(s)
+        return jnp.concatenate(cols, axis=0)  # [C, kk, OH, OW]
+
+    if mask is not None:
+        m = mask.reshape(n, dg, kk, oh, ow)
+        cols = jax.vmap(per_image)(x, off, m)
+    else:
+        cols = jax.vmap(lambda img, off_i: per_image(img, off_i, None))(
+            x, off)
+    # cols [N, C, kk, OH, OW] -> grouped matmul with the filter
+    cg = c // groups
+    og = o // groups
+    cols_g = cols.reshape(n, groups, cg, kk, oh, ow)
+    f_g = f.reshape(groups, og, cg, kk)
+    out = jnp.einsum("ngckhw,gock->ngohw", cols_g, f_g)
+    ctx.set_out(op, "Output", out.reshape(n, o, oh, ow))
+
+
+@register_lower("deformable_conv")
+def _deformable_conv_v2(ctx, op):
+    _deformable_conv(ctx, op, with_mask=True)
+
+
+@register_lower("deformable_conv_v1")
+def _deformable_conv_v1(ctx, op):
+    _deformable_conv(ctx, op, with_mask=False)

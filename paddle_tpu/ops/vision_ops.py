@@ -176,27 +176,23 @@ def _grid_sampler(ctx, op):
         gx = ((grid[..., 0] + 1.0) * w - 1.0) / 2.0
         gy = ((grid[..., 1] + 1.0) * h - 1.0) / 2.0
 
-    def gather(yy, xx):
-        yc = jnp.clip(yy, 0, h - 1).astype(jnp.int32)
-        xc = jnp.clip(xx, 0, w - 1).astype(jnp.int32)
-        # vals[n, c, ho, wo] = x[n, c, yc[n,ho,wo], xc[n,ho,wo]]
-        vals = jax.vmap(lambda img, ys, xs: img[:, ys, xs])(x, yc, xc)
-        if padding_mode == "zeros":
-            valid = (yy >= 0) & (yy < h) & (xx >= 0) & (xx < w)
-            vals = vals * valid[:, None].astype(x.dtype)
-        return vals
-
     if mode == "nearest":
+        def gather(yy, xx):
+            yc = jnp.clip(yy, 0, h - 1).astype(jnp.int32)
+            xc = jnp.clip(xx, 0, w - 1).astype(jnp.int32)
+            vals = jax.vmap(lambda img, ys, xs: img[:, ys, xs])(x, yc, xc)
+            if padding_mode == "zeros":
+                valid = (yy >= 0) & (yy < h) & (xx >= 0) & (xx < w)
+                vals = vals * valid[:, None].astype(x.dtype)
+            return vals
+
         out = gather(jnp.round(gy), jnp.round(gx))
     else:
-        x0 = jnp.floor(gx)
-        y0 = jnp.floor(gy)
-        wx = (gx - x0)[:, None]
-        wy = (gy - y0)[:, None]
-        out = (gather(y0, x0) * (1 - wx) * (1 - wy)
-               + gather(y0, x0 + 1) * wx * (1 - wy)
-               + gather(y0 + 1, x0) * (1 - wx) * wy
-               + gather(y0 + 1, x0 + 1) * wx * wy)
+        from .common import bilinear_sample_chw
+
+        out = jax.vmap(
+            lambda img, ys, xs: bilinear_sample_chw(
+                img, ys, xs, padding=padding_mode))(x, gy, gx)
     ctx.set_out(op, "Output", out)
 
 

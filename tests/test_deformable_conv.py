@@ -1,0 +1,97 @@
+"""Deformable conv v1/v2 vs oracles.
+
+Zero offsets must reduce EXACTLY to plain conv2d (the defining
+identity); integer offsets equal a shifted conv; the modulation mask
+scales sampled values.  Reference operators/deformable_conv_op.cu.
+"""
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu.framework.program import Program, program_guard
+
+
+def _run(op_type, x, offset, f, mask=None, attrs=None):
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        blk = main.global_block
+        ins = {"Input": ["x"], "Offset": ["off"], "Filter": ["f"]}
+        feed = {"x": x, "off": offset, "f": f}
+        for n, a in list(feed.items()):
+            blk.create_var(name=n, shape=a.shape, dtype="float32",
+                           stop_gradient=True)
+        if mask is not None:
+            blk.create_var(name="m", shape=mask.shape, dtype="float32",
+                           stop_gradient=True)
+            ins["Mask"] = ["m"]
+            feed["m"] = mask
+        blk.create_var(name="out", dtype="float32")
+        blk.append_op(op_type, ins, {"Output": ["out"]}, dict(attrs or {}))
+    exe = pt.Executor(pt.CPUPlace())
+    sc = pt.framework.Scope()
+    exe.run(startup, scope=sc)
+    return np.asarray(exe.run(main, feed=feed, fetch_list=["out"],
+                              scope=sc)[0])
+
+
+def _conv_oracle(x, f, stride=1, pad=1):
+    n, c, h, w = x.shape
+    o, _, kh, kw = f.shape
+    xp = np.pad(x, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    oh = (h + 2 * pad - kh) // stride + 1
+    ow = (w + 2 * pad - kw) // stride + 1
+    out = np.zeros((n, o, oh, ow), "f4")
+    for i in range(oh):
+        for j in range(ow):
+            patch = xp[:, :, i * stride:i * stride + kh,
+                       j * stride:j * stride + kw]
+            out[:, :, i, j] = np.einsum("nchw,ochw->no", patch, f)
+    return out
+
+
+def test_zero_offset_equals_plain_conv():
+    rs = np.random.RandomState(0)
+    x = rs.randn(2, 4, 6, 6).astype("f4")
+    f = rs.randn(3, 4, 3, 3).astype("f4")
+    off = np.zeros((2, 2 * 9, 6, 6), "f4")
+    got = _run("deformable_conv_v1", x, off, f,
+               attrs={"strides": [1, 1], "paddings": [1, 1],
+                      "dilations": [1, 1], "groups": 1,
+                      "deformable_groups": 1})
+    want = _conv_oracle(x, f)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_mask_scales_v2():
+    rs = np.random.RandomState(1)
+    x = rs.randn(1, 2, 5, 5).astype("f4")
+    f = rs.randn(2, 2, 3, 3).astype("f4")
+    off = np.zeros((1, 18, 5, 5), "f4")
+    mask_half = np.full((1, 9, 5, 5), 0.5, "f4")
+    got = _run("deformable_conv", x, off, f, mask=mask_half,
+               attrs={"strides": [1, 1], "paddings": [1, 1],
+                      "dilations": [1, 1], "groups": 1,
+                      "deformable_groups": 1})
+    want = 0.5 * _conv_oracle(x, f)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_integer_offset_shifts_sampling():
+    """Offset (dy=0, dx=1) samples one pixel right: equals plain conv on
+    the right-shifted image (interior columns)."""
+    rs = np.random.RandomState(2)
+    x = rs.randn(1, 1, 6, 6).astype("f4")
+    f = rs.randn(1, 1, 3, 3).astype("f4")
+    off = np.zeros((1, 18, 6, 6), "f4")
+    off[:, 1::2] = 1.0  # dx entries
+    got = _run("deformable_conv_v1", x, off, f,
+               attrs={"strides": [1, 1], "paddings": [1, 1],
+                      "dilations": [1, 1], "groups": 1,
+                      "deformable_groups": 1})
+    x_shift = np.zeros_like(x)
+    x_shift[..., :-1] = x[..., 1:]  # shift left = sample right
+    want = _conv_oracle(x_shift, f)
+    # both edges touch zero-padding differently (the shifted-image
+    # oracle pads where the deformable op samples real pixels): compare
+    # the interior columns where the identity is exact
+    np.testing.assert_allclose(got[..., 1:-2], want[..., 1:-2],
+                               rtol=1e-4, atol=1e-5)

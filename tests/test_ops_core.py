@@ -483,7 +483,10 @@ class TestActivations(OpTest):
                 op_type = name
 
             t = T(methodName="run")
-            x = np.random.randn(3, 4).astype("float32")
+            # seeded, and kept away from 0: relu-family kinks inside the
+            # finite-difference delta make the numeric grad flaky
+            x = np.random.RandomState(7).randn(3, 4).astype("float32")
+            x = np.where(np.abs(x) < 5e-3, 5e-3, x)
             t.inputs = {"X": [("x", x)]}
             t.attrs = {}
             t.outputs = {"Out": [("out", ref(x).astype("float32"))]}
