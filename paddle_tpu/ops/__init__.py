@@ -22,6 +22,7 @@ from . import (  # noqa: F401
     nn_ops,
     optimizer_ops,
     rnn_ops,
+    sampling_ops,
     sequence_ops,
     tensor_ops,
     vision_ops,

@@ -1,0 +1,150 @@
+"""Sampling-based ops: NCE, sample_logits, correlation cost volume.
+
+Reference parity: operators/nce_op.{cc,h} (noise-contrastive estimation
+with uniform/log-uniform samplers), operators/sample_logits_op.cc, and
+operators/correlation_op.cu (FlowNet cost volume).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.lowering import register_lower
+from .common import op_seed_key
+
+
+def _sampler_prob(idx, sampler, n_classes):
+    """P(class) under the sampler — ONE home for the Zipfian formula
+    (reference sampler.cc LogUniformSampler::Probability)."""
+    if sampler == 0:
+        return jnp.full(jnp.shape(idx), 1.0 / n_classes)
+    return (jnp.log((idx + 2.0) / (idx + 1.0))) / np.log(n_classes + 1.0)
+
+
+def _draw_samples(ctx, op, n_samples, n_classes):
+    sampler = int(op.attr("sampler", 0))
+    k = op_seed_key(ctx, op)
+    if sampler == 0:  # uniform
+        s = jax.random.randint(k, (n_samples,), 0, n_classes)
+    elif sampler == 1:  # log-uniform (Zipfian), reference math
+        u = jax.random.uniform(k, (n_samples,))
+        s = (jnp.exp(u * np.log(n_classes + 1.0)) - 1.0).astype(jnp.int32)
+        s = jnp.clip(s, 0, n_classes - 1)
+    else:
+        raise NotImplementedError(
+            "nce custom_dist sampler (2) needs CustomDist* inputs; use "
+            "uniform (0) or log-uniform (1)")
+    return s, _sampler_prob(s, sampler, n_classes)
+
+
+@register_lower("nce")
+def _nce(ctx, op):
+    """Noise-contrastive estimation (reference nce_op.h): binary logistic
+    loss over the true class + num_neg_samples drawn noise classes."""
+    x = ctx.in1(op, "Input")  # [B, D]
+    label = ctx.in1(op, "Label")  # [B, T] true classes
+    w = ctx.in1(op, "Weight")  # [num_classes, D]
+    b = ctx.in1(op, "Bias")  # [num_classes] or None
+    n_classes = int(op.attr("num_total_classes"))
+    n_neg = int(op.attr("num_neg_samples", 10))
+
+    bsz = x.shape[0]
+    t = label.shape[1] if label.ndim > 1 else 1
+    lbl = label.reshape(bsz, t)
+    samples, sample_prob = _draw_samples(ctx, op, n_neg, n_classes)
+
+    true_logit = jnp.einsum("bd,btd->bt", x, w[lbl])
+    if b is not None:
+        true_logit = true_logit + b[lbl]
+    noise_logit = x @ w[samples].T  # [B, n_neg]
+    if b is not None:
+        noise_logit = noise_logit + b[samples]
+
+    sampler = int(op.attr("sampler", 0))
+    p_true = _sampler_prob(lbl, sampler, n_classes)
+    # NCE: sigmoid cross-entropy against logit - log(k * P_noise);
+    # softplus keeps large logits finite (log1p(exp(x)) overflows)
+    k = float(n_neg)
+    true_adj = true_logit - jnp.log(k * p_true)
+    noise_adj = noise_logit - jnp.log(k * sample_prob)[None, :]
+    pos_loss = jax.nn.softplus(-true_adj).sum(axis=1)
+    neg_loss = jax.nn.softplus(noise_adj).sum(axis=1)
+    ctx.set_out(op, "Cost", (pos_loss + neg_loss).reshape(bsz, 1))
+    ctx.set_out(op, "SampleLogits",
+                jnp.concatenate([true_logit, noise_logit], axis=1))
+    ctx.set_out(op, "SampleLabels", jnp.concatenate(
+        [lbl, jnp.broadcast_to(samples[None], (bsz, n_neg))],
+        axis=1).astype(jnp.int64))
+
+
+@register_lower("sample_logits")
+def _sample_logits(ctx, op):
+    """Sampled-softmax helper (reference sample_logits_op): gather the
+    true-label logits plus sampled-class logits, with the log-prob
+    correction, for a cheap softmax over num_samples classes."""
+    logits = ctx.in1(op, "Logits")  # [B, C]
+    label = ctx.in1(op, "Labels")  # [B, T]
+    n_samples = int(op.attr("num_samples", 10))
+    c = logits.shape[1]
+    bsz = logits.shape[0]
+    t = label.shape[1]
+    samples, prob = _draw_samples(ctx, op, n_samples, c)
+    all_idx = jnp.concatenate(
+        [label.astype(jnp.int32),
+         jnp.broadcast_to(samples[None].astype(jnp.int32),
+                          (bsz, n_samples))], axis=1)
+    picked = jnp.take_along_axis(logits, all_idx, axis=1)
+    if bool(op.attr("remove_accidental_hits", True)):
+        acc = (all_idx[:, t:, None]
+               == label[:, None, :].astype(jnp.int32)).any(-1)
+        picked = picked.at[:, t:].add(-1e20 * acc.astype(picked.dtype))
+    # subtract log Q as in sampled softmax (true labels use the SAME
+    # sampler distribution as the drawn negatives)
+    sampler = int(op.attr("sampler", 0))
+    logq = jnp.concatenate(
+        [jnp.log(_sampler_prob(label.astype(jnp.float32), sampler, c)),
+         jnp.broadcast_to(jnp.log(prob)[None], (bsz, n_samples))], axis=1)
+    ctx.set_out(op, "SampledLogits", picked - logq)
+    ctx.set_out(op, "SampledLabels",
+                jnp.broadcast_to(jnp.arange(t)[None], (bsz, t))
+                .astype(jnp.int64))
+    ctx.set_out(op, "Samples", all_idx.astype(jnp.int64))
+    ctx.set_out(op, "Probabilities", jnp.exp(logq))
+    ctx.set_out(op, "LogitsDim", jnp.asarray(logits.shape, jnp.int64))
+    ctx.set_out(op, "LabelsDim", jnp.asarray(label.shape, jnp.int64))
+
+
+@register_lower("correlation")
+def _correlation(ctx, op):
+    """FlowNet correlation cost volume (reference correlation_op.cu):
+    for each displacement in the max_displacement neighborhood, the
+    channel-mean of x1(p) * x2(p + d) over kernel patches."""
+    x1 = ctx.in1(op, "Input1")  # [N, C, H, W]
+    x2 = ctx.in1(op, "Input2")
+    pad = int(op.attr("pad_size", 0))
+    ks = int(op.attr("kernel_size", 1))
+    max_disp = int(op.attr("max_displacement", 1))
+    stride1 = int(op.attr("stride1", 1))
+    stride2 = int(op.attr("stride2", 1))
+    if ks != 1:
+        raise NotImplementedError("correlation kernel_size > 1")
+    n, c, h, w = x1.shape
+    x1p = jnp.pad(x1, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    x2p = jnp.pad(x2, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    # reference grid: radius = max_disp // stride2, displacements are
+    # multiples of stride2 (correlation_op InferShape)
+    radius = max_disp // stride2
+    disps = [i * stride2 for i in range(-radius, radius + 1)]
+    outs = []
+    hp, wp = h + 2 * pad, w + 2 * pad
+    oh = -(-(hp - 2 * max_disp) // stride1)  # ceil div (reference)
+    ow = -(-(wp - 2 * max_disp) // stride1)
+    base_y = max_disp + stride1 * jnp.arange(oh)
+    base_x = max_disp + stride1 * jnp.arange(ow)
+    a = x1p[:, :, base_y[:, None], base_x[None, :]]
+    for dy in disps:
+        for dx in disps:
+            bpatch = x2p[:, :, (base_y + dy)[:, None], (base_x + dx)[None, :]]
+            outs.append(jnp.mean(a * bpatch, axis=1))
+    ctx.set_out(op, "Output", jnp.stack(outs, axis=1))

@@ -1,0 +1,86 @@
+"""Sampling ops: NCE, sample_logits, correlation — structural + oracle
+checks (sampling is stochastic; correlations are exact).
+"""
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu.framework.program import Program, program_guard
+
+
+def _run(op_type, feed_specs, outputs, attrs):
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        blk = main.global_block
+        ins = {}
+        feed = {}
+        for slot, name, arr in feed_specs:
+            blk.create_var(name=name, shape=arr.shape,
+                           dtype=str(arr.dtype), stop_gradient=True)
+            ins.setdefault(slot, []).append(name)
+            feed[name] = arr
+        outs = {}
+        for slot, name in outputs:
+            blk.create_var(name=name, dtype="float32")
+            outs.setdefault(slot, []).append(name)
+        blk.append_op(op_type, ins, outs, attrs)
+    exe = pt.Executor(pt.CPUPlace())
+    sc = pt.framework.Scope()
+    exe.run(startup, scope=sc)
+    main.random_seed = 5
+    got = exe.run(main, feed=feed,
+                  fetch_list=[n for _, n in outputs], scope=sc)
+    return [np.asarray(g) for g in got]
+
+
+def test_correlation_zero_displacement_is_channel_mean_product():
+    rs = np.random.RandomState(0)
+    x1 = rs.randn(1, 4, 5, 5).astype("f4")
+    x2 = rs.randn(1, 4, 5, 5).astype("f4")
+    (out,) = _run(
+        "correlation",
+        [("Input1", "x1", x1), ("Input2", "x2", x2)],
+        [("Output", "out")],
+        {"pad_size": 1, "kernel_size": 1, "max_displacement": 1,
+         "stride1": 1, "stride2": 1})
+    assert out.shape == (1, 9, 5, 5)
+    # center displacement (dy=0, dx=0) is index 4 of the 3x3 grid
+    want = (x1 * x2).mean(axis=1)
+    # padded border rows include zero-padding; compare interior
+    np.testing.assert_allclose(out[0, 4, 1:-1, 1:-1], want[0, 1:-1, 1:-1],
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_nce_cost_finite_and_shaped():
+    rs = np.random.RandomState(1)
+    B, D, C, T, K = 4, 6, 20, 1, 5
+    x = rs.randn(B, D).astype("f4")
+    lbl = rs.randint(0, C, (B, T)).astype("i8")
+    w = rs.randn(C, D).astype("f4") * 0.1
+    b = np.zeros(C, "f4")
+    cost, slog = _run(
+        "nce",
+        [("Input", "x", x), ("Label", "lbl", lbl), ("Weight", "w", w),
+         ("Bias", "b", b)],
+        [("Cost", "cost"), ("SampleLogits", "slog")],
+        {"num_total_classes": C, "num_neg_samples": K, "sampler": 0})
+    assert cost.shape == (B, 1) and np.isfinite(cost).all()
+    assert (cost > 0).all()  # NCE loss is positive
+    assert slog.shape == (B, T + K)
+
+
+def test_sample_logits_gathers_true_label_first():
+    rs = np.random.RandomState(2)
+    B, C, K = 3, 10, 4
+    logits = rs.randn(B, C).astype("f4")
+    lbl = rs.randint(0, C, (B, 1)).astype("i8")
+    sampled, samples = _run(
+        "sample_logits",
+        [("Logits", "lg", logits), ("Labels", "lb", lbl)],
+        [("SampledLogits", "sl"), ("Samples", "sm")],
+        {"num_samples": K, "sampler": 0,
+         "remove_accidental_hits": False})
+    assert sampled.shape == (B, 1 + K)
+    # first column = true-label logit + log C (uniform logQ correction)
+    want = logits[np.arange(B), lbl[:, 0]] + np.log(C)
+    np.testing.assert_allclose(sampled[:, 0], want, rtol=1e-5)
+    np.testing.assert_array_equal(samples[:, 0], lbl[:, 0])
