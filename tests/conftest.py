@@ -5,6 +5,12 @@ tests run on the jax CPU backend with 8 virtual devices so multi-chip
 sharding is exercised without TPU hardware.  Must run before jax imports.
 """
 import os
+import sys
+
+# make the repo importable regardless of pytest's invocation cwd
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
 
 os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
