@@ -1,6 +1,7 @@
 """`paddle.vision` equivalent (reference python/paddle/vision/)."""
 from . import datasets, transforms  # noqa: F401
 from . import models  # noqa: F401
+from . import ops  # noqa: F401
 from .datasets import Cifar10, DatasetFolder, FakeData, ImageFolder, MNIST  # noqa: F401
 from .models import (  # noqa: F401
     LeNet,

@@ -1,0 +1,51 @@
+"""paddle.vision.ops functional namespace (reference
+python/paddle/vision/ops.py) — dygraph + gradient flow.
+"""
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu.dygraph.tensor import Tensor
+from paddle_tpu.vision import ops as vops
+
+
+def test_deform_conv2d_zero_offset_matches_functional_conv():
+    rs = np.random.RandomState(0)
+    x = Tensor(rs.randn(2, 4, 6, 6).astype("f4"), stop_gradient=False)
+    w = Tensor(rs.randn(3, 4, 3, 3).astype("f4"), stop_gradient=False)
+    off = Tensor(np.zeros((2, 18, 6, 6), "f4"))
+    got = vops.deform_conv2d(x, off, w, padding=1)
+
+    import paddle_tpu.nn.functional as F
+
+    want = F.conv2d(x, w, padding=1)
+    np.testing.assert_allclose(np.asarray(got.numpy()),
+                               np.asarray(want.numpy()),
+                               rtol=1e-4, atol=1e-5)
+    loss = pt.tensor.math.sum(got * got)
+    loss.backward()
+    assert w.grad is not None
+    assert np.isfinite(np.asarray(w.grad.numpy())).all()
+
+
+def test_roi_align_and_pool_shapes():
+    rs = np.random.RandomState(1)
+    x = Tensor(rs.randn(1, 3, 8, 8).astype("f4"))
+    rois = Tensor(np.array([[0., 0., 8., 8.], [2., 2., 6., 6.]], "f4"))
+    ra = vops.roi_align(x, rois, output_size=2, aligned=False)
+    assert ra.shape == [2, 3, 2, 2]
+    rp = vops.roi_pool(x, rois, output_size=2)
+    assert rp.shape == [2, 3, 2, 2]
+
+
+def test_yolo_box_decodes():
+    rs = np.random.RandomState(2)
+    x = Tensor(rs.randn(1, 2 * 8, 4, 4).astype("f4"))
+    img = Tensor(np.array([[32, 32]], "i4"))
+    boxes, scores = vops.yolo_box(x, img, anchors=[10, 13, 16, 30],
+                                  class_num=3, conf_thresh=0.0,
+                                  downsample_ratio=8)
+    b = np.asarray(boxes.numpy())
+    assert b.shape == (1, 32, 4)
+    assert (b[..., 2] >= b[..., 0]).all() and (b[..., 3] >= b[..., 1]).all()
+    s = np.asarray(scores.numpy())
+    assert ((s >= 0) & (s <= 1)).all()
