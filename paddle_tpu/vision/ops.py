@@ -44,8 +44,19 @@ def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
     return out
 
 
+def _require_boxes_num(x, boxes_num, name):
+    # the op-level fallback maps every roi to image 0 (fine for N==1);
+    # for batched inputs that silent default would pool from the wrong
+    # image — the reference requires boxes_num in dygraph, so do we
+    if boxes_num is None and int(x.shape[0]) > 1:
+        raise ValueError(
+            f"{name} with a batched input (N={int(x.shape[0])}) requires "
+            f"boxes_num to assign each roi to its image")
+
+
 def roi_align(x, boxes, boxes_num=None, output_size=1, spatial_scale=1.0,
               sampling_ratio=-1, aligned=True, name=None):
+    _require_boxes_num(x, boxes_num, "roi_align")
     if isinstance(output_size, int):
         output_size = (output_size, output_size)
     inputs = {"X": x, "ROIs": boxes}
@@ -62,6 +73,7 @@ def roi_align(x, boxes, boxes_num=None, output_size=1, spatial_scale=1.0,
 
 def roi_pool(x, boxes, boxes_num=None, output_size=1, spatial_scale=1.0,
              name=None):
+    _require_boxes_num(x, boxes_num, "roi_pool")
     if isinstance(output_size, int):
         output_size = (output_size, output_size)
     inputs = {"X": x, "ROIs": boxes}
