@@ -153,6 +153,14 @@ class HDFSClient(FS):
         self._run("-rm", "-r", fs_path)
 
     def mv(self, src, dst, overwrite=False):
+        if self.is_exist(dst):
+            if not overwrite:
+                raise ExecuteError(
+                    f"hdfs mv: destination {dst!r} exists and "
+                    f"overwrite=False")
+            # hadoop fs -mv refuses to clobber; reference HDFSClient
+            # deletes dst first when overwrite=True
+            self.delete(dst)
         self._run("-mv", src, dst)
 
     def upload(self, local_path, fs_path):

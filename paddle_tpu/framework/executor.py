@@ -91,8 +91,10 @@ class _Compiled:
     # global jax.Arrays over the mesh before the executable call
     globalize: object = None
     # FLAGS_check_nan_inf: (op type, build site) per scanned op, parallel
-    # to the extra NAN_FLAGS fetch
+    # to the extra NAN_FLAGS fetch; nan_scan records that the sentinel
+    # fetch was appended even when the op list is empty
     nan_ops: Tuple = ()
+    nan_scan: bool = False
     n_calls: int = 0
 
 
@@ -392,16 +394,18 @@ class Executor:
             scope.set_var(n, v)
         if entry.uses_rng:
             scope.set_var(RNG_VAR, new_rng)
-        if entry.nan_ops:
+        if entry.nan_scan:
             flags = np.asarray(fetches[-1]).astype(bool)
             fetches = fetches[:-1]
-            ok = flags.reshape(-1, len(entry.nan_ops)).all(axis=0)
-            if not ok.all():
-                i = int(np.argmin(ok))
-                op_type, site = entry.nan_ops[i]
-                raise RuntimeError(
-                    f"FLAGS_check_nan_inf: op {op_type!r} (built at {site}) "
-                    f"produced NaN/Inf (op #{i} of the compiled block)")
+            if entry.nan_ops:
+                ok = flags.reshape(-1, len(entry.nan_ops)).all(axis=0)
+                if not ok.all():
+                    i = int(np.argmin(ok))
+                    op_type, site = entry.nan_ops[i]
+                    raise RuntimeError(
+                        f"FLAGS_check_nan_inf: op {op_type!r} (built at "
+                        f"{site}) produced NaN/Inf (op #{i} of the compiled "
+                        f"block)")
         return fetches
 
     # ------------------------------------------------------------------
@@ -624,6 +628,7 @@ class Executor:
             nan_ops=tuple(
                 (op.type, op.callstack[-1] if op.callstack else "?")
                 for op in op_list) if nan_scan else (),
+            nan_scan=nan_scan,
         )
         return compiled
 

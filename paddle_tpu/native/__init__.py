@@ -64,6 +64,10 @@ def _parse_multislot_py(data: bytes, slot_types: str):
         i = 0
         for s, t in enumerate(slot_types):
             try:
+                # match strtoll + boundary-check semantics: plain digits
+                # only (no python underscore literals)
+                if b"_" in toks[i]:
+                    raise ValueError
                 cnt = int(toks[i])
             except (IndexError, ValueError):
                 raise ValueError(f"bad slot count at line {n}")
@@ -82,10 +86,15 @@ def _parse_multislot_py(data: bytes, slot_types: str):
                     else:
                         # match strtoull semantics: plain digits only
                         # (no python underscore literals), negatives wrap
-                        # into uint64 like the C path
+                        # into uint64 like the C path; out-of-range
+                        # magnitudes are rejected in BOTH paths (the C
+                        # side checks ERANGE)
                         if not x.lstrip(b"-+").isdigit():
                             raise ValueError
-                        vals[s].append(int(x) & 0xFFFFFFFFFFFFFFFF)
+                        iv = int(x)
+                        if not (-(2 ** 64) < iv < 2 ** 64):
+                            raise ValueError
+                        vals[s].append(iv & 0xFFFFFFFFFFFFFFFF)
             except ValueError:
                 raise ValueError(
                     f"bad {'float' if t == 'f' else 'id'} value at line {n}")

@@ -14,6 +14,7 @@
 #define PY_SSIZE_T_CLEAN
 #include <Python.h>
 
+#include <cerrno>
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
@@ -28,6 +29,19 @@ struct SlotBuf {
   std::vector<uint64_t> uvals;
   std::vector<int64_t> lod;      // cumulative offsets, starts at 0
 };
+
+// The python fallback tokenizes on whitespace, so a numeric token must
+// be consumed in full; strtox stopping mid-token ("3.5" as count) is a
+// parse error, not a value.
+inline bool is_tok_ws(char c) {
+  // every separator python bytes.split() honors (minus '\n', the line
+  // delimiter handled above this level)
+  return c == ' ' || c == '\t' || c == '\r' || c == '\v' || c == '\f';
+}
+
+inline bool at_token_boundary(const char* c) {
+  return *c == '\0' || is_tok_ws(*c);
+}
 
 // Parse one buffer of '\n'-separated lines into per-slot value/lod
 // buffers.  Returns false + sets err on malformed input.
@@ -50,32 +64,54 @@ bool parse_buffer(const char* data, Py_ssize_t len,
     // skip blank lines, including CRLF/whitespace-only ones (parity with
     // the python fallback's token-split semantics)
     const char* first = p;
-    while (first < line_end &&
-           (*first == ' ' || *first == '\t' || *first == '\r'))
-      ++first;
+    while (first < line_end && is_tok_ws(*first)) ++first;
     if (first < line_end) {
+      // an embedded NUL would silently truncate the NUL-terminated
+      // scratch copy; the python fallback errors on such tokens — reject
+      if (memchr(p, '\0', static_cast<size_t>(line_end - p)) != nullptr) {
+        err = "bad value (embedded NUL) at line " + std::to_string(n_lines);
+        return false;
+      }
       line.assign(p, static_cast<size_t>(line_end - p));
       const char* q = line.c_str();
       for (auto& slot : slots) {
-        // parse count
+        // parse count.  strtoll alone would accept partial tokens
+        // ("3.5" -> 3) the python fallback rejects, so every numeric
+        // token must end at whitespace/NUL (token-boundary parity).
         char* next = nullptr;
         long long cnt = strtoll(q, &next, 10);
-        if (next == q || cnt < 0) {
+        if (next == q || cnt < 0 || !at_token_boundary(next)) {
           err = "bad slot count at line " + std::to_string(n_lines);
           return false;
         }
         q = next;
         for (long long i = 0; i < cnt; ++i) {
           if (slot.type == 'f') {
+            // python float() rejects C99 hex-float literals strtof
+            // accepts; keep the two paths agreeing on what is malformed
+            const char* t = q;
+            while (is_tok_ws(*t)) ++t;
+            if (*t == '+' || *t == '-') ++t;
+            if (t[0] == '0' && (t[1] == 'x' || t[1] == 'X')) {
+              err = "bad float value at line " + std::to_string(n_lines);
+              return false;
+            }
             float v = strtof(q, &next);
-            if (next == q) {
+            if (next == q || !at_token_boundary(next) ||
+                memchr(q, '(', static_cast<size_t>(next - q)) != nullptr) {
+              // '(' only appears in C99 NAN(n-char-seq), which python
+              // float() rejects
               err = "bad float value at line " + std::to_string(n_lines);
               return false;
             }
             slot.fvals.push_back(v);
           } else {
+            // out-of-range ids saturate in strtoull but wrap in python's
+            // int & mask — reject in both paths instead (errno check
+            // here, magnitude check in the fallback)
+            errno = 0;
             unsigned long long v = strtoull(q, &next, 10);
-            if (next == q) {
+            if (next == q || !at_token_boundary(next) || errno == ERANGE) {
               err = "bad id value at line " + std::to_string(n_lines);
               return false;
             }
@@ -89,7 +125,7 @@ bool parse_buffer(const char* data, Py_ssize_t len,
       }
       // trailing tokens mean the line held more data than the slot
       // spec describes — reject, don't silently drop
-      while (*q == ' ' || *q == '\t' || *q == '\r') ++q;
+      while (is_tok_ws(*q)) ++q;
       if (*q != '\0') {
         err = "trailing tokens at line " + std::to_string(n_lines);
         return false;

@@ -118,9 +118,10 @@ def _smooth_l1_loss(ctx, op):
     if out_w is not None:
         loss = loss * out_w
     ctx.set_out(op, "Diff", d)
-    ctx.set_out(op, "Out", jnp.sum(loss, axis=tuple(range(1, loss.ndim)),
-                                   keepdims=loss.ndim > 1)
-                if loss.ndim > 1 else loss)
+    # reference smooth_l1_loss_op always emits Out of shape [N, 1]
+    out = (jnp.sum(loss, axis=tuple(range(1, loss.ndim))).reshape(-1, 1)
+           if loss.ndim > 1 else loss)
+    ctx.set_out(op, "Out", out)
 
 
 @register_lower("sigmoid_focal_loss")

@@ -139,7 +139,12 @@ def _gru_unit(ctx, op):
     gu = x[:, :2 * hid] + h_prev @ w[:, :2 * hid]
     u, r = jnp.split(jax.nn.sigmoid(gu), 2, axis=-1)
     c = jnp.tanh(x[:, 2 * hid:] + (r * h_prev) @ w[:, 2 * hid:])
-    h = u * h_prev + (1.0 - u) * c
+    # gru_unit_op.h: origin_mode=True -> u*h_prev + (1-u)*c; the default
+    # (False) is u*c + (1-u)*h_prev (gru_kernel.h gru_finalOutput).
+    if bool(op.attr("origin_mode", False)):
+        h = u * h_prev + (1.0 - u) * c
+    else:
+        h = u * c + (1.0 - u) * h_prev
     ctx.set_out(op, "Gate", jnp.concatenate([u, r, c], axis=-1))
     ctx.set_out(op, "ResetHiddenPrev", r * h_prev)
     ctx.set_out(op, "Hidden", h)
@@ -180,6 +185,7 @@ def _gru(ctx, op):
     gate_act = _act(op.attr("gate_activation", "sigmoid"))
     cand_act = _act(op.attr("activation", "tanh"))
     reverse = bool(op.attr("is_reverse", False))
+    origin_mode = bool(op.attr("origin_mode", False))
     if bias is not None:
         x = x + bias.reshape((-1,))
     if reverse:
@@ -190,7 +196,10 @@ def _gru(ctx, op):
         gu = gate_act(xg[:2 * hid] + h @ w[:, :2 * hid])
         u, r = gu[:hid], gu[hid:]
         c = cand_act(xg[2 * hid:] + (r * h) @ w[:, 2 * hid:])
-        h2 = u * h + (1.0 - u) * c
+        if origin_mode:
+            h2 = u * h + (1.0 - u) * c
+        else:
+            h2 = u * c + (1.0 - u) * h
         return h2, (h2, r * h, gu)
 
     hT, (hidden, reset_h, gates) = jax.lax.scan(step, h_init, x)

@@ -45,6 +45,25 @@ def test_check_nan_inf_names_the_bad_op():
         pt.set_flags({"FLAGS_check_nan_inf": False})
 
 
+def test_check_nan_inf_all_pseudo_program():
+    """nan-scan on a program whose compiled op list is empty (feed/fetch
+    only) must not leak the sentinel fetch to callers."""
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = layers.data("x", [3])
+    exe = pt.Executor(pt.CPUPlace())
+    scope = pt.framework.Scope()
+    exe.run(startup, scope=scope)
+    pt.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        out = exe.run(main, feed={"x": np.ones((2, 3), "f4")},
+                      fetch_list=[x], scope=scope)
+        assert len(out) == 1
+        np.testing.assert_array_equal(np.asarray(out[0]), np.ones((2, 3)))
+    finally:
+        pt.set_flags({"FLAGS_check_nan_inf": False})
+
+
 def test_use_prune_skips_optimizer_ops():
     """Eval fetch on a training program must not advance params/optimizer
     state when use_prune=True (reference Executor.run(use_prune))."""

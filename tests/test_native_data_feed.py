@@ -55,9 +55,29 @@ def test_malformed_input_raises():
     # a short line must NOT steal tokens from the next line
     with pytest.raises(ValueError):
         native.parse_multislot(b"1 5\n1 6 1 7\n", "uu")
+    # partial-token consumption: "3.5" must not parse as count 3
+    with pytest.raises(ValueError):
+        native.parse_multislot(b"3.5 1 2 3\n", "u")
+    with pytest.raises(ValueError):
+        native.parse_multislot(b"1 2.5\n", "u")  # float token in id slot
+    # hex floats, uint64 overflow: rejected by BOTH paths (strtof/strtoull
+    # would accept/saturate where python errors — parity means both error)
+    for fn in (native.parse_multislot, native._parse_multislot_py):
+        with pytest.raises(ValueError):
+            fn(b"1 0x10\n", "f")
+        with pytest.raises(ValueError):
+            fn(b"1 18446744073709551616\n", "u")
+    # negative ids wrap into uint64 identically in both paths
+    for fn in (native.parse_multislot, native._parse_multislot_py):
+        _, out = fn(b"1 -5\n", "u")
+        assert int(out[0][0][0]) == 2 ** 64 - 5
     # python fallback raises identically
     with pytest.raises(ValueError, match="line"):
         native._parse_multislot_py(b"2 1\n", "u")
+    with pytest.raises(ValueError):
+        native._parse_multislot_py(b"3.5 1 2 3\n", "u")
+    with pytest.raises(ValueError):
+        native._parse_multislot_py(b"1 2.5\n", "u")
     with pytest.raises(ValueError, match="trailing"):
         native._parse_multislot_py(b"1 5 9\n", "u")
     with pytest.raises(ValueError):

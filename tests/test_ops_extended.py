@@ -176,6 +176,105 @@ class TestSmoothL1(OpTest):
         self.check_output()
 
 
+class TestSmoothL1HighRank(OpTest):
+    """4-D input still yields Out of shape [N, 1] (smooth_l1_loss_op.cc)."""
+    op_type = "smooth_l1_loss"
+
+    def setup(self):
+        rs = np.random.RandomState(15)
+        x = rs.randn(2, 3, 4, 5).astype("f4")
+        y = rs.randn(2, 3, 4, 5).astype("f4")
+        d = x - y
+        ad = np.abs(d)
+        loss = np.where(ad < 1.0, 0.5 * d * d, ad - 0.5)
+        out = loss.reshape(2, -1).sum(1, keepdims=True)
+        self.inputs = {"X": [("x", x)], "Y": [("y", y)]}
+        self.attrs = {"sigma": 1.0}
+        self.outputs = {"Out": [("out", out)], "Diff": [("diff", d)]}
+
+    def test_output(self):
+        self.check_output()
+
+
+def _gru_unit_numpy(x, h_prev, w, bias, origin_mode):
+    hid = h_prev.shape[-1]
+    if bias is not None:
+        x = x + bias.reshape(-1)
+    gu = _sigmoid(x[:, :2 * hid] + h_prev @ w[:, :2 * hid])
+    u, r = gu[:, :hid], gu[:, hid:]
+    c = np.tanh(x[:, 2 * hid:] + (r * h_prev) @ w[:, 2 * hid:])
+    if origin_mode:
+        h = u * h_prev + (1.0 - u) * c
+    else:
+        h = u * c + (1.0 - u) * h_prev
+    return gu, r * h_prev, c, h
+
+
+class TestGruUnitDefault(OpTest):
+    """origin_mode default False: h = u*c + (1-u)*h_prev
+    (gru_kernel.h gru_finalOutput)."""
+    op_type = "gru_unit"
+    origin_mode = False
+
+    def setup(self):
+        B, H = 3, 4
+        rs = np.random.RandomState(21)
+        x = rs.randn(B, 3 * H).astype("f4")
+        h_prev = rs.randn(B, H).astype("f4")
+        w = rs.randn(H, 3 * H).astype("f4") * 0.5
+        bias = rs.randn(1, 3 * H).astype("f4") * 0.1
+        gu, rh, c, h = _gru_unit_numpy(x, h_prev, w, bias, self.origin_mode)
+        self.inputs = {"Input": [("x", x)], "HiddenPrev": [("hp", h_prev)],
+                       "Weight": [("w", w)], "Bias": [("b", bias)]}
+        self.attrs = {"origin_mode": self.origin_mode}
+        gate = np.concatenate([gu, c], axis=-1)
+        self.outputs = {"Gate": [("gate", gate)],
+                        "ResetHiddenPrev": [("rh", rh)],
+                        "Hidden": [("h", h)]}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+
+class TestGruUnitOriginMode(TestGruUnitDefault):
+    """origin_mode=True: h = u*h_prev + (1-u)*c (gru_unit_op.h)."""
+    origin_mode = True
+
+
+class TestGruOpDefault(OpTest):
+    """Fluid gru op, origin_mode default False."""
+    op_type = "gru"
+    origin_mode = False
+
+    def setup(self):
+        T, H = 5, 3
+        rs = np.random.RandomState(22)
+        x = rs.randn(T, 3 * H).astype("f4")
+        w = rs.randn(H, 3 * H).astype("f4") * 0.5
+        h = np.zeros(H, "f4")
+        hidden = []
+        for t in range(T):
+            gu = _sigmoid(x[t, :2 * H] + h @ w[:, :2 * H])
+            u, r = gu[:H], gu[H:]
+            c = np.tanh(x[t, 2 * H:] + (r * h) @ w[:, 2 * H:])
+            if self.origin_mode:
+                h = u * h + (1.0 - u) * c
+            else:
+                h = u * c + (1.0 - u) * h
+            hidden.append(h)
+        self.inputs = {"Input": [("x", x)], "Weight": [("w", w)]}
+        self.attrs = {"origin_mode": self.origin_mode}
+        self.outputs = {"Hidden": [("hid", np.stack(hidden))]}
+
+    def test_output(self):
+        self.check_output(no_check_set=["BatchGate", "BatchResetHiddenPrev",
+                                        "BatchHidden"], atol=1e-5)
+
+
+class TestGruOpOriginMode(TestGruOpDefault):
+    origin_mode = True
+
+
 class TestNllLoss(OpTest):
     op_type = "nll_loss"
 
