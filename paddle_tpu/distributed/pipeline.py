@@ -13,27 +13,45 @@ over the 'pp' mesh axis.  Every rank runs the same code; `lax.switch` on
 activations (forward) and their cotangents (backward) between neighbor
 ranks, and each stage's backward is `jax.vjp` of its traced forward.
 GPipe flush schedule: K micro-batch forwards fill the pipe, then K
-backwards drain it; per-stage gradients are psum'd over the axis and feed
-the program's own optimizer ops, so parameters stay replicated and every
-rank applies the identical update.
+backwards drain it.
 
-v2 capabilities (v1's restrictions lifted):
-- dropout/RNG inside stages: the key is fold_in(program_key, stage,
-  microbatch), so the backward vjp replay regenerates identical masks;
-- state written in staged forwards (batch_norm running stats) is carried
-  tick-to-tick on the owning rank and published from it at the end;
-- boundaries may pass MULTIPLE float tensors with non-uniform shapes:
-  each boundary packs into one flat carrier buffer padded to the widest
-  boundary (rank-uniform, ppermute-able), unpacked by the next stage;
-- dp x pp meshes: feeds shard over 'dp', the schedule runs per dp
-  shard, grads psum over both axes.
+v3 — per-stage state sharding (the point of PP — memory):
+- parameters AND optimizer slots are packed, stage by stage, into ONE
+  (n_stages, width) float32 buffer physically sharded over 'pp'
+  (`PartitionSpec('pp')` on dim 0), so each rank holds only its own
+  stage's ~1/S of the training state.  Inside the shard_map every rank
+  sees its LOCAL (width,) row; the `lax.switch` branch for stage s
+  reinterprets that row with stage s's layout — on rank r branch r is
+  the one selected, so the bytes always match the layout.
+- the backward takes `jax.vjp` directly w.r.t. the packed row, so
+  per-stage parameter gradients come back packed in the same layout and
+  never leave the owning rank (no pp psum for param grads; dp still
+  psums).
+- optimizer ops are partitioned per stage and run inside a second
+  `lax.switch`; each rank updates only its own stage's slice in place.
+  Shared optimizer ops (lr schedules, counters) run replicated.
+- the scope keeps lightweight `PackedParamRef` views of every owned var
+  (framework/scope.py) so save/checkpoint/inspection still read true
+  values and `paddle.load` writes trigger a re-pack.
+- fetches are no longer loss-only: any forward activation can be
+  fetched (per-microbatch values are collected on the owning stage's
+  rank, psum-broadcast, and re-assembled over micro-batches and dp).
 
-Remaining restrictions (loud errors): loss-only fetches; boundary
-tensors must be floating point.
+v2 capabilities retained: dropout-safe per-(stage, microbatch) RNG,
+carried batch-norm stats, multi-tensor/ragged/skip boundaries via the
+packed activation carrier, dp x pp meshes.
+
+Remaining restrictions (loud errors): float32 training state; boundary
+tensors must be floating point; no cross-stage optimizer reductions
+(global grad clip); shared (multi-stage) parameters.
 """
 from __future__ import annotations
 
 from typing import Dict, List
+
+import numpy as np
+
+PACKED_STATE_VAR = "@PP_PACKED_STATE@"
 
 
 def analyze_stages(program, n_stages: int):
@@ -90,13 +108,234 @@ def analyze_stages(program, n_stages: int):
     return stage_ops, boundaries
 
 
+class PackPlan:
+    """Stage-ownership of training state + its packed layout.
+
+    Ownership (which var lives on which stage, how optimizer ops
+    partition) is computed at compile time from the program alone;
+    the byte layout (offsets/width) is filled in lazily on the first
+    `ensure_packed` call, when the scope has concrete shapes.
+    """
+
+    def __init__(self, n_stages, owned_stage, params_by_stage,
+                 stage_opt_ops, shared_opt_ops, stage_ops, boundaries):
+        self.n_stages = n_stages
+        self.owned_stage: Dict[str, int] = owned_stage
+        self.owned_names = frozenset(owned_stage)
+        self.params_by_stage = params_by_stage
+        self.stage_opt_ops = stage_opt_ops
+        self.shared_opt_ops = shared_opt_ops
+        # the forward stage partition the plan was derived from, so the
+        # compiled fn uses the identical view instead of re-deriving one
+        self.stage_ops = stage_ops
+        self.boundaries = boundaries
+        # filled by _build_layout on first ensure_packed
+        self.entries = None  # per stage: [(name, off, size, shape), ...]
+        self.layout = None   # name -> (stage, off, size, shape)
+        self.width = None
+
+    # -- layout --------------------------------------------------------
+    def _build_layout(self, shapes: Dict[str, tuple]):
+        entries = [[] for _ in range(self.n_stages)]
+        layout = {}
+        cursor = [0] * self.n_stages
+        for n in sorted(self.owned_stage):
+            s = self.owned_stage[n]
+            shape = shapes[n]
+            size = 1
+            for d in shape:
+                size *= int(d)
+            off = cursor[s]
+            cursor[s] += size
+            entries[s].append((n, off, size, shape))
+            layout[n] = (s, off, size, shape)
+        self.entries = entries
+        self.layout = layout
+        self.width = max(cursor) if max(cursor) > 0 else 1
+
+    # -- host-side pack ------------------------------------------------
+    def ensure_packed(self, scope, mesh):
+        """Pack owned scope vars into the sharded (S, W) buffer.
+
+        No-op when the scope already holds the packed buffer and every
+        owned var is a PackedParamRef view.  A concrete array over an
+        owned name (fresh startup run, paddle.load restore) triggers a
+        re-pack of those entries.
+        """
+        import jax
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        from ..framework.scope import PackedParamRef
+
+        concrete = {}
+        for n in self.owned_stage:
+            if not scope.has_var(n):
+                raise RuntimeError(
+                    f"pipeline state var {n!r} is not in the scope; run "
+                    f"the startup program first")
+            v = scope.get_var(n)
+            if not isinstance(v, PackedParamRef):
+                concrete[n] = np.asarray(v)
+        has_buf = scope.has_var(PACKED_STATE_VAR)
+
+        if self.layout is None:
+            # shapes come from concrete arrays or from the ref views a
+            # sibling plan (different fetch list, same program) installed
+            shapes = {}
+            for n in self.owned_stage:
+                v = scope.get_var(n)
+                dt = np.dtype(v.dtype)
+                if dt != np.float32:
+                    raise NotImplementedError(
+                        f"pipeline per-stage state sharding requires "
+                        f"float32 training state; {n!r} is {dt}")
+                shapes[n] = tuple(int(d) for d in v.shape)
+            self._build_layout(shapes)
+        if has_buf:
+            buf_shape = tuple(scope.get_var(PACKED_STATE_VAR).shape)
+            if buf_shape != (self.n_stages, self.width):
+                raise RuntimeError(
+                    f"existing packed pipeline buffer has shape "
+                    f"{buf_shape}, expected "
+                    f"{(self.n_stages, self.width)}; the program's "
+                    f"stage-owned state changed — rebuild the scope")
+        if has_buf and not concrete:
+            return
+
+        S, W = self.n_stages, self.width
+        buf = np.zeros((S, W), np.float32)
+        if has_buf:
+            buf[:] = np.asarray(scope.get_var(PACKED_STATE_VAR))
+        elif len(concrete) != len(self.owned_stage):
+            missing = sorted(self.owned_names - set(concrete))
+            raise RuntimeError(
+                f"pipeline state vars {missing} are packed views but no "
+                f"packed buffer exists in this scope")
+        for n, v in concrete.items():
+            s, off, size, shape = self.layout[n]
+            if tuple(v.shape) != tuple(shape):
+                raise ValueError(
+                    f"pipeline state var {n!r} has shape {v.shape}, "
+                    f"expected {shape}")
+            buf[s, off:off + size] = v.astype(np.float32).ravel()
+        sharding = NamedSharding(mesh, P("pp"))
+        arr = jax.make_array_from_callback(
+            (S, W), sharding, lambda idx: buf[idx])
+        scope.set_var(PACKED_STATE_VAR, arr)
+        for n, (s, off, size, shape) in self.layout.items():
+            scope.set_var(n, PackedParamRef(scope, PACKED_STATE_VAR, s, off,
+                                            shape, np.float32))
+
+
+def plan_packing(program, n_stages, state_in, state_out, pipe):
+    """Compute stage ownership of params + optimizer slots and partition
+    the optimizer ops per stage (compile-time; shapes come later)."""
+    from ..framework.lowering import PSEUDO_OPS
+
+    stage_ops, boundaries = analyze_stages(program, n_stages)
+    block = program.global_block
+    grad_of = {(p if isinstance(p, str) else p.name):
+               (g if isinstance(g, str) else g.name)
+               for p, g in pipe["params_grads"]}
+    grad_names = set(grad_of.values())
+    opt_ops = [op for op in block.ops[pipe["bwd_end"]:]
+               if op.type not in PSEUDO_OPS]
+    state_vars = set(state_in) | set(state_out)
+
+    # each parameter is owned by the single stage whose forward reads it
+    param_stage: Dict[str, int] = {}
+    for s, ops in enumerate(stage_ops):
+        reads = {n for op in ops for n in op.input_arg_names()}
+        for p in grad_of:
+            if p in reads:
+                if p in param_stage and param_stage[p] != s:
+                    raise NotImplementedError(
+                        f"parameter {p!r} is read by pipeline stages "
+                        f"{param_stage[p]} and {s}; shared (tied) "
+                        f"parameters are not supported by the pipeline "
+                        f"executor")
+                param_stage.setdefault(p, s)
+    unread = sorted(set(grad_of) - set(param_stage))
+    if unread:
+        raise ValueError(
+            f"parameters {unread} are not read by any pipeline stage")
+
+    # optimizer slots inherit the stage of the param their op updates;
+    # fixpoint so slot-only ops (chained accumulators) resolve too
+    owned_stage: Dict[str, int] = dict(param_stage)
+    op_stage: Dict[int, int] = {}  # opt-op index -> stage
+    pending = list(enumerate(opt_ops))
+    while True:
+        progressed = False
+        still = []
+        for idx, op in pending:
+            names = set(op.input_arg_names()) | set(op.output_arg_names())
+            stages = {owned_stage[n] for n in names if n in owned_stage}
+            if len(stages) > 1:
+                raise NotImplementedError(
+                    f"optimizer op {op.type!r} touches state owned by "
+                    f"stages {sorted(stages)}; cross-stage optimizer ops "
+                    f"(e.g. global grad clipping) are not supported under "
+                    f"pipeline state sharding")
+            if stages:
+                s = stages.pop()
+                op_stage[idx] = s
+                for n in op.output_arg_names():
+                    if n in state_vars and n not in grad_names:
+                        owned_stage[n] = s
+                progressed = True
+            else:
+                still.append((idx, op))
+        pending = still
+        if not progressed or not pending:
+            break
+    # preserve PROGRAM ORDER inside each stage: ops resolved in a later
+    # fixpoint round must not execute after ops they precede
+    stage_opt_ops: List[list] = [
+        [opt_ops[i] for i in sorted(op_stage) if op_stage[i] == s]
+        for s in range(n_stages)]
+    shared_opt_ops = [op for _, op in pending]
+
+    # shared ops must be computable replicated: no stage-owned state, no
+    # per-stage gradients, no temporaries produced by per-stage opt ops
+    stage_temps = {n for ops in stage_opt_ops for op in ops
+                   for n in op.output_arg_names()}
+    for op in shared_opt_ops:
+        ins = set(op.input_arg_names())
+        bad = sorted(ins & (set(owned_stage) | grad_names | stage_temps))
+        if bad:
+            raise NotImplementedError(
+                f"optimizer op {op.type!r} reads {bad} which live on "
+                f"individual pipeline stages; global reductions over "
+                f"stage-sharded state/gradients are not supported")
+
+    # forward may read owned NON-param state only via the carried-state
+    # path, never from the packed buffer
+    fwd_reads = {n for ops in stage_ops for op in ops
+                 for n in op.input_arg_names()}
+    bad = sorted(fwd_reads & (set(owned_stage) - set(param_stage)))
+    if bad:
+        raise NotImplementedError(
+            f"forward ops read optimizer-slot state {bad} which is "
+            f"sharded per stage")
+
+    params_by_stage = [[p for p in sorted(grad_of) if param_stage[p] == s]
+                       for s in range(n_stages)]
+    return PackPlan(n_stages, owned_stage, params_by_stage, stage_opt_ops,
+                    shared_opt_ops, stage_ops, boundaries)
+
+
 def build_pipeline_fn(program, mesh, feed_names, state_mut, state_const,
                       state_out, fetch_names, loss_name, params_grads,
-                      n_microbatches, bwd_end):
+                      n_microbatches, bwd_end, plan):
     """The compiled GPipe train step (plugs into Executor._compile).
 
-    Signature matches the standard sharded path:
-    (feed_vals, mut_vals, const_vals, rng) -> (fetches, new_state, rng).
+    `state_mut` / `state_out` arrive WITH `PACKED_STATE_VAR` as their
+    first entry and the stage-owned names already removed (the executor
+    rewrites them via the PackPlan).  Signature matches the standard
+    sharded path: (feed_vals, mut_vals, const_vals, rng) ->
+    (fetches, new_state, rng).
     """
     import jax
     import jax.numpy as jnp
@@ -116,28 +355,41 @@ def build_pipeline_fn(program, mesh, feed_names, state_mut, state_const,
     dp_size = int(mesh.shape[dp_axis]) if dp_axis else 1
     S = int(mesh.shape[pp_axis])
     K = int(n_microbatches)
-    stage_ops, boundaries = analyze_stages(program, S)
+    stage_ops, boundaries = plan.stage_ops, plan.boundaries
     block = program.global_block
-    if set(fetch_names) - {loss_name}:
-        raise NotImplementedError(
-            f"pipeline executor fetches the loss only; got {fetch_names}")
+    assert state_mut and state_mut[0] == PACKED_STATE_VAR
+    assert state_out and state_out[0] == PACKED_STATE_VAR
+    rest_mut = state_mut[1:]
+    rest_out = state_out[1:]
 
     grad_of = {(p if isinstance(p, str) else p.name):
                (g if isinstance(g, str) else g.name)
                for p, g in params_grads}
-    opt_ops = [op for op in block.ops[bwd_end:]
-               if op.type not in PSEUDO_OPS]
+
+    # fetches: the loss plus any forward-produced activation
+    producer_stage: Dict[str, int] = {}
+    for s, ops in enumerate(stage_ops):
+        for op in ops:
+            for n in op.output_arg_names():
+                producer_stage[n] = s  # last producer wins
+    extra_fetches = [f for f in fetch_names if f != loss_name]
+    for f in extra_fetches:
+        if f not in producer_stage:
+            raise NotImplementedError(
+                f"pipeline fetch {f!r} is not produced by any forward "
+                f"stage op; fetchable values are forward activations and "
+                f"the loss")
 
     # state written inside staged forwards (batch_norm running stats):
     # carried tick-to-tick on the owning stage's rank, published at the end
-    state_out_set = set(state_out)
-    param_names = set(grad_of)
-    opt_writes = {n for op in opt_ops for n in op.output_arg_names()}
+    state_out_set = set(rest_out)
+    opt_writes = {n for ops in (plan.shared_opt_ops, *plan.stage_opt_ops)
+                  for op in ops for n in op.output_arg_names()}
     carried_owner: Dict[str, int] = {}
     for s, ops in enumerate(stage_ops):
         for op in ops:
             for n in op.output_arg_names():
-                if n in state_out_set and n not in param_names \
+                if n in state_out_set and n not in plan.owned_names \
                         and n not in opt_writes:
                     carried_owner[n] = s
     carried_names = sorted(carried_owner)
@@ -157,14 +409,18 @@ def build_pipeline_fn(program, mesh, feed_names, state_mut, state_const,
                     f"{site}): {e}") from e
         return env
 
+    def unpack_stage(s, buf):
+        """Reinterpret the local packed row with stage s's layout."""
+        return {n: buf[off:off + size].reshape(shape)
+                for (n, off, size, shape) in plan.entries[s]}
+
     def traced(feed_vals, mut_vals, const_vals, rng):
+        lbuf = mut_vals[0][0]  # local (1, W) shard -> (W,)
         base_env = {}
-        base_env.update(zip(state_mut, mut_vals))
+        base_env.update(zip(rest_mut, mut_vals[1:]))
         base_env.update(zip(state_const, const_vals))
         full_feeds = dict(zip(feed_names, feed_vals))
         r = lax.axis_index(pp_axis)
-
-        params = {pname: base_env[pname] for pname in grad_of}
 
         # micro-batch every feed: (B, ...) -> (K, B//K, ...)
         mb_feeds = {}
@@ -176,32 +432,42 @@ def build_pipeline_fn(program, mesh, feed_names, state_mut, state_const,
                     f"count {K}")
             mb_feeds[n] = v.reshape((K, b // K) + v.shape[1:])
 
-        # ---- probe boundary structures stage by stage -------------------
+        # ---- probe boundary + fetch structures stage by stage -----------
         mb_structs = {n: jax.ShapeDtypeStruct((v.shape[1],) + v.shape[2:],
                                               v.dtype)
                       for n, v in mb_feeds.items()}
+        fetch_by_stage = [[f for f in extra_fetches
+                           if producer_stage[f] == s] for s in range(S)]
 
         def probe_stage(s, in_structs):
             def f(acts_in):
                 env = dict(base_env)
-                env.update(params)
+                for (n, off, size, shape) in plan.entries[s]:
+                    env[n] = jnp.zeros(shape, jnp.float32)
                 for n, sd in mb_structs.items():
                     env[n] = jnp.zeros(sd.shape, sd.dtype)
                 if s > 0:
                     env.update(dict(zip(boundaries[s - 1], acts_in)))
                 trace_ops(stage_ops[s], env,
                           rng_key=jax.random.PRNGKey(0))
-                return tuple(jnp.asarray(env[n]) for n in boundaries[s])
+                bnd = tuple(jnp.asarray(env[n]) for n in boundaries[s]) \
+                    if s < S - 1 else ()
+                fts = tuple(jnp.asarray(env[f]) for f in fetch_by_stage[s])
+                return bnd, fts
 
             dummy = tuple(jnp.zeros(sd.shape, sd.dtype)
                           for sd in (in_structs or ()))
             return jax.eval_shape(f, dummy)
 
         bnd_structs = []  # per boundary: tuple of ShapeDtypeStructs
+        fetch_structs: Dict[str, object] = {}
         prev = None
-        for s in range(S - 1):
-            prev = probe_stage(s, prev)
-            bnd_structs.append(prev)
+        for s in range(S):
+            prev, fstructs = probe_stage(s, prev)
+            if s < S - 1:
+                bnd_structs.append(prev)
+            for f, sd in zip(fetch_by_stage[s], fstructs):
+                fetch_structs[f] = sd
         for structs, names in zip(bnd_structs, boundaries):
             for sd, n in zip(structs, names):
                 if not jnp.issubdtype(sd.dtype, jnp.floating):
@@ -209,6 +475,26 @@ def build_pipeline_fn(program, mesh, feed_names, state_mut, state_const,
                         f"pipeline boundary tensor {n!r} has non-float "
                         f"dtype {sd.dtype}; route integer data to every "
                         f"stage via feeds instead")
+
+        # classify fetches: scalar -> mean over microbatches (loss-like);
+        # per-microbatch batched -> concatenated over microbatches
+        mb_b = next(iter(mb_structs.values())).shape[0] if mb_structs else 0
+        scalar_fetches, batched_fetches = [], []
+        for f in extra_fetches:
+            sd = fetch_structs[f]
+            if sd.shape == ():
+                if not jnp.issubdtype(sd.dtype, jnp.floating):
+                    raise NotImplementedError(
+                        f"pipeline scalar fetch {f!r} must be floating "
+                        f"point, got {sd.dtype}")
+                scalar_fetches.append(f)
+            elif sd.shape and sd.shape[0] == mb_b:
+                batched_fetches.append(f)
+            else:
+                raise NotImplementedError(
+                    f"pipeline fetch {f!r} has per-microbatch shape "
+                    f"{sd.shape}, which is neither a scalar nor batched "
+                    f"over the micro-batch dim ({mb_b})")
 
         # ---- flat f32 carrier buffer, padded to the widest boundary -----
         def _size(sd):
@@ -243,12 +529,17 @@ def build_pipeline_fn(program, mesh, feed_names, state_mut, state_const,
             # masks (the correctness crux of RNG under GPipe)
             return jax.random.fold_in(jax.random.fold_in(rng_key, mb_idx), s)
 
-        def stage_fwd(s, prm, carried, act_buf, mb_idx, rng_key):
+        zero_fetches = tuple(jnp.zeros(fetch_structs[f].shape,
+                                       fetch_structs[f].dtype)
+                             for f in extra_fetches)
+
+        def stage_fwd(s, buf, carried, act_buf, mb_idx, rng_key):
             """Uniform output across branches:
-            (out_buf, loss, new_carried)."""
+            (out_buf, loss, fetches, new_carried)."""
             env = dict(base_env)
             env.update(carried)
-            env.update(prm)
+            env.update({p: v for p, v in unpack_stage(s, buf).items()
+                        if p in grad_of})
             for n, v in mb_feeds.items():
                 env[n] = lax.dynamic_index_in_dim(v, mb_idx, 0,
                                                   keepdims=False)
@@ -259,19 +550,23 @@ def build_pipeline_fn(program, mesh, feed_names, state_mut, state_const,
                 n: (env[n] if carried_owner[n] == s else carried[n])
                 for n in carried_names
             }
+            fts = tuple(
+                (jnp.asarray(env[f]).astype(fetch_structs[f].dtype)
+                 if producer_stage[f] == s else z)
+                for f, z in zip(extra_fetches, zero_fetches))
             if s < S - 1:
                 out_buf = pack(s, [env[n] for n in boundaries[s]])
-                return out_buf, jnp.zeros((), jnp.float32), new_carried
+                return out_buf, jnp.zeros((), jnp.float32), fts, new_carried
             loss = jnp.asarray(env[loss_name], jnp.float32).reshape(())
-            return zero_act, loss, new_carried
+            return zero_act, loss, fts, new_carried
 
         branches = [
-            (lambda prm, c, a, i, k, s=s: stage_fwd(s, prm, c, a, i, k))
+            (lambda buf, c, a, i, k, s=s: stage_fwd(s, buf, c, a, i, k))
             for s in range(S)
         ]
 
-        def switch_fwd(prm, carried, act_buf, mb_idx, rng_key):
-            return lax.switch(r, branches, prm, carried, act_buf, mb_idx,
+        def switch_fwd(buf, carried, act_buf, mb_idx, rng_key):
+            return lax.switch(r, branches, buf, carried, act_buf, mb_idx,
                               rng_key)
 
         fwd_perm = [(i, i + 1) for i in range(S - 1)]
@@ -282,12 +577,17 @@ def build_pipeline_fn(program, mesh, feed_names, state_mut, state_const,
         saved_in = jnp.zeros((K, width), jnp.float32)
         losses = jnp.zeros((K,), jnp.float32)
         carried = {n: base_env[n] for n in carried_names}
+        fetch_bufs = {f: jnp.zeros((K,) + tuple(fetch_structs[f].shape),
+                                   fetch_structs[f].dtype)
+                      for f in batched_fetches}
+        scalar_acc = {f: jnp.zeros((), fetch_structs[f].dtype)
+                      for f in scalar_fetches}
         recv = zero_act
         for t in range(T):
             mb = jnp.clip(t - r, 0, K - 1)
             active = jnp.logical_and(t - r >= 0, t - r < K)
-            act_out, loss_mb, new_carried = switch_fwd(
-                params, carried, recv, mb, rng)
+            act_out, loss_mb, fts, new_carried = switch_fwd(
+                lbuf, carried, recv, mb, rng)
             carried = {
                 n: jnp.where(active, new_carried[n], carried[n])
                 for n in carried_names
@@ -298,24 +598,33 @@ def build_pipeline_fn(program, mesh, feed_names, state_mut, state_const,
             saved_in = lax.dynamic_update_index_in_dim(saved_in, upd, mb, 0)
             losses = losses.at[mb].set(
                 jnp.where(active, loss_mb, losses[mb]))
+            for f, v in zip(extra_fetches, fts):
+                if f in fetch_bufs:
+                    prevf = lax.dynamic_index_in_dim(fetch_bufs[f], mb, 0,
+                                                     keepdims=False)
+                    fetch_bufs[f] = lax.dynamic_update_index_in_dim(
+                        fetch_bufs[f], jnp.where(active, v, prevf), mb, 0)
+                else:
+                    scalar_acc[f] = scalar_acc[f] + jnp.where(
+                        active, v, jnp.zeros_like(v))
             send = jnp.where(active, act_out, zero_act)
             recv = lax.ppermute(send, pp_axis, fwd_perm)
 
         # ---- backward drain (K + S - 1 ticks) ---------------------------
-        # backward replays the forward with the SAME carried snapshot the
+        # backward replays the forward with the SAME carried snapshot; the
         # vjp does not need exact per-tick stats (grads of running-stat
         # updates are zero: they are stop-gradient outputs)
-        def stage_bwd(prm, act_in, mb_idx, g_act, g_loss):
-            def f(prm_, act_in_):
-                out_buf, loss, _ = switch_fwd(prm_, carried, act_in_,
-                                              mb_idx, rng)
+        def stage_bwd(buf, act_in, mb_idx, g_act, g_loss):
+            def f(buf_, act_in_):
+                out_buf, loss, _, _ = switch_fwd(buf_, carried, act_in_,
+                                                 mb_idx, rng)
                 return out_buf, loss
 
-            _, vjp = jax.vjp(f, prm, act_in)
-            gp, gact = vjp((g_act, g_loss))
-            return gp, gact
+            _, vjp = jax.vjp(f, buf, act_in)
+            gb, gact = vjp((g_act, g_loss))
+            return gb, gact
 
-        grad_acc = jax.tree.map(jnp.zeros_like, params)
+        grad_acc = jnp.zeros_like(lbuf)
         g_recv = zero_act
         for u in range(T):
             m = jnp.clip(u - (S - 1 - r), 0, K - 1)
@@ -327,21 +636,18 @@ def build_pipeline_fn(program, mesh, feed_names, state_mut, state_const,
             g_act = jnp.where(is_last, zero_act, g_recv)
             act_in = lax.dynamic_index_in_dim(saved_in, m, 0,
                                               keepdims=False)
-            gp, gact = stage_bwd(params, act_in, m, g_act, g_loss)
+            gb, gact = stage_bwd(lbuf, act_in, m, g_act, g_loss)
             # where-select, not multiply: an inf/NaN jacobian at a
             # zero-filled inactive tick must not poison the accumulator
-            grad_acc = jax.tree.map(
-                lambda a, g: a + jnp.where(active, g, jnp.zeros_like(g)),
-                grad_acc, gp)
+            grad_acc = grad_acc + jnp.where(active, gb,
+                                            jnp.zeros_like(gb))
             g_send = jnp.where(active, gact, zero_act)
             g_recv = lax.ppermute(g_send, pp_axis, bwd_perm)
 
-        # grads live on the owning stage's rank; psum over pp replicates
-        # them, psum over dp completes data parallelism
-        grad_axes = (pp_axis,) + ((dp_axis,) if dp_axis else ())
-        grad_acc = jax.tree.map(
-            lambda g: lax.psum(g, grad_axes)
-            / (dp_size if dp_axis else 1), grad_acc)
+        # packed per-stage grads stay on their owning rank (that is the
+        # memory point of PP); only dp replicas reduce
+        if dp_axis:
+            grad_acc = lax.psum(grad_acc, dp_axis) / dp_size
 
         # publish carried state from its owning rank (other ranks still
         # hold the initial value); under dp the shards saw different data
@@ -357,19 +663,53 @@ def build_pipeline_fn(program, mesh, feed_names, state_mut, state_const,
                 out = lax.pmean(out, dp_axis)
             final_carried[n] = out
 
-        env = dict(base_env)
-        env.update(final_carried)
-        for pname, gname in grad_of.items():
-            env[gname] = grad_acc[pname]
-        trace_ops(opt_ops, env)
+        # ---- optimizer: shared ops replicated, stage ops switched -------
+        env_shared = dict(base_env)
+        env_shared.update(final_carried)
+        trace_ops(plan.shared_opt_ops, env_shared)
+
+        def opt_branch(s):
+            def f(buf, gbuf):
+                env = dict(env_shared)
+                env.update(unpack_stage(s, buf))
+                for p in plan.params_by_stage[s]:
+                    _, off, size, shape = plan.layout[p]
+                    env[grad_of[p]] = gbuf[off:off + size].reshape(shape)
+                trace_ops(plan.stage_opt_ops[s], env)
+                newb = buf
+                for (n, off, size, shape) in plan.entries[s]:
+                    newb = newb.at[off:off + size].set(
+                        jnp.ravel(env[n]).astype(jnp.float32))
+                return newb
+            return f
+
+        new_buf = lax.switch(r, [opt_branch(s) for s in range(S)],
+                             lbuf, grad_acc)
 
         # full-batch mean loss, present on the last rank; psum-broadcast
         loss_sum = jnp.where(r == S - 1, losses.sum(), 0.0)
         mean_loss = lax.psum(loss_sum, pp_axis) / K
         if dp_axis:
             mean_loss = lax.pmean(mean_loss, dp_axis)
-        fetches = tuple(mean_loss for _ in fetch_names)
-        new_state = tuple(env[n] for n in state_out)
+
+        # assemble fetches in fetch_names order
+        computed = {}
+        for f in scalar_fetches:
+            v = lax.psum(scalar_acc[f], pp_axis) / K
+            if dp_axis:
+                v = lax.pmean(v, dp_axis)
+            computed[f] = v
+        for f in batched_fetches:
+            full = lax.psum(fetch_bufs[f], pp_axis)
+            full = full.reshape((-1,) + tuple(fetch_structs[f].shape[1:]))
+            if dp_axis:
+                full = lax.all_gather(full, dp_axis, axis=0, tiled=True)
+            computed[f] = full
+        fetches = tuple(mean_loss if f == loss_name else computed[f]
+                        for f in fetch_names)
+
+        new_state = (new_buf[None, :],) \
+            + tuple(env_shared[n] for n in rest_out)
         new_rng = jax.random.split(rng, 2)[0]
         return fetches, new_state, new_rng
 
@@ -379,11 +719,11 @@ def build_pipeline_fn(program, mesh, feed_names, state_mut, state_const,
         traced,
         mesh=mesh,
         in_specs=(in_feed_specs,
-                  tuple(P() for _ in state_mut),
+                  (P(pp_axis),) + tuple(P() for _ in rest_mut),
                   tuple(P() for _ in state_const),
                   P()),
         out_specs=(tuple(P() for _ in fetch_names),
-                   tuple(P() for _ in state_out),
+                   (P(pp_axis),) + tuple(P() for _ in rest_out),
                    P()),
         check_vma=False,
     )

@@ -27,7 +27,7 @@ from . import dtypes
 from .lowering import PSEUDO_OPS, LoweringContext, get_lowering
 from .place import CPUPlace, Place, _default_place
 from .program import Program, Variable, default_main_program
-from .scope import Scope, global_scope
+from .scope import PackedParamRef, Scope, global_scope
 
 logger = logging.getLogger(__name__)
 
@@ -95,6 +95,9 @@ class _Compiled:
     # fetch was appended even when the op list is empty
     nan_ops: Tuple = ()
     nan_scan: bool = False
+    # pipeline v3: PackPlan sharding params+opt state per stage; run()
+    # calls its ensure_packed before assembling the state tuple
+    pipeline_pack: object = None
     n_calls: int = 0
 
 
@@ -345,12 +348,13 @@ class Executor:
             state_in, state_out = self._analyze_state(program, set(feed),
                                                       scope, ops=ops)
             self._analysis_cache[akey] = (state_in, state_out)
-        state_spec = tuple(
-            (n, tuple(np.shape(scope.get_var(n))), str(np.asarray(scope.get_var(n)).dtype))
-            if not _is_jax_array(scope.get_var(n))
-            else (n, tuple(scope.get_var(n).shape), str(scope.get_var(n).dtype))
-            for n in state_in
-        )
+        def _svspec(n):
+            v = scope.get_var(n)
+            if isinstance(v, PackedParamRef) or _is_jax_array(v):
+                return (n, tuple(v.shape), str(v.dtype))
+            return (n, tuple(np.shape(v)), str(np.asarray(v).dtype))
+
+        state_spec = tuple(_svspec(n) for n in state_in)
 
         mesh = self._active_mesh()
         key = (
@@ -377,6 +381,9 @@ class Executor:
         if not scope.has_var(RNG_VAR) or scope.get_var(RNG_VAR) is None:
             seed = program.random_seed or 0
             scope.set_var(RNG_VAR, jax.random.PRNGKey(seed))
+
+        if entry.pipeline_pack is not None:
+            entry.pipeline_pack.ensure_packed(scope, mesh)
 
         feed_vals = tuple(feed_arrays[n] for n in entry.feed_names)
         mut_vals = tuple(scope.get_var(n) for n in entry.state_mut)
@@ -563,21 +570,39 @@ class Executor:
                 raise NotImplementedError(
                     "run_steps over the pipeline executor is not supported "
                     "yet; call run per step")
-            from ..distributed.pipeline import build_pipeline_fn
+            from ..distributed.pipeline import (PACKED_STATE_VAR,
+                                                build_pipeline_fn,
+                                                plan_packing)
+
+            plan = plan_packing(program, int(mesh.shape["pp"]), state_in,
+                                state_out, pipe)
+            owned = plan.owned_names
+            ro_owned = sorted(owned & set(state_const))
+            if ro_owned:
+                raise NotImplementedError(
+                    f"stage-owned state {ro_owned} is read-only in the "
+                    f"program; pipeline state sharding expects params and "
+                    f"slots to be updated each step")
+            p_mut = (PACKED_STATE_VAR,) + tuple(
+                n for n in state_mut if n not in owned)
+            p_const = tuple(n for n in state_const if n not in owned)
+            p_out = (PACKED_STATE_VAR,) + tuple(
+                n for n in state_out if n not in owned)
 
             fn = build_pipeline_fn(
-                program, mesh, feed_names, state_mut, state_const,
-                state_out, fetch_names, pipe["loss_name"],
+                program, mesh, feed_names, p_mut, p_const,
+                p_out, fetch_names, pipe["loss_name"],
                 pipe["params_grads"], pipe["num_microbatches"],
-                pipe["bwd_end"])
+                pipe["bwd_end"], plan)
             return _Compiled(
                 fn=jax.jit(fn, donate_argnums=(1,)),
                 feed_names=feed_names,
-                state_mut=state_mut,
-                state_const=state_const,
-                state_out=tuple(state_out),
+                state_mut=p_mut,
+                state_const=p_const,
+                state_out=p_out,
                 fetch_names=fetch_names,
                 uses_rng=True,
+                pipeline_pack=plan,
             )
 
         globalize = None

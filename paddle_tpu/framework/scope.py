@@ -42,6 +42,48 @@ class _VarView:
         return _TensorView(self._scope, self._name)
 
 
+class PackedParamRef:
+    """Lazy view of one variable inside a pipeline-packed state buffer.
+
+    Pipeline v3 shards parameters + optimizer slots per stage: the scope
+    holds ONE (n_stages, width) buffer sharded over the 'pp' mesh axis,
+    and each owned variable becomes this lightweight view.  Reading the
+    view (np.asarray — the paddle.save / checkpoint / inspection path)
+    gathers the owning stage's row and slices the variable back out;
+    writing a concrete array over it (scope.set_var — the paddle.load /
+    restore path) signals the executor to re-pack before the next step.
+    """
+
+    __slots__ = ("_scope", "_packed_name", "stage", "offset", "shape",
+                 "dtype")
+
+    def __init__(self, scope, packed_name, stage, offset, shape, dtype):
+        self._scope = scope
+        self._packed_name = packed_name
+        self.stage = int(stage)
+        self.offset = int(offset)
+        self.shape = tuple(int(d) for d in shape)
+        self.dtype = np.dtype(dtype)
+
+    @property
+    def size(self):
+        n = 1
+        for d in self.shape:
+            n *= d
+        return n
+
+    def __array__(self, dtype=None, copy=None):
+        buf = self._scope.get_var(self._packed_name)
+        row = np.asarray(buf[self.stage])
+        arr = row[self.offset:self.offset + self.size] \
+            .reshape(self.shape).astype(self.dtype)
+        return arr.astype(dtype) if dtype is not None else arr
+
+    def __repr__(self):
+        return (f"PackedParamRef(stage={self.stage}, shape={self.shape}, "
+                f"dtype={self.dtype})")
+
+
 _scope_serial = itertools.count()
 
 
