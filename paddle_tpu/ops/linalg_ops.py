@@ -169,12 +169,10 @@ def _segment_pool(ctx, op):
         jnp.ones((x.shape[0], 1), x.dtype), seg, num_segments=n))
 
 
-@register_lower("gather_tree")
-def _gather_tree(ctx, op):
-    """Beam-search ancestry walk (reference gather_tree_op.cc): ids/parents
-    [T, B, W] -> full beams re-threaded from the last step backwards."""
-    ids = ctx.in1(op, "Ids")
-    parents = ctx.in1(op, "Parents")
+def backtrack_beams(ids, parents):
+    """Beam ancestry walk shared by gather_tree and beam_search_decode:
+    ids/parents [T, B, W] (parents local to each batch's beam group) ->
+    re-threaded beams [T, B, W], chronological."""
     t, b, w = ids.shape
     binx = jnp.arange(b)[:, None]
 
@@ -186,4 +184,12 @@ def _gather_tree(ctx, op):
 
     init = jnp.tile(jnp.arange(w)[None, :], (b, 1))
     _, outs = jax.lax.scan(step, init, (ids[::-1], parents[::-1]))
-    ctx.set_out(op, "Out", outs[::-1])
+    return outs[::-1]
+
+
+@register_lower("gather_tree")
+def _gather_tree(ctx, op):
+    """Beam-search ancestry walk (reference gather_tree_op.cc): ids/parents
+    [T, B, W] -> full beams re-threaded from the last step backwards."""
+    ctx.set_out(op, "Out", backtrack_beams(ctx.in1(op, "Ids"),
+                                           ctx.in1(op, "Parents")))

@@ -120,9 +120,82 @@ def _share_data(ctx, op):
     ctx.set_out(op, "Out", ctx.in1(op, "X"))
 
 
-@register_lower("beam_search_decode", "beam_search")
+@register_lower("beam_search")
 def _beam_search(ctx, op):
-    raise NotImplementedError(
-        "beam search has dynamic shapes; use the functional decoding API "
-        "(paddle_tpu.text.decode) on TPU"
-    )
+    """One beam-search selection step (reference
+    paddle/fluid/operators/math/beam_search.cc, layers/rnn.py:3136).
+
+    TPU-native dense semantics (SURVEY §7 LoD mitigation): no per-batch
+    beam shrinking — rows stay [batch*beam] and finished lanes (pre_id
+    == end_id) compete with a single frozen-score end_id candidate.
+    Inputs: pre_ids/pre_scores [B*K, 1], scores [B*K, C] (+ optional
+    ids [B*K, C], else candidate j means token j); outputs
+    selected_ids/selected_scores [B*K, 1] and parent_idx [B*K] (GLOBAL
+    row index of each selected lane's parent).  The functional API
+    (paddle_tpu.text.decode) is the recommended jit-native front end.
+    """
+    pre_ids = ctx.in1(op, "pre_ids")
+    pre_scores = ctx.in1(op, "pre_scores")
+    scores = ctx.in1(op, "scores")
+    ids = ctx.in1(op, "ids")
+    K = int(op.attr("beam_size"))
+    end_id = int(op.attr("end_id"))
+    accumulated = bool(op.attr("is_accumulated", True))
+    BK, C = scores.shape
+    if BK % K:
+        raise ValueError(
+            f"beam_search rows {BK} not divisible by beam_size {K}")
+    B = BK // K
+    NEG = jnp.float32(-1e9)
+
+    if ids is None:
+        ids = jnp.broadcast_to(jnp.arange(C, dtype=jnp.int32), (BK, C))
+    ids = ids.astype(jnp.int32)
+    pre_s = pre_scores.reshape(BK).astype(jnp.float32)
+    acc = scores.astype(jnp.float32) if accumulated \
+        else pre_s[:, None] + jnp.log(jnp.maximum(scores.astype(jnp.float32),
+                                                  1e-30))
+    finished = pre_ids.reshape(BK) == end_id
+    # finished lanes: single end_id candidate at the frozen score
+    only_end = jnp.full((C,), NEG).at[0].set(0.0)
+    acc = jnp.where(finished[:, None], pre_s[:, None] + only_end[None, :],
+                    acc)
+    ids = jnp.where(finished[:, None], jnp.int32(end_id), ids)
+
+    flat = acc.reshape(B, K * C)
+    top_scores, top_idx = jax.lax.top_k(flat, K)  # [B, K]
+    parent_in_group = top_idx // C
+    sel_ids = jnp.take_along_axis(
+        ids.reshape(B, K * C), top_idx, axis=1).astype(jnp.int32)
+    parent_global = (jnp.arange(B)[:, None] * K
+                     + parent_in_group).astype(jnp.int32)
+    ctx.set_out(op, "selected_ids", sel_ids.reshape(BK, 1))
+    ctx.set_out(op, "selected_scores", top_scores.reshape(BK, 1))
+    ctx.set_out(op, "parent_idx", parent_global.reshape(BK))
+
+
+@register_lower("beam_search_decode")
+def _beam_search_decode(ctx, op):
+    """Backtrack stacked beam-search steps into full hypotheses
+    (reference beam_search_decode_op.cc, layers/rnn.py:3295).
+
+    Dense redesign: instead of LoD TensorArrays, Ids/ParentIdx arrive
+    stacked [T, B*K] (tokens and GLOBAL parent rows per step, as emitted
+    by the beam_search lowering) and Scores [T, B*K]; outputs
+    SentenceIds [B*K, T] (each final lane's full token path) and
+    SentenceScores [B*K] (its final accumulated score).
+    """
+    from .linalg_ops import backtrack_beams
+
+    ids = ctx.in1(op, "Ids").astype(jnp.int32)        # [T, BK]
+    parents = ctx.in1(op, "ParentIdx").astype(jnp.int32)
+    scores = ctx.in1(op, "Scores")
+    K = int(op.attr("beam_size"))
+    T, BK = ids.shape
+    # global parent rows -> per-group local beams, then the shared
+    # gather_tree ancestry walk
+    sent = backtrack_beams(ids.reshape(T, BK // K, K),
+                           (parents % K).reshape(T, BK // K, K))
+    ctx.set_out(op, "SentenceIds",
+                jnp.transpose(sent.reshape(T, BK), (1, 0)))
+    ctx.set_out(op, "SentenceScores", scores[T - 1].reshape(BK))

@@ -7,5 +7,7 @@ contrib ERNIE configs) plus a ``paddle.text`` dataset package.  The static
 BERT builder here is the BASELINE.json config-3 flagship workload.
 """
 from . import datasets  # noqa: F401
+from . import decode  # noqa: F401
 from . import static_models  # noqa: F401
+from .decode import beam_search, dynamic_decode, greedy_search  # noqa: F401
 from .static_models import bert_base_pretrain_program, bert_encoder  # noqa: F401

@@ -1,0 +1,147 @@
+"""Sequence decoding: greedy and fixed-beam search, XLA-static.
+
+Role parity: reference BeamSearchDecoder + dynamic_decode
+(python/paddle/fluid/layers/rnn.py:866, :1398) and the LoD beam-search
+kernels (paddle/fluid/operators/math/beam_search.cc).  TPU-native
+redesign per SURVEY §7: no TensorArrays or LoD beam shrinking — a
+`lax.scan` over `max_len` steps carries a fixed [batch, beam] lane set;
+finished beams are forced to extend with `end_id` at zero added
+log-prob, so they keep competing in the joint top-k exactly like the
+reference's merged finished/alive queue.  Everything is jittable and
+shape-static (MXU-friendly: the step_fn's matmuls stay batched over
+batch*beam).
+
+The step function contract:
+
+    step_fn(token_ids, state) -> (logits, new_state)
+
+with `token_ids` int32 [N], `logits` float [N, vocab], and `state` any
+pytree batched on dim 0 (N = batch*beam for beam search; beam search
+reorders it by parent beam every step).
+"""
+from __future__ import annotations
+
+
+def greedy_search(step_fn, init_state, init_ids, max_len, end_id):
+    """Argmax decoding.
+
+    Args:
+        init_ids: int32 [batch] start tokens (BOS).
+        max_len: number of generated tokens (static).
+        end_id: EOS token id; generation sticks to EOS once emitted.
+    Returns:
+        (ids [batch, max_len] int32, scores [batch] float32 — the summed
+        log-probs of the chosen tokens up to and including EOS).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    init_ids = jnp.asarray(init_ids, jnp.int32)
+
+    def body(carry, _):
+        state, cur, done, score = carry
+        logits, state = step_fn(cur, state)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+        tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        tok = jnp.where(done, jnp.int32(end_id), tok)
+        step_lp = jnp.take_along_axis(logp, tok[:, None], axis=1)[:, 0]
+        score = score + jnp.where(done, 0.0, step_lp)
+        done = jnp.logical_or(done, tok == end_id)
+        return (state, tok, done, score), tok
+
+    b = init_ids.shape[0]
+    carry0 = (init_state, init_ids, jnp.zeros((b,), bool),
+              jnp.zeros((b,), jnp.float32))
+    (_, _, _, scores), toks = lax.scan(body, carry0, None, length=max_len)
+    return jnp.transpose(toks, (1, 0)), scores
+
+
+def beam_search(step_fn, init_state, init_ids, beam_size, max_len, end_id,
+                length_penalty=0.0):
+    """Fixed-beam search (reference BeamSearchDecoder semantics).
+
+    Args:
+        init_state: pytree batched [batch, ...]; tiled to batch*beam
+            internally (reference tile_beam_merge_with_batch,
+            rnn.py:934).
+        init_ids: int32 [batch] BOS tokens.
+        beam_size: number of lanes kept per batch element (static).
+        length_penalty: GNMT alpha; final score =
+            log_prob / ((5 + len) / 6) ** alpha.
+    Returns:
+        (ids [batch, beam, max_len] int32 — best beam first,
+         scores [batch, beam] float32 — length-penalized log-probs).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    K = int(beam_size)
+    init_ids = jnp.asarray(init_ids, jnp.int32)
+    B = init_ids.shape[0]
+    NEG = jnp.float32(-1e9)
+
+    state = jax.tree.map(lambda v: jnp.repeat(v, K, axis=0), init_state)
+    cur = jnp.repeat(init_ids, K)
+    # only lane 0 live initially so step 1 yields K DISTINCT expansions
+    log_probs = jnp.tile(
+        jnp.concatenate([jnp.zeros((1,), jnp.float32),
+                         jnp.full((K - 1,), NEG)]), (B,)).reshape(B, K)
+    finished = jnp.zeros((B, K), bool)
+    ids_buf = jnp.full((B, K, int(max_len)), end_id, jnp.int32)
+
+    def body(carry, t):
+        state, cur, log_probs, finished, ids_buf = carry
+        logits, state = step_fn(cur, state)
+        V = logits.shape[-1]
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32)) \
+            .reshape(B, K, V)
+        # finished lanes extend ONLY with end_id at zero cost, keeping
+        # their score frozen while still competing in the joint top-k
+        eos_row = jnp.full((V,), NEG).at[end_id].set(0.0)
+        logp = jnp.where(finished[:, :, None], eos_row[None, None, :], logp)
+        total = (log_probs[:, :, None] + logp).reshape(B, K * V)
+        top_scores, top_idx = lax.top_k(total, K)  # [B, K]
+        parent = top_idx // V
+        token = (top_idx % V).astype(jnp.int32)
+        ids_buf = jnp.take_along_axis(ids_buf, parent[:, :, None], axis=1)
+        ids_buf = ids_buf.at[:, :, t].set(token)
+        finished = jnp.take_along_axis(finished, parent, axis=1)
+        finished = jnp.logical_or(finished, token == end_id)
+        gidx = (jnp.arange(B)[:, None] * K + parent).reshape(-1)
+        state = jax.tree.map(lambda v: v[gidx], state)
+        return (state, token.reshape(-1), top_scores, finished, ids_buf), None
+
+    carry0 = (state, cur, log_probs, finished, ids_buf)
+    (_, _, log_probs, finished, ids_buf), _ = lax.scan(
+        body, carry0, jnp.arange(int(max_len)))
+
+    # length = index of first EOS + 1, or max_len when never finished
+    is_eos = ids_buf == end_id
+    first_eos = jnp.argmax(is_eos, axis=-1)
+    lengths = jnp.where(is_eos.any(axis=-1), first_eos + 1, int(max_len))
+    if length_penalty:
+        lp = ((5.0 + lengths.astype(jnp.float32)) / 6.0) ** float(
+            length_penalty)
+    else:
+        lp = jnp.ones_like(lengths, jnp.float32)
+    scores = log_probs / lp
+    order = jnp.argsort(-scores, axis=-1)
+    scores = jnp.take_along_axis(scores, order, axis=1)
+    ids_buf = jnp.take_along_axis(ids_buf, order[:, :, None], axis=1)
+    return ids_buf, scores
+
+
+def dynamic_decode(decoder_step, init_state, init_ids, max_len, end_id,
+                   beam_size=None, **kw):
+    """Reference dynamic_decode(rnn.py:1398) role: dispatch greedy vs
+    beam by `beam_size`."""
+    if beam_size is None or int(beam_size) <= 1:
+        return greedy_search(decoder_step, init_state, init_ids, max_len,
+                             end_id)
+    return beam_search(decoder_step, init_state, init_ids, beam_size,
+                       max_len, end_id, **kw)
+
+
+__all__ = ["greedy_search", "beam_search", "dynamic_decode"]

@@ -1,0 +1,210 @@
+"""Greedy/beam decoding vs numpy oracles.
+
+Parity model: reference BeamSearchDecoder + dynamic_decode
+(layers/rnn.py:866, :1398) and math/beam_search.cc — a seq2seq-style
+step model decoded both ways, checked against an independent numpy
+implementation of merged-queue beam search.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.text import decode
+
+V, H = 11, 7
+EOS = 0
+
+
+def _mk_model(seed=0):
+    rs = np.random.RandomState(seed)
+    emb = rs.randn(V, H).astype("f4") * 0.7
+    w = rs.randn(H, H).astype("f4") * 0.5
+    out = rs.randn(H, V).astype("f4") * 0.9
+    return emb, w, out
+
+
+def _np_step(tok, h, model):
+    emb, w, out = model
+    h2 = np.tanh(emb[tok] + h @ w)
+    return h2 @ out, h2
+
+
+def _jax_step_fn(model):
+    import jax.numpy as jnp
+
+    emb, w, out = (jnp.asarray(m) for m in model)
+
+    def step(tok, h):
+        h2 = jnp.tanh(emb[tok] + h @ w)
+        return h2 @ out, h2
+
+    return step
+
+
+def _np_log_softmax(x):
+    x = x - x.max(axis=-1, keepdims=True)
+    return x - np.log(np.exp(x).sum(axis=-1, keepdims=True))
+
+
+def test_greedy_matches_numpy():
+    model = _mk_model(0)
+    step = _jax_step_fn(model)
+    import jax.numpy as jnp
+
+    ids, scores = decode.greedy_search(
+        step, jnp.zeros((3, H)), np.array([1, 2, 3]), max_len=8, end_id=EOS)
+    # numpy oracle from the same bos tokens
+    h = np.zeros((3, H), "f4")
+    tok = np.array([1, 2, 3])
+    done = np.zeros(3, bool)
+    out, score = [], np.zeros(3, "f4")
+    for _ in range(8):
+        logits, h = _np_step(tok, h, model)
+        lp = _np_log_softmax(logits)
+        tok = logits.argmax(-1)
+        tok = np.where(done, EOS, tok)
+        score = score + np.where(done, 0.0, lp[np.arange(3), tok])
+        done |= tok == EOS
+        out.append(tok.copy())
+    np.testing.assert_array_equal(np.asarray(ids), np.stack(out, 1))
+    np.testing.assert_allclose(np.asarray(scores), score, rtol=1e-5)
+
+
+@pytest.mark.parametrize("K", [2, 4])
+def test_beam_matches_numpy(K):
+    import jax
+    import jax.numpy as jnp
+
+    model = _mk_model(1)
+    step = _jax_step_fn(model)
+    bos = np.array([1, 2])
+    ids, scores = jax.jit(
+        lambda s0, b: decode.beam_search(step, s0, b, beam_size=K,
+                                         max_len=6, end_id=EOS))(
+        jnp.zeros((2, H)), bos)
+
+    # oracle from the same bos
+    NEG = -1e9
+    batch = 2
+    h = np.zeros((batch * K, H), "f4")
+    tok = np.repeat(bos, K)
+    logp = np.tile([0.0] + [NEG] * (K - 1), batch).reshape(batch, K)
+    fin = np.zeros((batch, K), bool)
+    buf = np.full((batch, K, 6), EOS, np.int64)
+    for t in range(6):
+        logits, h = _np_step(tok, h, model)
+        lp = _np_log_softmax(logits).reshape(batch, K, V)
+        eos_row = np.full((V,), NEG)
+        eos_row[EOS] = 0.0
+        lp = np.where(fin[:, :, None], eos_row[None, None, :], lp)
+        total = (logp[:, :, None] + lp).reshape(batch, K * V)
+        top = np.argsort(-total, axis=1)[:, :K]
+        logp = np.take_along_axis(total, top, axis=1)
+        parent, token = top // V, top % V
+        buf = np.take_along_axis(buf, parent[:, :, None], axis=1)
+        buf[:, :, t] = token
+        fin = np.take_along_axis(fin, parent, axis=1) | (token == EOS)
+        gidx = (np.arange(batch)[:, None] * K + parent).ravel()
+        h = h[gidx]
+        tok = token.ravel()
+    order = np.argsort(-logp, axis=1, kind="stable")
+    buf = np.take_along_axis(buf, order[:, :, None], axis=1)
+    logp = np.take_along_axis(logp, order, axis=1)
+    np.testing.assert_allclose(np.asarray(scores), logp, rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_array_equal(np.asarray(ids), buf)
+
+
+def test_beam_scores_sorted_and_eos_padded():
+    import jax.numpy as jnp
+
+    model = _mk_model(2)
+    ids, scores = decode.beam_search(
+        _jax_step_fn(model), jnp.zeros((4, H)), np.array([1, 2, 3, 4]),
+        beam_size=3, max_len=10, end_id=EOS, length_penalty=0.6)
+    s = np.asarray(scores)
+    assert (np.diff(s, axis=1) <= 1e-6).all(), "beams not sorted"
+    ids = np.asarray(ids)
+    # after the first EOS, everything is EOS padding
+    for b in range(4):
+        for k in range(3):
+            row = ids[b, k]
+            if (row == EOS).any():
+                first = int((row == EOS).argmax())
+                assert (row[first:] == EOS).all()
+
+
+def test_dynamic_decode_dispatch():
+    import jax.numpy as jnp
+
+    model = _mk_model(3)
+    g_ids, _ = decode.dynamic_decode(_jax_step_fn(model), jnp.zeros((2, H)),
+                                     np.array([1, 2]), 5, EOS)
+    assert np.asarray(g_ids).shape == (2, 5)
+    b_ids, _ = decode.dynamic_decode(_jax_step_fn(model), jnp.zeros((2, H)),
+                                     np.array([1, 2]), 5, EOS, beam_size=2)
+    assert np.asarray(b_ids).shape == (2, 2, 5)
+
+
+# --------------------------------------------------------------------------
+# op-level: beam_search / beam_search_decode dense lowerings
+# --------------------------------------------------------------------------
+
+from op_test import OpTest  # noqa: E402
+
+
+class TestBeamSearchOp(OpTest):
+    op_type = "beam_search"
+
+    def setup(self):
+        K, C = 2, 3
+        # batch 2, beam 2; row 2 is finished (pre_id == end 0)
+        pre_ids = np.array([[3], [5], [0], [7]], np.int64)
+        pre_scores = np.array([[-1.0], [-2.0], [-0.5], [-3.0]], "f4")
+        ids = np.array([[4, 2, 8], [1, 9, 6], [4, 2, 8], [3, 5, 2]],
+                       np.int64)
+        scores = np.array([[-1.2, -1.4, -1.9], [-2.2, -2.5, -2.6],
+                           [-9.0, -9.1, -9.2], [-3.1, -3.3, -3.9]], "f4")
+        # group 0 candidates: (-1.2,4) (-1.4,2) (-1.9,8) (-2.2,1) ...
+        #   top2: -1.2 (id 4, parent 0), -1.4 (id 2, parent 0)
+        # group 1: finished row 2 contributes (end,-0.5) frozen;
+        #   row 3 alive: -3.1 -3.3 -3.9 -> top2: -0.5 (end, parent 2),
+        #   -3.1 (id 3, parent 3)
+        sel_ids = np.array([[4], [2], [0], [3]], np.int64)
+        sel_scores = np.array([[-1.2], [-1.4], [-0.5], [-3.1]], "f4")
+        parent = np.array([0, 0, 2, 3], np.int32)
+        self.inputs = {"pre_ids": [("pi", pre_ids)],
+                       "pre_scores": [("ps", pre_scores)],
+                       "ids": [("ids", ids)],
+                       "scores": [("sc", scores)]}
+        self.attrs = {"beam_size": 2, "end_id": 0, "is_accumulated": True,
+                      "level": 0}
+        self.outputs = {"selected_ids": [("si", sel_ids)],
+                        "selected_scores": [("ss", sel_scores)],
+                        "parent_idx": [("pa", parent)]}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestBeamSearchDecodeOp(OpTest):
+    op_type = "beam_search_decode"
+
+    def setup(self):
+        # T=3, batch*beam=2; chain: final lane 0 <- parent 1 <- parent 0
+        ids = np.array([[4, 7], [5, 8], [6, 9]], np.int64)
+        parents = np.array([[0, 0], [0, 0], [1, 0]], np.int64)
+        scores = np.array([[-1.0, -1.1], [-2.0, -2.1], [-3.0, -3.1]], "f4")
+        # lane 0: t2 tok 6, parent 1 -> t1 tok 8, parent 0 -> t0 tok 4
+        # lane 1: t2 tok 9, parent 0 -> t1 tok 5, parent 0 -> t0 tok 4
+        sent = np.array([[4, 8, 6], [4, 5, 9]], np.int64)
+        self.inputs = {"Ids": [("ids", ids)],
+                       "ParentIdx": [("par", parents)],
+                       "Scores": [("sc", scores)]}
+        self.attrs = {"beam_size": 2, "end_id": 0}
+        self.outputs = {"SentenceIds": [("si", sent)],
+                        "SentenceScores": [("ss",
+                                            np.array([-3.0, -3.1], "f4"))]}
+
+    def test_output(self):
+        self.check_output()

@@ -40,6 +40,8 @@ MODULES = [
     "paddle_tpu.distributed.fleet",
     "paddle_tpu.layers",
     "paddle_tpu.profiler",
+    "paddle_tpu.text",
+    "paddle_tpu.text.decode",
 ]
 
 
