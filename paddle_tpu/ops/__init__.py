@@ -19,6 +19,7 @@ from . import (  # noqa: F401
     math_ops,
     misc,
     misc_ops,
+    nms_ops,
     nn_ops,
     optimizer_ops,
     rnn_ops,

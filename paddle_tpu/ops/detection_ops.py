@@ -3,9 +3,7 @@
 Reference parity: operators/detection/ — the dense, statically-shaped
 subset (prior_box, anchor_generator, box_coder, iou_similarity,
 yolo_box, box_clip).  NMS-style ops with data-dependent output shapes
-(multiclass_nms, generate_proposals, bipartite_match) are rejected
-loudly: XLA needs static shapes; decode-then-top-k pipelines cover the
-TPU serving path.
+live in nms_ops.py as masked fixed-size lowerings.
 """
 from __future__ import annotations
 
@@ -284,16 +282,5 @@ def _box_clip(ctx, op):
     ctx.set_out(op, "Output", out)
 
 
-def _dynamic_shape_reject(name):
-    def rule(ctx, op):
-        raise NotImplementedError(
-            f"{name} produces data-dependent output shapes, which XLA "
-            f"static shapes cannot express; use the dense decode ops "
-            f"(yolo_box/box_coder) + top-k style selection instead")
-
-    return rule
-
-
-for _n in ("multiclass_nms", "multiclass_nms2", "generate_proposals",
-           "bipartite_match", "matrix_nms"):
-    register_lower(_n)(_dynamic_shape_reject(_n))
+# NMS / proposal / matching ops live in nms_ops.py (masked fixed-size
+# lowerings with explicit valid counts).

@@ -1,0 +1,338 @@
+"""NMS / proposal / matching ops as masked fixed-size lowerings.
+
+Reference parity: operators/detection/multiclass_nms_op.cc (NMSFast:
+greedy suppression with adaptive eta, :606 MultiClassNMS),
+matrix_nms_op.cc (parallel decay), generate_proposals_op.cc (RPN
+decode -> clip -> min-size filter -> NMS), bipartite_match_op.cc
+(greedy global-argmax matching).
+
+TPU-native redesign (SURVEY §7 LoD mitigation): the reference emits
+LoD tensors whose row count is data-dependent; XLA needs static shapes,
+so every op here returns FIXED-size outputs padded at the tail plus an
+explicit valid count:
+
+- multiclass_nms / multiclass_nms2 / multiclass_nms3: Out is
+  [B, keep_top_k, 6] with invalid rows marked class = -1 (the
+  reference's own no-detection marker), multiclass_nms2/3 add Index
+  [B, keep_top_k] (-1 pad) and NmsRoisNum [B].
+- matrix_nms: same contract (Out/Index/RoisNum).
+- generate_proposals: RpnRois [B, post_nms_topN, 4], RpnRoiProbs
+  [B, post_nms_topN, 1], RpnRoisNum [B]; pad rows are zero with prob 0.
+- bipartite_match: dense [B, rows, cols] (or single [rows, cols])
+  DistMat; outputs already fixed-shape in the reference.
+
+The sequential suppression loop is a `lax.fori_loop` over a top-k
+pre-sorted candidate list with an O(K^2) IoU matrix — K = nms_top_k is
+a compile-time bound, so everything tiles statically onto the VPU.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..framework.lowering import register_lower
+
+NEG = jnp.float32(-1e9)
+
+
+def _pairwise_iou(boxes, normalized):
+    """IoU matrix [M, M] (reference JaccardOverlap): +1 extent when the
+    boxes are in un-normalized pixel coordinates."""
+    off = 0.0 if normalized else 1.0
+    x1, y1, x2, y2 = (boxes[:, i] for i in range(4))
+    area = jnp.maximum(x2 - x1 + off, 0) * jnp.maximum(y2 - y1 + off, 0)
+    ix1 = jnp.maximum(x1[:, None], x1[None, :])
+    iy1 = jnp.maximum(y1[:, None], y1[None, :])
+    ix2 = jnp.minimum(x2[:, None], x2[None, :])
+    iy2 = jnp.minimum(y2[:, None], y2[None, :])
+    iw = jnp.maximum(ix2 - ix1 + off, 0)
+    ih = jnp.maximum(iy2 - iy1 + off, 0)
+    inter = iw * ih
+    union = area[:, None] + area[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+def _greedy_nms_keep(boxes, valid, iou_threshold, eta, normalized):
+    """Keep-mask over score-desc-sorted boxes (reference NMSFast):
+    each CANDIDATE is tested against the threshold as decayed by all
+    previously KEPT boxes (adaptive eta applies at candidate time, not
+    keeper time); after every kept box the threshold decays by eta
+    while it stays above 0.5."""
+    m = boxes.shape[0]
+    iou = _pairwise_iou(boxes, normalized)
+    idx = jnp.arange(m)
+
+    def body(j, carry):
+        keep, thr = carry
+        ov = jnp.max(jnp.where(jnp.logical_and(idx < j, keep), iou[j], 0.0))
+        kj = jnp.logical_and(valid[j], ov <= thr)
+        keep = keep.at[j].set(kj)
+        if eta < 1.0:
+            thr = jnp.where(jnp.logical_and(kj, thr > 0.5), thr * eta, thr)
+        return keep, thr
+
+    keep, _ = lax.fori_loop(0, m, body,
+                            (jnp.zeros((m,), bool),
+                             jnp.float32(iou_threshold)))
+    return keep
+
+
+def _per_class_nms(boxes, scores, background, score_thr, nms_top_k,
+                   iou_thr, eta, normalized):
+    """One image.  boxes [M, 4], scores [C, M] -> per-candidate
+    (score, class, box_index) for C*K candidates, suppressed ones at
+    score NEG."""
+    C, M = scores.shape
+    K = M if nms_top_k <= 0 else min(int(nms_top_k), M)
+
+    def one_class(c, s):
+        s = jnp.where(s > score_thr, s, NEG)
+        top_s, order = lax.top_k(s, K)
+        valid = top_s > NEG / 2
+        keep = _greedy_nms_keep(boxes[order], valid, iou_thr, eta,
+                                normalized)
+        is_bg = c == background
+        sel = jnp.where(jnp.logical_and(keep, jnp.logical_not(is_bg)),
+                        top_s, NEG)
+        return sel, order
+
+    sel, order = jax.vmap(one_class)(jnp.arange(C), scores)
+    cls = jnp.broadcast_to(jnp.arange(C)[:, None], (C, K))
+    return sel.reshape(-1), cls.reshape(-1), order.reshape(-1)
+
+
+def _merge_keep_top_k(sel, cls, order, boxes, keep_top_k):
+    """Cross-class merge (reference keep_top_k stage): final rows
+    [keep, 6] = (label, score, box), -1-class padded, plus indices and
+    the valid count."""
+    total = sel.shape[0]
+    keep = total if keep_top_k <= 0 else min(int(keep_top_k), total)
+    top_s, top_i = lax.top_k(sel, keep)
+    valid = top_s > NEG / 2
+    label = jnp.where(valid, cls[top_i], -1).astype(jnp.int32)
+    bidx = jnp.where(valid, order[top_i], -1).astype(jnp.int32)
+    b = jnp.where(valid[:, None], boxes[order[top_i]], 0.0)
+    score = jnp.where(valid, top_s, 0.0)
+    out = jnp.concatenate([label[:, None].astype(boxes.dtype),
+                           score[:, None], b], axis=1)
+    return out, bidx, valid.sum().astype(jnp.int32)
+
+
+def _nms_common(ctx, op, with_index):
+    boxes = ctx.in1(op, "BBoxes")   # [B, M, 4]
+    scores = ctx.in1(op, "Scores")  # [B, C, M]
+    if boxes.ndim == 2:
+        boxes = boxes[None]
+    if scores.ndim == 2:
+        scores = scores[None]
+    background = int(op.attr("background_label", 0))
+    score_thr = float(op.attr("score_threshold", 0.0))
+    nms_top_k = int(op.attr("nms_top_k", -1))
+    iou_thr = float(op.attr("nms_threshold", 0.3))
+    eta = float(op.attr("nms_eta", 1.0))
+    keep_top_k = int(op.attr("keep_top_k", -1))
+    normalized = bool(op.attr("normalized", True))
+
+    def one_image(b, s):
+        sel, cls, order = _per_class_nms(b, s, background, score_thr,
+                                         nms_top_k, iou_thr, eta,
+                                         normalized)
+        return _merge_keep_top_k(sel, cls, order, b, keep_top_k)
+
+    out, index, count = jax.vmap(one_image)(boxes, scores)
+    ctx.set_out(op, "Out", out)
+    if with_index:
+        ctx.set_out(op, "Index", index)
+    ctx.set_out(op, "NmsRoisNum", count)
+    ctx.set_out(op, "RoisNum", count)
+
+
+@register_lower("multiclass_nms")
+def _multiclass_nms(ctx, op):
+    _nms_common(ctx, op, with_index=False)
+
+
+@register_lower("multiclass_nms2", "multiclass_nms3")
+def _multiclass_nms2(ctx, op):
+    _nms_common(ctx, op, with_index=True)
+
+
+@register_lower("matrix_nms")
+def _matrix_nms(ctx, op):
+    """Parallel soft-NMS (reference matrix_nms_op.cc): each candidate's
+    score decays by the worst-case overlap with any higher-scored
+    candidate, compensated by that candidate's own overlap history —
+    no sequential loop, a perfect fit for the VPU."""
+    boxes = ctx.in1(op, "BBoxes")
+    scores = ctx.in1(op, "Scores")
+    if boxes.ndim == 2:
+        boxes = boxes[None]
+    if scores.ndim == 2:
+        scores = scores[None]
+    background = int(op.attr("background_label", 0))
+    score_thr = float(op.attr("score_threshold", 0.0))
+    post_thr = float(op.attr("post_threshold", 0.0))
+    nms_top_k = int(op.attr("nms_top_k", -1))
+    keep_top_k = int(op.attr("keep_top_k", -1))
+    use_gaussian = bool(op.attr("use_gaussian", False))
+    sigma = float(op.attr("gaussian_sigma", 2.0))
+    normalized = bool(op.attr("normalized", True))
+    C, M = scores.shape[1], scores.shape[2]
+    K = M if nms_top_k <= 0 else min(int(nms_top_k), M)
+
+    def one_class(c, s, b):
+        s = jnp.where(s > score_thr, s, NEG)
+        top_s, order = lax.top_k(s, K)
+        valid = top_s > NEG / 2
+        iou = _pairwise_iou(b[order], normalized)
+        tri = jnp.tril(jnp.ones((K, K), bool), -1)  # i<j pairs: iou[j, i]
+        iou_masked = jnp.where(tri, iou, 0.0)       # row j: overlaps w/ prev
+        comp = jnp.max(iou_masked, axis=1)          # compensate_iou per box
+        if use_gaussian:
+            decay = jnp.exp((comp[None, :] ** 2 - iou_masked ** 2) * sigma)
+        else:
+            decay = (1.0 - iou_masked) / (1.0 - comp[None, :])
+        decay = jnp.where(tri, decay, 1.0)
+        dmin = jnp.min(decay, axis=1)
+        ds = top_s * dmin
+        sel = jnp.where(jnp.logical_and(
+            jnp.logical_and(valid, ds > post_thr), c != background), ds, NEG)
+        return sel, order
+
+    def one_image(b, s):
+        sel, order = jax.vmap(lambda c, sc: one_class(c, sc, b))(
+            jnp.arange(C), s)
+        cls = jnp.broadcast_to(jnp.arange(C)[:, None], (C, K))
+        return _merge_keep_top_k(sel.reshape(-1), cls.reshape(-1),
+                                 order.reshape(-1), b, keep_top_k)
+
+    out, index, count = jax.vmap(one_image)(boxes, scores)
+    ctx.set_out(op, "Out", out)
+    ctx.set_out(op, "Index", index)
+    ctx.set_out(op, "RoisNum", count)
+
+
+@register_lower("bipartite_match")
+def _bipartite_match(ctx, op):
+    """Greedy global-argmax matching (reference bipartite_match_op.cc):
+    repeatedly take the largest remaining entry, binding its row to its
+    column; `per_prediction` then fills unmatched columns by per-column
+    argmax over the distance threshold."""
+    dist = ctx.in1(op, "DistMat")
+    squeeze = dist.ndim == 2
+    if squeeze:
+        dist = dist[None]
+    match_type = str(op.attr("match_type", "bipartite"))
+    dist_threshold = float(op.attr("dist_threshold", 0.5))
+    B, R, C = dist.shape
+
+    def one(d):
+        def body(_, carry):
+            dm, idx, val = carry
+            flat = dm.reshape(-1)
+            k = jnp.argmax(flat)
+            v = flat[k]
+            r, c = k // C, k % C
+            do = v > 0
+            idx = jnp.where(do, idx.at[c].set(r.astype(jnp.int32)), idx)
+            val = jnp.where(do, val.at[c].set(v), val)
+            dm = jnp.where(do, dm.at[r, :].set(NEG).at[:, c].set(NEG), dm)
+            return dm, idx, val
+
+        _, idx, val = lax.fori_loop(
+            0, min(R, C), body,
+            (d, jnp.full((C,), -1, jnp.int32), jnp.zeros((C,), d.dtype)))
+        if match_type == "per_prediction":
+            col_best = jnp.argmax(d, axis=0).astype(jnp.int32)
+            col_val = jnp.max(d, axis=0)
+            fill = jnp.logical_and(idx < 0, col_val >= dist_threshold)
+            idx = jnp.where(fill, col_best, idx)
+            val = jnp.where(fill, col_val, val)
+        return idx, val
+
+    idx, val = jax.vmap(one)(dist)
+    if squeeze:
+        idx, val = idx[0][None], val[0][None]  # reference emits [1, C]
+    ctx.set_out(op, "ColToRowMatchIndices", idx)
+    ctx.set_out(op, "ColToRowMatchDist", val)
+
+
+@register_lower("generate_proposals", "generate_proposals_v2")
+def _generate_proposals(ctx, op):
+    """RPN proposal generation (reference generate_proposals_op.cc):
+    per image, top pre_nms_topN anchor scores -> delta decode -> clip ->
+    min-size filter -> greedy NMS -> post_nms_topN, dense-padded."""
+    scores = ctx.in1(op, "Scores")        # [B, A, H, W]
+    deltas = ctx.in1(op, "BboxDeltas")    # [B, 4A, H, W]
+    im_info = ctx.in1(op, "ImInfo")
+    v1 = im_info is not None              # v1 carries (h, w, scale)
+    if im_info is None:
+        im_info = ctx.in1(op, "ImShape")  # v2: [B, 2] (h, w)
+    anchors = ctx.in1(op, "Anchors").reshape(-1, 4)    # [H*W*A, 4]
+    variances = ctx.in1(op, "Variances").reshape(-1, 4)
+    pre_n = int(op.attr("pre_nms_topN", 6000))
+    post_n = int(op.attr("post_nms_topN", 1000))
+    nms_thr = float(op.attr("nms_thresh", 0.5))
+    min_size = float(op.attr("min_size", 0.1))
+    eta = float(op.attr("eta", 1.0))
+    pixel_offset = bool(op.attr("pixel_offset", True))
+    off = 1.0 if pixel_offset else 0.0
+
+    B, A, H, W = scores.shape
+    N = A * H * W
+    # reference layout: scores/deltas transposed to (H, W, A[, 4]) to
+    # match the anchor tensor's flattening
+    sc = jnp.transpose(scores, (0, 2, 3, 1)).reshape(B, N)
+    dl = jnp.transpose(deltas.reshape(B, A, 4, H, W),
+                       (0, 3, 4, 1, 2)).reshape(B, N, 4)
+    pre_k = min(pre_n, N) if pre_n > 0 else N
+
+    def decode(anchor, var, d):
+        aw = anchor[2] - anchor[0] + off
+        ah = anchor[3] - anchor[1] + off
+        acx = anchor[0] + 0.5 * aw
+        acy = anchor[1] + 0.5 * ah
+        bbox_clip = jnp.log(1000.0 / 16.0)
+        cx = var[0] * d[0] * aw + acx
+        cy = var[1] * d[1] * ah + acy
+        w = jnp.exp(jnp.minimum(var[2] * d[2], bbox_clip)) * aw
+        h = jnp.exp(jnp.minimum(var[3] * d[3], bbox_clip)) * ah
+        return jnp.stack([cx - 0.5 * w, cy - 0.5 * h,
+                          cx + 0.5 * w - off, cy + 0.5 * h - off])
+
+    def one(s, d, info):
+        top_s, order = lax.top_k(s, pre_k)
+        props = jax.vmap(decode)(anchors[order], variances[order], d[order])
+        ih, iw = info[0], info[1]
+        props = jnp.stack([
+            jnp.clip(props[:, 0], 0, iw - off),
+            jnp.clip(props[:, 1], 0, ih - off),
+            jnp.clip(props[:, 2], 0, iw - off),
+            jnp.clip(props[:, 3], 0, ih - off)], axis=1)
+        # reference FilterBoxes: min_size clamps to >= 1 and v1 compares
+        # ORIGIN-scale extents ((x2-x1)/im_scale + 1) using im_info[2]
+        ms = max(min_size, 1.0)
+        if v1:
+            scale = info[2]
+            pw = (props[:, 2] - props[:, 0]) / scale + 1.0
+            ph = (props[:, 3] - props[:, 1]) / scale + 1.0
+        else:
+            pw = props[:, 2] - props[:, 0] + off
+            ph = props[:, 3] - props[:, 1] + off
+        valid = jnp.logical_and(pw >= ms, ph >= ms)
+        cand = jnp.where(valid, top_s, NEG)
+        keep = _greedy_nms_keep(props, cand > NEG / 2, nms_thr, eta,
+                                not pixel_offset)
+        sel = jnp.where(keep, cand, NEG)
+        kk = min(post_n, pre_k)
+        fs, fi = lax.top_k(sel, kk)
+        ok = fs > NEG / 2
+        rois = jnp.where(ok[:, None], props[fi], 0.0)
+        probs = jnp.where(ok, fs, 0.0)
+        return rois, probs[:, None], ok.sum().astype(jnp.int32)
+
+    rois, probs, count = jax.vmap(one)(sc, dl, im_info)
+    ctx.set_out(op, "RpnRois", rois)
+    ctx.set_out(op, "RpnRoiProbs", probs)
+    ctx.set_out(op, "RpnRoisNum", count)

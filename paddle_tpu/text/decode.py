@@ -89,10 +89,9 @@ def beam_search(step_fn, init_state, init_ids, beam_size, max_len, end_id,
         jnp.concatenate([jnp.zeros((1,), jnp.float32),
                          jnp.full((K - 1,), NEG)]), (B,)).reshape(B, K)
     finished = jnp.zeros((B, K), bool)
-    ids_buf = jnp.full((B, K, int(max_len)), end_id, jnp.int32)
 
-    def body(carry, t):
-        state, cur, log_probs, finished, ids_buf = carry
+    def body(carry, _):
+        state, cur, log_probs, finished = carry
         logits, state = step_fn(cur, state)
         V = logits.shape[-1]
         logp = jax.nn.log_softmax(logits.astype(jnp.float32)) \
@@ -105,17 +104,23 @@ def beam_search(step_fn, init_state, init_ids, beam_size, max_len, end_id,
         top_scores, top_idx = lax.top_k(total, K)  # [B, K]
         parent = top_idx // V
         token = (top_idx % V).astype(jnp.int32)
-        ids_buf = jnp.take_along_axis(ids_buf, parent[:, :, None], axis=1)
-        ids_buf = ids_buf.at[:, :, t].set(token)
         finished = jnp.take_along_axis(finished, parent, axis=1)
         finished = jnp.logical_or(finished, token == end_id)
         gidx = (jnp.arange(B)[:, None] * K + parent).reshape(-1)
         state = jax.tree.map(lambda v: v[gidx], state)
-        return (state, token.reshape(-1), top_scores, finished, ids_buf), None
+        return (state, token.reshape(-1), top_scores, finished), \
+            (token, parent.astype(jnp.int32))
 
-    carry0 = (state, cur, log_probs, finished, ids_buf)
-    (_, _, log_probs, finished, ids_buf), _ = lax.scan(
-        body, carry0, jnp.arange(int(max_len)))
+    carry0 = (state, cur, log_probs, finished)
+    (_, _, log_probs, finished), (toks, parents) = lax.scan(
+        body, carry0, None, length=int(max_len))
+
+    # single O(max_len) ancestry walk instead of re-gathering the whole
+    # ids buffer every step (shared with the beam_search_decode lowering)
+    from ..ops.linalg_ops import backtrack_beams
+
+    ids_buf = jnp.transpose(backtrack_beams(toks, parents),
+                            (1, 2, 0))  # [T, B, K] -> [B, K, T]
 
     # length = index of first EOS + 1, or max_len when never finished
     is_eos = ids_buf == end_id

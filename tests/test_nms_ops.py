@@ -1,0 +1,380 @@
+"""NMS / proposal / matching op parity vs numpy oracles.
+
+Parity model: reference detection/multiclass_nms_op.cc (NMSFast +
+MultiClassNMS), matrix_nms_op.cc, bipartite_match_op.cc,
+generate_proposals_op.cc — the oracles below re-implement the
+reference algorithms with plain loops; the lowerings must agree on the
+VALID rows (padding tails are checked for the -1/zero convention).
+"""
+import numpy as np
+
+from op_test import OpTest
+
+
+def _iou(a, b, off):
+    ax1, ay1, ax2, ay2 = a
+    bx1, by1, bx2, by2 = b
+    area_a = max(ax2 - ax1 + off, 0) * max(ay2 - ay1 + off, 0)
+    area_b = max(bx2 - bx1 + off, 0) * max(by2 - by1 + off, 0)
+    iw = max(min(ax2, bx2) - max(ax1, bx1) + off, 0)
+    ih = max(min(ay2, by2) - max(ay1, by1) + off, 0)
+    inter = iw * ih
+    union = area_a + area_b - inter
+    return inter / union if union > 0 else 0.0
+
+
+def _nms_fast(boxes, scores, score_thr, nms_top_k, iou_thr, eta, off):
+    """Reference NMSFast: returns kept original indices in score order."""
+    idx = [i for i in np.argsort(-scores, kind="stable")
+           if scores[i] > score_thr]
+    if nms_top_k > 0:
+        idx = idx[:nms_top_k]
+    kept = []
+    thr = iou_thr
+    for i in idx:
+        ok = all(_iou(boxes[i], boxes[j], off) <= thr for j in kept)
+        if ok:
+            kept.append(i)
+            if eta < 1.0 and thr > 0.5:
+                thr *= eta
+    return kept
+
+
+def _multiclass_nms_oracle(boxes, scores, background, score_thr,
+                           nms_top_k, iou_thr, eta, keep_top_k,
+                           normalized):
+    off = 0.0 if normalized else 1.0
+    dets = []
+    for c in range(scores.shape[0]):
+        if c == background:
+            continue
+        for i in _nms_fast(boxes, scores[c], score_thr, nms_top_k,
+                           iou_thr, eta, off):
+            dets.append((scores[c, i], c, i))
+    dets.sort(key=lambda t: -t[0])
+    if keep_top_k > 0:
+        dets = dets[:keep_top_k]
+    return dets  # (score, class, box index), sorted desc
+
+
+class TestMulticlassNms(OpTest):
+    op_type = "multiclass_nms2"
+
+    def setup(self):
+        rs = np.random.RandomState(3)
+        M, C, KEEP = 12, 4, 6
+        centers = rs.uniform(2, 18, (M, 2))
+        wh = rs.uniform(1.5, 5, (M, 2))
+        boxes = np.concatenate([centers - wh / 2, centers + wh / 2],
+                               axis=1).astype("f4")
+        scores = rs.uniform(0, 1, (C, M)).astype("f4")
+        attrs = dict(background_label=0, score_threshold=0.25,
+                     nms_top_k=10, nms_threshold=0.4, nms_eta=1.0,
+                     keep_top_k=KEEP, normalized=True)
+        dets = _multiclass_nms_oracle(boxes, scores, 0, 0.25, 10, 0.4,
+                                      1.0, KEEP, True)
+        out = np.zeros((1, KEEP, 6), "f4")
+        index = np.full((1, KEEP), -1, np.int32)
+        for k, (s, c, i) in enumerate(dets):
+            out[0, k] = [c, s, *boxes[i]]
+            index[0, k] = i
+        out[0, len(dets):, 0] = -1
+        self.inputs = {"BBoxes": [("b", boxes[None])],
+                       "Scores": [("s", scores[None])]}
+        self.attrs = attrs
+        self.outputs = {"Out": [("out", out)],
+                        "Index": [("idx", index)],
+                        "NmsRoisNum": [("n", np.array([len(dets)],
+                                                      np.int32))]}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+
+class TestMulticlassNmsEta(TestMulticlassNms):
+    """Adaptive eta < 1 decays the threshold after each kept box."""
+
+    def setup(self):
+        super().setup()
+        rs = np.random.RandomState(5)
+        boxes = np.asarray(self.inputs["BBoxes"][0][1][0])
+        scores = np.asarray(self.inputs["Scores"][0][1][0])
+        KEEP = 6
+        attrs = dict(self.attrs, nms_eta=0.9, nms_threshold=0.7)
+        dets = _multiclass_nms_oracle(boxes, scores, 0, 0.25, 10, 0.7,
+                                      0.9, KEEP, True)
+        out = np.zeros((1, KEEP, 6), "f4")
+        index = np.full((1, KEEP), -1, np.int32)
+        for k, (s, c, i) in enumerate(dets):
+            out[0, k] = [c, s, *boxes[i]]
+            index[0, k] = i
+        out[0, len(dets):, 0] = -1
+        self.attrs = attrs
+        self.outputs = {"Out": [("out", out)],
+                        "Index": [("idx", index)],
+                        "NmsRoisNum": [("n", np.array([len(dets)],
+                                                      np.int32))]}
+
+
+class TestMulticlassNmsEtaAdversarial(OpTest):
+    """Deterministic candidate-time-threshold case: IoU 0.66 boxes with
+    thr 0.7 decayed to 0.63 by eta=0.9 after the first keep — the second
+    box MUST be suppressed (keeper-time evaluation would keep it)."""
+    op_type = "multiclass_nms2"
+
+    def setup(self):
+        boxes = np.array([[0, 0, 10, 10], [0, 0, 10, 6.6]], "f4")
+        scores = np.array([[0.0, 0.0], [0.9, 0.8]], "f4")  # class 0 = bg
+        KEEP = 2
+        attrs = dict(background_label=0, score_threshold=0.1,
+                     nms_top_k=2, nms_threshold=0.7, nms_eta=0.9,
+                     keep_top_k=KEEP, normalized=True)
+        dets = _multiclass_nms_oracle(boxes, scores, 0, 0.1, 2, 0.7,
+                                      0.9, KEEP, True)
+        assert len(dets) == 1, dets  # oracle itself keeps only box 0
+        out = np.zeros((1, KEEP, 6), "f4")
+        index = np.full((1, KEEP), -1, np.int32)
+        for k, (s, c, i) in enumerate(dets):
+            out[0, k] = [c, s, *boxes[i]]
+            index[0, k] = i
+        out[0, len(dets):, 0] = -1
+        self.inputs = {"BBoxes": [("b", boxes[None])],
+                       "Scores": [("s", scores[None])]}
+        self.attrs = attrs
+        self.outputs = {"Out": [("out", out)],
+                        "Index": [("idx", index)],
+                        "NmsRoisNum": [("n", np.array([1], np.int32))]}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+
+class TestMatrixNms(OpTest):
+    op_type = "matrix_nms"
+
+    def setup(self):
+        rs = np.random.RandomState(7)
+        M, C, KEEP = 10, 3, 8
+        centers = rs.uniform(2, 18, (M, 2))
+        wh = rs.uniform(2, 6, (M, 2))
+        boxes = np.concatenate([centers - wh / 2, centers + wh / 2],
+                               axis=1).astype("f4")
+        scores = rs.uniform(0, 1, (C, M)).astype("f4")
+        sthr, pthr, topk = 0.2, 0.3, 8
+
+        dets = []
+        for c in range(C):
+            if c == 0:  # background
+                continue
+            idx = [i for i in np.argsort(-scores[c], kind="stable")
+                   if scores[c, i] > sthr][:topk]
+            srt = [scores[c, i] for i in idx]
+            n = len(idx)
+            ious = np.zeros((n, n))
+            for a in range(n):
+                for b in range(a):
+                    ious[a, b] = _iou(boxes[idx[a]], boxes[idx[b]], 0.0)
+            comp = np.array([ious[i, :i].max() if i else 0.0
+                             for i in range(n)])
+            for j in range(n):
+                decay = 1.0
+                for i in range(j):
+                    decay = min(decay,
+                                (1 - ious[j, i]) / (1 - comp[i]))
+                ds = srt[j] * decay
+                if ds > pthr:
+                    dets.append((ds, c, idx[j]))
+        dets.sort(key=lambda t: -t[0])
+        dets = dets[:KEEP]
+        out = np.zeros((1, KEEP, 6), "f4")
+        index = np.full((1, KEEP), -1, np.int32)
+        for k, (s, c, i) in enumerate(dets):
+            out[0, k] = [c, s, *boxes[i]]
+            index[0, k] = i
+        out[0, len(dets):, 0] = -1
+        self.inputs = {"BBoxes": [("b", boxes[None])],
+                       "Scores": [("s", scores[None])]}
+        self.attrs = dict(background_label=0, score_threshold=sthr,
+                          post_threshold=pthr, nms_top_k=topk,
+                          keep_top_k=KEEP, use_gaussian=False,
+                          gaussian_sigma=2.0, normalized=True)
+        self.outputs = {"Out": [("out", out)],
+                        "Index": [("idx", index)],
+                        "RoisNum": [("n", np.array([len(dets)],
+                                                   np.int32))]}
+
+    def test_output(self):
+        self.check_output(atol=1e-4)
+
+
+class TestBipartiteMatch(OpTest):
+    op_type = "bipartite_match"
+
+    def setup(self):
+        dist = np.array([[[0.5, 0.9, 0.3],
+                          [0.7, 0.2, 0.8]]], "f4")  # [1, 2 rows, 3 cols]
+        # greedy: max 0.9 -> col1=row0; mask row0/col1; max 0.8 ->
+        # col2=row1; no rows left -> col0 unmatched
+        idx = np.array([[-1, 0, 1]], np.int32)
+        val = np.array([[0.0, 0.9, 0.8]], "f4")
+        self.inputs = {"DistMat": [("d", dist)]}
+        self.attrs = {"match_type": "bipartite"}
+        self.outputs = {"ColToRowMatchIndices": [("i", idx)],
+                        "ColToRowMatchDist": [("v", val)]}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestBipartiteMatchPerPrediction(OpTest):
+    op_type = "bipartite_match"
+
+    def setup(self):
+        dist = np.array([[[0.5, 0.9, 0.3],
+                          [0.7, 0.2, 0.8]]], "f4")
+        # bipartite pass as above; per_prediction fills col0 with its
+        # argmax row 1 (0.7 >= 0.6)
+        idx = np.array([[1, 0, 1]], np.int32)
+        val = np.array([[0.7, 0.9, 0.8]], "f4")
+        self.inputs = {"DistMat": [("d", dist)]}
+        self.attrs = {"match_type": "per_prediction",
+                      "dist_threshold": 0.6}
+        self.outputs = {"ColToRowMatchIndices": [("i", idx)],
+                        "ColToRowMatchDist": [("v", val)]}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestGenerateProposals(OpTest):
+    op_type = "generate_proposals"
+
+    def setup(self):
+        rs = np.random.RandomState(11)
+        A, H, W = 3, 4, 4
+        N = A * H * W
+        POST = 8
+        scores = rs.uniform(0, 1, (1, A, H, W)).astype("f4")
+        deltas = (rs.randn(1, 4 * A, H, W) * 0.2).astype("f4")
+        im_info = np.array([[40.0, 40.0, 1.0]], "f4")
+        # anchors laid out [H, W, A, 4]
+        anchors = np.zeros((H, W, A, 4), "f4")
+        for y in range(H):
+            for x in range(W):
+                for a in range(A):
+                    size = 6 + 4 * a
+                    cx, cy = x * 10 + 5, y * 10 + 5
+                    anchors[y, x, a] = [cx - size / 2, cy - size / 2,
+                                        cx + size / 2, cy + size / 2]
+        variances = np.full((H, W, A, 4), 0.5, "f4")
+
+        # oracle
+        sc = scores[0].transpose(1, 2, 0).reshape(N)
+        dl = deltas[0].reshape(A, 4, H, W).transpose(2, 3, 0, 1) \
+            .reshape(N, 4)
+        anc = anchors.reshape(N, 4)
+        var = variances.reshape(N, 4)
+        order = np.argsort(-sc, kind="stable")
+        props, vals = [], []
+        for i in order:
+            aw = anc[i, 2] - anc[i, 0] + 1
+            ah = anc[i, 3] - anc[i, 1] + 1
+            acx, acy = anc[i, 0] + aw / 2, anc[i, 1] + ah / 2
+            clipv = np.log(1000.0 / 16.0)
+            cx = var[i, 0] * dl[i, 0] * aw + acx
+            cy = var[i, 1] * dl[i, 1] * ah + acy
+            w = np.exp(min(var[i, 2] * dl[i, 2], clipv)) * aw
+            h = np.exp(min(var[i, 3] * dl[i, 3], clipv)) * ah
+            box = [cx - w / 2, cy - h / 2, cx + w / 2 - 1, cy + h / 2 - 1]
+            box = [np.clip(box[0], 0, 39), np.clip(box[1], 0, 39),
+                   np.clip(box[2], 0, 39), np.clip(box[3], 0, 39)]
+            bw, bh = box[2] - box[0] + 1, box[3] - box[1] + 1
+            if bw >= 3.0 and bh >= 3.0:
+                props.append(box)
+                vals.append(sc[i])
+        kept = _nms_fast(np.array(props), np.array(vals), -1e9, -1, 0.6,
+                         1.0, 1.0)[:POST]
+        rois = np.zeros((1, POST, 4), "f4")
+        probs = np.zeros((1, POST, 1), "f4")
+        for k, i in enumerate(kept):
+            rois[0, k] = props[i]
+            probs[0, k, 0] = vals[i]
+        self.inputs = {"Scores": [("s", scores)],
+                       "BboxDeltas": [("d", deltas)],
+                       "ImInfo": [("ii", im_info)],
+                       "Anchors": [("a", anchors)],
+                       "Variances": [("v", variances)]}
+        self.attrs = {"pre_nms_topN": N, "post_nms_topN": POST,
+                      "nms_thresh": 0.6, "min_size": 3.0, "eta": 1.0}
+        self.outputs = {"RpnRois": [("r", rois)],
+                        "RpnRoiProbs": [("p", probs)],
+                        "RpnRoisNum": [("n", np.array([len(kept)],
+                                                      np.int32))]}
+
+    def test_output(self):
+        self.check_output(atol=1e-4)
+
+
+def test_ssd_head_end_to_end():
+    """Detector head through the public API: prior_box -> box_coder ->
+    multiclass_nms over a conv feature, on the Executor."""
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    from paddle_tpu.layer_helper import LayerHelper
+    from paddle_tpu.framework.program import Program, program_guard
+
+    rs = np.random.RandomState(0)
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        feat = layers.data("feat", [8, 4, 4])     # [B, C, H, W]
+        img = layers.data("img", [3, 32, 32])
+        loc = layers.data("loc", [48, 4])         # predicted offsets
+        conf = layers.data("conf", [3, 48])       # class scores
+        h = LayerHelper("ssd")
+        pb = h.create_variable_for_type_inference()
+        pbv = h.create_variable_for_type_inference()
+        h.append_op("prior_box", {"Input": [feat.name], "Image": [img.name]},
+                    {"Boxes": [pb.name], "Variances": [pbv.name]},
+                    {"min_sizes": [4.0], "aspect_ratios": [1.0, 2.0],
+                     "variances": [0.1, 0.1, 0.2, 0.2], "flip": True,
+                     "clip": True})
+        # prior_box gives [H, W, n_prior, 4] = [4, 4, 3, 4] -> 48 boxes
+        pb2 = layers.reshape(pb, [-1, 4])
+        pbv2 = layers.reshape(pbv, [-1, 4])
+        dec = h.create_variable_for_type_inference()
+        h.append_op("box_coder",
+                    {"PriorBox": [pb2.name], "PriorBoxVar": [pbv2.name],
+                     "TargetBox": [loc.name]},
+                    {"OutputBox": [dec.name]},
+                    {"code_type": "decode_center_size", "axis": 0,
+                     "box_normalized": True})
+        out = h.create_variable_for_type_inference()
+        idx = h.create_variable_for_type_inference()
+        cnt = h.create_variable_for_type_inference()
+        h.append_op("multiclass_nms2",
+                    {"BBoxes": [dec.name], "Scores": [conf.name]},
+                    {"Out": [out.name], "Index": [idx.name],
+                     "NmsRoisNum": [cnt.name]},
+                    {"background_label": 0, "score_threshold": 0.3,
+                     "nms_top_k": 16, "nms_threshold": 0.45,
+                     "keep_top_k": 10, "normalized": True})
+    exe = pt.Executor(pt.CPUPlace())
+    res = exe.run(main, feed={
+        "feat": rs.randn(1, 8, 4, 4).astype("f4"),
+        "img": rs.randn(1, 3, 32, 32).astype("f4"),
+        "loc": (rs.randn(48, 4) * 0.1).astype("f4"),
+        "conf": rs.uniform(0, 1, (3, 48)).astype("f4"),
+    }, fetch_list=[out, idx, cnt])
+    o, ix, n = (np.asarray(v) for v in res)
+    n = int(n.reshape(-1)[0])
+    assert o.shape == (10, 6) or o.shape == (1, 10, 6)
+    o = o.reshape(-1, 6)
+    ix = ix.reshape(-1)
+    assert 0 < n <= 10
+    # valid rows first: class >= 1, scores above threshold and sorted
+    assert (o[:n, 0] >= 1).all()
+    assert (o[:n, 1] > 0.3).all()
+    assert (np.diff(o[:n, 1]) <= 1e-6).all()
+    assert (ix[:n] >= 0).all()
+    # padding rows carry the -1 class marker
+    assert (o[n:, 0] == -1).all()
