@@ -56,14 +56,18 @@ class _ProgramRecorder:
         if name is not None:
             return name
         # first sighting mid-trace: a parameter or a captured constant —
-        # either way it becomes persistable state saved with the model
+        # either way it becomes persistable state saved with the model.
+        # Declared in the ROOT block even when captured inside a
+        # cond/while sub-block: persistable state is global, and the
+        # export path only saves root-block vars.
         if getattr(t, "persistable", False) and t.name:
             name = t.name
         else:
             name = unique_name.generate("trace_const")
-        self.block.create_var(name=name, shape=list(t.shape),
-                              dtype=str(np.dtype(t._value.dtype)),
-                              persistable=True, stop_gradient=True)
+        self.program.global_block.create_var(
+            name=name, shape=list(t.shape),
+            dtype=str(np.dtype(t._value.dtype)),
+            persistable=True, stop_gradient=True)
         self._names[id(t)] = name
         self._keep.append(t)
         self.param_values[name] = np.asarray(t._value)
@@ -87,6 +91,33 @@ class _ProgramRecorder:
 
     def name_of(self, t: Tensor) -> Optional[str]:
         return self._names.get(id(t))
+
+    # -- control-flow capture (dy2static convert shims) ----------------
+    def ensure_name(self, t: Tensor) -> str:
+        """Var name for ``t``, registering it as a captured constant if
+        the trace has not seen it (same policy as op-input capture)."""
+        return self._var_for(t)
+
+    def bind(self, t: Tensor, name: str):
+        """Re-point ``t`` at ``name`` (e.g. a cond/while output var)."""
+        self._names[id(t)] = name
+        self._keep.append(t)
+
+    def new_parent_var(self, parent, t: Tensor) -> str:
+        name = unique_name.generate("ctrl_out")
+        parent.create_var(name=name, shape=list(t.shape),
+                          dtype=str(np.dtype(t._value.dtype)),
+                          stop_gradient=False)
+        return name
+
+    def begin_sub_block(self):
+        sub = self.program._create_block()
+        self.block = sub
+        return sub
+
+    def end_sub_block(self, parent):
+        self.program._rollback()
+        self.block = parent
 
     # -- op recording --------------------------------------------------
     def record(self, op_type: str, tensor_inputs: Dict[str, List[Tensor]],
@@ -139,7 +170,14 @@ def _as_tensors(inputs):
 
 def trace(layer_or_fn, inputs):
     """Run ``layer_or_fn(*inputs)`` once, recording every op into a
-    Program.  Returns (outputs, recorder)."""
+    Program.  Returns (outputs, recorder).
+
+    The callable is AST-converted first (dy2static), so python
+    ``if``/``while``/``for`` over tensor values record real
+    cond/while ops instead of baking in the traced branch."""
+    from .dy2static import convert_callable
+
+    layer_or_fn = convert_callable(layer_or_fn)
     inputs = _as_tensors(list(inputs))
     rec = _ProgramRecorder()
     for t in inputs:
@@ -221,7 +259,9 @@ class StaticFunction:
     dygraph_to_static ProgramTranslator, trace-based instead of AST)."""
 
     def __init__(self, fn, input_spec=None):
-        self._fn = fn
+        from .dy2static import convert_callable
+
+        self._fn = convert_callable(fn)
         self._input_spec = input_spec
         self._traced: Dict[tuple, TracedLayer] = {}
 

@@ -124,8 +124,21 @@ def prune_program(program: Program, feed_names, target_names,
     feed_names (reference framework/prune.cc via Executor.run(use_prune)).
     Unreferenced vars (e.g. optimizer state) are dropped too, so the slice
     carries exactly the serving surface.  One clone total."""
+    from ..framework.executor import _ctrl_attr_reads, _sub_external_reads
+
     pruned = program.clone(for_test=for_test)
     block = pruned.global_block
+
+    def op_reads(op):
+        # control-flow ops read their sub-blocks' closures (captured
+        # consts/params) and unwritten branch outputs, not just explicit
+        # input slots — dropping those breaks the exported params set
+        reads = list(op.input_arg_names()) + _ctrl_attr_reads(pruned, op)
+        for aname in ("sub_block", "sub_block_t", "sub_block_f"):
+            if op.has_attr(aname):
+                reads.extend(_sub_external_reads(pruned, int(op.attr(aname))))
+        return reads
+
     feed_set = set(feed_names)
     needed = set(target_names)
     kept = []
@@ -134,13 +147,13 @@ def prune_program(program: Program, feed_names, target_names,
             continue
         if set(op.output_arg_names()) & needed:
             kept.append(op)
-            for n in op.input_arg_names():
+            for n in op_reads(op):
                 if n not in feed_set:
                     needed.add(n)
     block.ops[:] = list(reversed(kept))
     referenced = set(feed_set) | set(target_names)
     for op in block.ops:
-        referenced.update(op.input_arg_names())
+        referenced.update(op_reads(op))
         referenced.update(op.output_arg_names())
     block.vars = {n: v for n, v in block.vars.items() if n in referenced}
     pruned._bump()

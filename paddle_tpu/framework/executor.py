@@ -101,6 +101,33 @@ class _Compiled:
     n_calls: int = 0
 
 
+def _block_written(program, block_idx: int) -> set:
+    """All names written anywhere inside a block (incl. nested blocks)."""
+    sub = program.blocks[block_idx]
+    out: set = set()
+    for sop in sub.ops:
+        out.update(sop.output_arg_names())
+        for aname in ("sub_block", "sub_block_t", "sub_block_f"):
+            if sop.has_attr(aname):
+                out |= _block_written(program, int(sop.attr(aname)))
+    return out
+
+
+def _ctrl_attr_reads(program, op) -> List[str]:
+    """cond_pair branch-output names that are NOT produced inside the
+    branch (a branch returning an unchanged outer var / captured const):
+    the lowering reads them from the env, so they are external reads."""
+    reads: List[str] = []
+    if op.type == "cond_pair":
+        for aname, sb in (("t_outs", "sub_block_t"),
+                          ("f_outs", "sub_block_f")):
+            written = _block_written(program, int(op.attr(sb)))
+            for n in (op.attr(aname, []) or []):
+                if n not in written:
+                    reads.append(n)
+    return reads
+
+
 def _sub_external_reads(program, block_idx: int) -> List[str]:
     """Names a sub-block reads from its surroundings (closures for the
     lax.while_loop/lax.cond lowering)."""
@@ -108,7 +135,7 @@ def _sub_external_reads(program, block_idx: int) -> List[str]:
     local_written: set = set()
     ext: List[str] = []
     for sop in sub.ops:
-        for n in sop.input_arg_names():
+        for n in sop.input_arg_names() + _ctrl_attr_reads(program, sop):
             if n not in local_written and n not in ext:
                 ext.append(n)
         for aname in ("sub_block", "sub_block_t", "sub_block_f"):
@@ -134,6 +161,7 @@ def _prune_ops(program, fetch_names):
         if set(op.output_arg_names()) & needed:
             keep.append(op)
             needed.update(op.input_arg_names())
+            needed.update(_ctrl_attr_reads(program, op))
             for aname in ("sub_block", "sub_block_t", "sub_block_f"):
                 if op.has_attr(aname):
                     needed.update(
@@ -478,7 +506,8 @@ class Executor:
             for op in op_list:
                 if op.type in PSEUDO_OPS:
                     continue
-                reads = list(op.input_arg_names())
+                reads = list(op.input_arg_names()) \
+                    + _ctrl_attr_reads(program, op)
                 for aname in ("sub_block", "sub_block_t", "sub_block_f"):
                     if op.has_attr(aname):
                         reads.extend(

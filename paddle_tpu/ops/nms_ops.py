@@ -33,7 +33,7 @@ from jax import lax
 
 from ..framework.lowering import register_lower
 
-NEG = jnp.float32(-1e9)
+NEG = -1e9  # python float: no backend touch at import time
 
 
 def _pairwise_iou(boxes, normalized):
