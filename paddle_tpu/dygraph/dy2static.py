@@ -407,6 +407,20 @@ def _user_names(names):
     return [n for n in names if not n.startswith(_GEN_PREFIXES)]
 
 
+def _contains_break_or_continue(stmts) -> bool:
+    """break/continue belonging to THIS loop level (nested loops and
+    function defs own theirs)."""
+    def scan(node) -> bool:
+        if isinstance(node, (ast.Break, ast.Continue)):
+            return True
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.For, ast.While)):
+            return False
+        return any(scan(c) for c in ast.iter_child_nodes(node))
+
+    return any(scan(s) for s in stmts)
+
+
 def _contains_return(stmts) -> bool:
     """True if a `return` occurs at THIS function's level — nested
     function defs (incl. converted _pt_* branch functions) open their
@@ -601,6 +615,11 @@ class _Dy2StaticTransformer(ast.NodeTransformer):
         if node.orelse:
             raise NotImplementedError(
                 "to_static does not support while/else")
+        if _contains_return(node.body):
+            raise NotImplementedError(
+                "to_static does not support `return` inside a converted "
+                "while loop body; assign to a variable and return after "
+                "the loop")
         i = self._next()
         rw = _BreakContinueRewriter(i)
         body, _ = rw.rewrite(node.body)
@@ -636,11 +655,21 @@ class _Dy2StaticTransformer(ast.NodeTransformer):
         it = node.iter
         if not (isinstance(it, ast.Call) and isinstance(it.func, ast.Name)
                 and it.func.id == "range"):
-            self.generic_visit(node)
-            return node  # iteration over python containers stays python
+            # iteration over python containers stays python — and if the
+            # body breaks/continues, its ifs must stay python too (a
+            # break moved into a generated branch function would be a
+            # SyntaxError at compile time)
+            if not _contains_break_or_continue(node.body):
+                self.generic_visit(node)
+            return node
         if not isinstance(node.target, ast.Name):
             raise NotImplementedError(
                 "to_static for-range needs a simple loop variable")
+        if _contains_return(node.body):
+            raise NotImplementedError(
+                "to_static does not support `return` inside a converted "
+                "for-range loop body; assign to a variable and return "
+                "after the loop")
         i = self._next()
         var = node.target.id
         a = [ast.unparse(x) for x in it.args]

@@ -25,6 +25,7 @@ from . import (  # noqa: F401
     rnn_ops,
     sampling_ops,
     sequence_ops,
+    tail_ops,
     tensor_ops,
     vision_ops,
 )

@@ -9,11 +9,23 @@ from ..framework import dtypes
 
 
 def attr_dtype(op, name="dtype", default="float32"):
-    """Resolve a dtype attribute (IR enum int or string) to a jnp dtype."""
+    """Resolve a dtype attribute (IR enum int or string) to a jnp dtype.
+
+    64-bit integer/float requests collapse to their 32-bit forms
+    explicitly: x64 is disabled on TPU, so jax would truncate anyway —
+    this makes the documented int32/float32 contract silent instead of
+    a per-op UserWarning."""
     v = op.attr(name, None)
     if v is None or v == 0:
-        return jnp.dtype(default)
-    return dtypes.to_jnp(v)
+        dt = jnp.dtype(default)
+    else:
+        dt = dtypes.to_jnp(v)
+    if not jax.config.read("jax_enable_x64"):
+        dt = {jnp.dtype("int64"): jnp.dtype("int32"),
+              jnp.dtype("uint64"): jnp.dtype("uint32"),
+              jnp.dtype("float64"): jnp.dtype("float32")}.get(
+            jnp.dtype(dt), dt)
+    return dt
 
 
 def op_seed_key(ctx, op, per_shard=False):

@@ -155,7 +155,7 @@ def _shuffle_batch(ctx, op):
     x = ctx.in1(op, "X")
     perm = jax.random.permutation(ctx.next_key(), x.shape[0])
     ctx.set_out(op, "Out", x[perm])
-    ctx.set_out(op, "ShuffleIdx", perm.astype(jnp.int64))
+    ctx.set_out(op, "ShuffleIdx", perm.astype(jnp.int32))
 
 
 @register_lower("batch_fc")
@@ -312,7 +312,7 @@ def _histogram(ctx, op):
             "histogram needs explicit min/max attrs on TPU (data-dependent "
             "range is not XLA-static)")
     h, _ = jnp.histogram(x.reshape(-1), bins=bins, range=(lo, hi))
-    ctx.set_out(op, "Out", h.astype(jnp.int64))
+    ctx.set_out(op, "Out", h.astype(jnp.int32))
 
 
 @register_lower("bincount")

@@ -75,7 +75,7 @@ def _nce(ctx, op):
                 jnp.concatenate([true_logit, noise_logit], axis=1))
     ctx.set_out(op, "SampleLabels", jnp.concatenate(
         [lbl, jnp.broadcast_to(samples[None], (bsz, n_neg))],
-        axis=1).astype(jnp.int64))
+        axis=1).astype(jnp.int32))
 
 
 @register_lower("sample_logits")
@@ -108,11 +108,11 @@ def _sample_logits(ctx, op):
     ctx.set_out(op, "SampledLogits", picked - logq)
     ctx.set_out(op, "SampledLabels",
                 jnp.broadcast_to(jnp.arange(t)[None], (bsz, t))
-                .astype(jnp.int64))
-    ctx.set_out(op, "Samples", all_idx.astype(jnp.int64))
+                .astype(jnp.int32))
+    ctx.set_out(op, "Samples", all_idx.astype(jnp.int32))
     ctx.set_out(op, "Probabilities", jnp.exp(logq))
-    ctx.set_out(op, "LogitsDim", jnp.asarray(logits.shape, jnp.int64))
-    ctx.set_out(op, "LabelsDim", jnp.asarray(label.shape, jnp.int64))
+    ctx.set_out(op, "LogitsDim", jnp.asarray(logits.shape, jnp.int32))
+    ctx.set_out(op, "LabelsDim", jnp.asarray(label.shape, jnp.int32))
 
 
 @register_lower("correlation")

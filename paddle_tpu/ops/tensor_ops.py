@@ -295,7 +295,7 @@ def _top_k(ctx, op):
         vals = jnp.moveaxis(vals, -1, axis)
         idx = jnp.moveaxis(idx, -1, axis)
     ctx.set_out(op, "Out", vals)
-    ctx.set_out(op, "Indices", idx.astype(jnp.int64))
+    ctx.set_out(op, "Indices", idx.astype(jnp.int32))
 
 
 @register_lower("arg_max")
@@ -331,7 +331,7 @@ def _argsort(ctx, op):
     idx = jnp.argsort(-x if desc else x, axis=axis)
     out = jnp.take_along_axis(x, idx, axis=axis)
     ctx.set_out(op, "Out", out)
-    ctx.set_out(op, "Indices", idx.astype(jnp.int64))
+    ctx.set_out(op, "Indices", idx.astype(jnp.int32))
 
 
 @register_lower("where")
@@ -342,13 +342,7 @@ def _where(ctx, op):
     ctx.set_out(op, "Out", jnp.where(cond, x, y))
 
 
-@register_lower("where_index")
-def _where_index(ctx, op):
-    # dynamic output shape: unsupported under XLA static shapes
-    raise NotImplementedError(
-        "where_index (nonzero) has data-dependent output shape; "
-        "use masking instead on TPU"
-    )
+# where_index lives in tail_ops.py (masked fixed-size lowering).
 
 
 @register_lower("one_hot", "one_hot_v2")

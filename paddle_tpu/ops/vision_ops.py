@@ -305,7 +305,7 @@ def _roi_pool(ctx, op):
 
     out = jax.vmap(one_roi)(rois, batch_idx)
     ctx.set_out(op, "Out", out)
-    ctx.set_out(op, "Argmax", jnp.zeros(out.shape, jnp.int64))
+    ctx.set_out(op, "Argmax", jnp.zeros(out.shape, jnp.int32))
 
 
 @register_lower("conv3d")
@@ -389,7 +389,9 @@ def _max_pool2d_with_index(ctx, op):
     ws = (jnp.arange(ow) * strides[1] - paddings[1])[None, :]
     flat = (hs + arg // kw) * w + (ws + arg % kw)
     ctx.set_out(op, "Out", out)
-    ctx.set_out(op, "Mask", flat.astype(jnp.int64))
+    # int32: x64 is disabled on TPU; an int64 annotation would
+    # silently truncate anyway (documented contract)
+    ctx.set_out(op, "Mask", flat.astype(jnp.int32))
 
 
 @register_lower("im2sequence")

@@ -321,6 +321,39 @@ def test_zero_trip_range_keeps_existing_var():
         np.testing.assert_allclose(np.asarray(tl(x)[0]._value), [5.0, 5.0])
 
 
+def test_return_inside_loop_is_loud():
+    def f(x):
+        acc = x * 0.0
+        for i in range(3):
+            acc = acc + x
+            if acc.sum() > 1.0:
+                return acc
+        return acc
+
+    with dygraph.guard():
+        x = dygraph.to_variable(np.ones((2,), "f4"))
+        with pytest.raises(NotImplementedError, match="loop"):
+            djit.TracedLayer.trace(f, [x])
+
+
+def test_container_for_with_break_stays_python():
+    """break under an if inside a python-container loop must not be
+    moved into a generated branch function (SyntaxError regression)."""
+    def f(x):
+        acc = x * 0.0
+        for w in [1.0, 2.0, 3.0]:
+            acc = acc + x * w
+            if float(np.asarray(acc._value).sum()) > 4.0:
+                break
+        return acc
+
+    with dygraph.guard():
+        x = dygraph.to_variable(np.ones((2,), "f4"))
+        eager = np.asarray(f(x)._value)
+        _, tl = djit.TracedLayer.trace(f, [x])
+        np.testing.assert_allclose(np.asarray(tl(x)[0]._value), eager)
+
+
 def test_static_mode_variable_dispatch():
     """convert shims route framework Variables to layers.cond."""
     from paddle_tpu import layers

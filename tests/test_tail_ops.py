@@ -1,0 +1,306 @@
+"""Op-tail parity vs numpy oracles: CRF, spectral_norm, pool3d-with-
+index, psroi/prroi pooling, padded select family, sequence_scatter.
+
+Parity model: reference linear_chain_crf_op.h ForwardOneSequence,
+crf_decoding_op.h Decode, spectral_norm_op.h, pool_with_index_op.cc,
+psroi_pool_op.h, index_sample_op.cc, masked_select_op.cc,
+where_index_op.cc, sequence_scatter_op.cc.
+"""
+import itertools
+
+import numpy as np
+
+from op_test import OpTest
+
+
+class TestIndexSample(OpTest):
+    op_type = "index_sample"
+
+    def setup(self):
+        rs = np.random.RandomState(0)
+        x = rs.randn(3, 8).astype("f4")
+        idx = rs.randint(0, 8, (3, 4)).astype("i4")
+        out = np.take_along_axis(x, idx, axis=1)
+        self.inputs = {"X": [("x", x)], "Index": [("i", idx)]}
+        self.outputs = {"Out": [("o", out)]}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["x"], "Out", max_relative_error=0.01)
+
+
+class TestMaskedSelect(OpTest):
+    op_type = "masked_select"
+
+    def setup(self):
+        rs = np.random.RandomState(1)
+        x = rs.randn(3, 4).astype("f4")
+        mask = rs.rand(3, 4) > 0.5
+        flat = x.ravel()
+        sel = flat[mask.ravel()]
+        y = np.zeros(12, "f4")
+        y[:sel.size] = sel
+        self.inputs = {"X": [("x", x)], "Mask": [("m", mask)]}
+        self.outputs = {"Y": [("y", y)],
+                        "Count": [("c", np.int32(sel.size))]}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestWhereIndex(OpTest):
+    op_type = "where_index"
+
+    def setup(self):
+        cond = np.array([[True, False, True], [False, True, False]])
+        out = np.full((6, 2), -1, np.int32)
+        coords = np.argwhere(cond)
+        out[:coords.shape[0]] = coords
+        self.inputs = {"Condition": [("c", cond)]}
+        self.outputs = {"Out": [("o", out)],
+                        "Count": [("n", np.int32(coords.shape[0]))]}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestSequenceScatter(OpTest):
+    op_type = "sequence_scatter"
+
+    def setup(self):
+        x = np.zeros(6, "f4")
+        ids = np.array([1, 3, 3, 5], np.int32)
+        upd = np.array([1.0, 2.0, 4.0, 8.0], "f4")
+        out = x.copy()
+        np.add.at(out, ids, upd)
+        self.inputs = {"X": [("x", x)], "Ids": [("i", ids)],
+                       "Updates": [("u", upd)]}
+        self.outputs = {"Out": [("o", out)]}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestSpectralNorm(OpTest):
+    op_type = "spectral_norm"
+
+    def setup(self):
+        rs = np.random.RandomState(3)
+        w = rs.randn(4, 6).astype("f4")
+        u = rs.randn(4).astype("f4")
+        v = rs.randn(6).astype("f4")
+        iters, eps = 3, 1e-12
+        uu, vv = u.copy(), v.copy()
+        for _ in range(iters):
+            vv = w.T @ uu
+            vv /= np.linalg.norm(vv) + eps
+            uu = w @ vv
+            uu /= np.linalg.norm(uu) + eps
+        sigma = uu @ w @ vv
+        self.inputs = {"Weight": [("w", w)], "U": [("u", u)],
+                       "V": [("v", v)]}
+        self.attrs = {"dim": 0, "power_iters": iters, "eps": eps}
+        self.outputs = {"Out": [("o", w / sigma)]}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+
+class TestMaxPool3dWithIndex(OpTest):
+    op_type = "max_pool3d_with_index"
+
+    def setup(self):
+        rs = np.random.RandomState(4)
+        x = rs.randn(1, 2, 4, 4, 4).astype("f4")
+        k, s = 2, 2
+        D = H = W = 4
+        oD = oH = oW = 2
+        out = np.zeros((1, 2, oD, oH, oW), "f4")
+        mask = np.zeros((1, 2, oD, oH, oW), np.int32)
+        for c in range(2):
+            for d, h, w in itertools.product(range(oD), range(oH),
+                                             range(oW)):
+                blk = x[0, c, d*s:d*s+k, h*s:h*s+k, w*s:w*s+k]
+                out[0, c, d, h, w] = blk.max()
+                off = np.unravel_index(blk.argmax(), blk.shape)
+                mask[0, c, d, h, w] = ((d*s+off[0]) * H + h*s+off[1]) * W \
+                    + w*s + off[2]
+        self.inputs = {"X": [("x", x)]}
+        self.attrs = {"ksize": [k]*3, "strides": [s]*3,
+                      "paddings": [0]*3}
+        self.outputs = {"Out": [("o", out)], "Mask": [("m", mask)]}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestPsroiPool(OpTest):
+    op_type = "psroi_pool"
+
+    def setup(self):
+        rs = np.random.RandomState(5)
+        OC, PH, PW = 2, 2, 2
+        C = OC * PH * PW
+        x = rs.randn(1, C, 8, 8).astype("f4")
+        rois = np.array([[0.0, 0.0, 5.0, 5.0]], "f4")
+        out = np.zeros((1, OC, PH, PW), "f4")
+        # oracle mirrors psroi_pool_op.h with spatial_scale=1
+        x1, y1 = round(0.0) * 1.0, round(0.0) * 1.0
+        x2, y2 = round(5.0 + 1) * 1.0, round(5.0 + 1) * 1.0
+        bw = max(x2 - x1, 0.1) / PW
+        bh = max(y2 - y1, 0.1) / PH
+        for c in range(OC):
+            for ph in range(PH):
+                for pw in range(PW):
+                    hs = int(np.floor(y1 + ph * bh))
+                    he = int(np.ceil(y1 + (ph + 1) * bh))
+                    ws = int(np.floor(x1 + pw * bw))
+                    we = int(np.ceil(x1 + (pw + 1) * bw))
+                    hs, he = max(hs, 0), min(he, 8)
+                    ws, we = max(ws, 0), min(we, 8)
+                    ch = c * PH * PW + ph * PW + pw
+                    blk = x[0, ch, hs:he, ws:we]
+                    out[0, c, ph, pw] = blk.mean() if blk.size else 0.0
+        self.inputs = {"X": [("x", x)], "ROIs": [("r", rois)]}
+        self.attrs = {"output_channels": OC, "pooled_height": PH,
+                      "pooled_width": PW, "spatial_scale": 1.0}
+        self.outputs = {"Out": [("o", out)]}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+
+class TestPrroiPoolRunsAndBounds(OpTest):
+    """prroi_pool is documented as a dense-sample approximation of the
+    bilinear integral; parity check = within-range + constant-field
+    exactness (integral of a constant is the constant)."""
+    op_type = "prroi_pool"
+
+    def setup(self):
+        x = np.full((1, 3, 8, 8), 2.5, "f4")
+        rois = np.array([[1.0, 1.0, 6.0, 6.0]], "f4")
+        out = np.full((1, 3, 2, 2), 2.5, "f4")
+        self.inputs = {"X": [("x", x)], "ROIs": [("r", rois)]}
+        self.attrs = {"pooled_height": 2, "pooled_width": 2,
+                      "spatial_scale": 1.0}
+        self.outputs = {"Out": [("o", out)]}
+
+    def test_output(self):
+        self.check_output(atol=1e-4)
+
+
+def _np_crf_ll(emission, label, trans_full, length):
+    """logZ - path_score, start/stop in rows 0/1 (linear_chain_crf_op.h)."""
+    start, stop, trans = trans_full[0], trans_full[1], trans_full[2:]
+    n = length
+    alpha = start + emission[0]
+    for t in range(1, n):
+        alpha = np.array([
+            np.logaddexp.reduce(alpha + trans[:, j]) + emission[t, j]
+            for j in range(trans.shape[1])])
+    logz = np.logaddexp.reduce(alpha + stop)
+    path = start[label[0]] + emission[np.arange(n), label[:n]].sum() \
+        + trans[label[:n - 1], label[1:n]].sum() + stop[label[n - 1]]
+    return logz - path
+
+
+class TestLinearChainCrf(OpTest):
+    op_type = "linear_chain_crf"
+
+    def setup(self):
+        rs = np.random.RandomState(7)
+        B, T, D = 2, 5, 3
+        em = rs.randn(B, T, D).astype("f4")
+        trans = (rs.randn(D + 2, D) * 0.5).astype("f4")
+        label = rs.randint(0, D, (B, T)).astype("i4")
+        lens = np.array([5, 3], np.int32)
+        ll = np.array([[_np_crf_ll(em[b], label[b], trans, lens[b])]
+                       for b in range(B)], "f4")
+        self.inputs = {"Emission": [("e", em)],
+                       "Transition": [("t", trans)],
+                       "Label": [("l", label)],
+                       "Length": [("n", lens)]}
+        self.outputs = {"LogLikelihood": [("ll", ll)]}
+
+    def test_output(self):
+        self.check_output(no_check_set=["Alpha", "EmissionExps",
+                                        "TransitionExps"], atol=1e-4)
+
+
+class TestCrfDecoding(OpTest):
+    op_type = "crf_decoding"
+
+    def setup(self):
+        rs = np.random.RandomState(8)
+        B, T, D = 2, 5, 3
+        em = rs.randn(B, T, D).astype("f4")
+        trans = (rs.randn(D + 2, D) * 0.5).astype("f4")
+        lens = np.array([5, 3], np.int32)
+        start, stop, tr = trans[0], trans[1], trans[2:]
+
+        paths = np.zeros((B, T), np.int32)
+        for b in range(B):
+            n = lens[b]
+            score = start + em[b, 0]
+            back = np.zeros((n, D), np.int32)
+            for t in range(1, n):
+                cand = score[:, None] + tr
+                back[t] = cand.argmax(0)
+                score = cand.max(0) + em[b, t]
+            cur = int((score + stop).argmax())
+            for t in range(n - 1, -1, -1):
+                paths[b, t] = cur
+                if t > 0:
+                    cur = int(back[t][cur])
+        self.inputs = {"Emission": [("e", em)],
+                       "Transition": [("t", trans)],
+                       "Length": [("n", lens)]}
+        self.outputs = {"ViterbiPath": [("p", paths)]}
+
+    def test_output(self):
+        self.check_output()
+
+
+def test_crf_trains_end_to_end():
+    """CRF loss decreases when the transition/emission params train
+    (the generic-vjp gradient path through logsumexp scans)."""
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    from paddle_tpu.layer_helper import LayerHelper
+    from paddle_tpu.framework import unique_name
+    from paddle_tpu.framework.program import Program, program_guard
+    from paddle_tpu.optimizer import MomentumOptimizer
+
+    B, T, D = 4, 6, 3
+    rs = np.random.RandomState(0)
+    main, startup = Program(), Program()
+    with unique_name.guard(), program_guard(main, startup):
+        em_in = layers.data("em", [T, D])
+        lbl = layers.data("lbl", [T], dtype="int32")
+        ln = layers.data("ln", [], dtype="int32")
+        h = LayerHelper("crf")
+        trans = h.create_parameter(attr=None, shape=[D + 2, D],
+                                   dtype="float32")
+        ll = h.create_variable_for_type_inference()
+        h.append_op("linear_chain_crf",
+                    {"Emission": [em_in.name], "Transition": [trans.name],
+                     "Label": [lbl.name], "Length": [ln.name]},
+                    {"LogLikelihood": [ll.name]}, {})
+        loss = layers.mean(ll)
+        MomentumOptimizer(0.1, 0.9).minimize(loss)
+
+    exe = pt.Executor(pt.CPUPlace())
+    sc = pt.framework.Scope()
+    exe.run(startup, scope=sc)
+    em = rs.randn(B, T, D).astype("f4")
+    lb = rs.randint(0, D, (B, T)).astype("i4")
+    lens = np.full((B,), T, np.int32)
+    losses = [float(np.asarray(
+        exe.run(main, feed={"em": em, "lbl": lb, "ln": lens},
+                fetch_list=[loss], scope=sc)[0]))
+        for _ in range(25)]
+    # only the transition matrix trains (emissions are feeds), so the
+    # attainable drop against random labels is modest
+    assert losses[-1] < losses[0] * 0.75, losses
