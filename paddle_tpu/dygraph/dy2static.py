@@ -337,6 +337,12 @@ def assert_plain_if(pred):
     return _truth(pred)
 
 
+def to_bool(x):
+    """Eager truth value for the real break/continue guards kept inside
+    python container loops (tensors evaluate eagerly)."""
+    return _truth(x)
+
+
 def init_loop_var(caller_locals, name, default):
     """Initial carry for a for-range loop variable: python leaves a
     pre-existing variable untouched when the range is empty, so reuse
@@ -655,12 +661,30 @@ class _Dy2StaticTransformer(ast.NodeTransformer):
         it = node.iter
         if not (isinstance(it, ast.Call) and isinstance(it.func, ast.Name)
                 and it.func.id == "range"):
-            # iteration over python containers stays python — and if the
-            # body breaks/continues, its ifs must stay python too (a
-            # break moved into a generated branch function would be a
-            # SyntaxError at compile time)
-            if not _contains_break_or_continue(node.body):
-                self.generic_visit(node)
+            # iteration over python containers stays a python loop, but
+            # its body still converts (tensor ifs must not bake).  A raw
+            # break/continue cannot move into a generated branch
+            # function (SyntaxError), so rewrite them into flags first
+            # and emit REAL break/continue at the loop-body top level,
+            # guarded by the (possibly tensor-valued) flags.
+            if _contains_break_or_continue(node.body):
+                i = self._next()
+                rw = _BreakContinueRewriter(i)
+                body, _ = rw.rewrite(node.body)
+                wrapper = ast.Module(body=body, type_ignores=[])
+                wrapper = self.generic_visit(wrapper)
+                body = wrapper.body
+                pre = []
+                if rw.cont_used:
+                    body = _parse_stmts(f"{rw.cont} = False") + body
+                if rw.brk_used:
+                    pre.append(f"{rw.brk} = False")
+                    body = body + _parse_stmts(
+                        f"if _jst.to_bool({rw.brk}):\n    break")
+                node.body = body
+                init = _parse_stmts("\n".join(pre)) if pre else []
+                return init + [node]
+            self.generic_visit(node)
             return node
         if not isinstance(node.target, ast.Name):
             raise NotImplementedError(

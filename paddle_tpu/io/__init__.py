@@ -215,19 +215,23 @@ class _PrefetchIterator:
 
     def __init__(self, make_batches, num_workers, prefetch_factor=2):
         self._q = queue.Queue(maxsize=max(2, num_workers * prefetch_factor))
-        self._exc = None
-        self._thread = threading.Thread(target=self._fill, args=(make_batches,),
-                                        daemon=True)
+        self._exc_box: list = []
+        self._stop_evt = threading.Event()
+        # the fill function must NOT hold a strong ref to self: a running
+        # thread would keep the iterator alive forever and __del__ (the
+        # worker-reaping trigger on abandonment) would never fire
+        self._thread = threading.Thread(
+            target=_prefetch_fill,
+            args=(make_batches, self._q, self._exc_box, self._stop_evt),
+            daemon=True)
         self._thread.start()
 
-    def _fill(self, make_batches):
-        try:
-            for b in make_batches():
-                self._q.put(b)
-        except BaseException as e:  # surfaced on the consumer side
-            self._exc = e
-        finally:
-            self._q.put(self._END)
+    def close(self):
+        """Release the fill thread (and through it the worker processes)
+        when the consumer abandons the iterator mid-epoch."""
+        self._stop_evt.set()
+
+    __del__ = close
 
     def __iter__(self):
         return self
@@ -235,10 +239,37 @@ class _PrefetchIterator:
     def __next__(self):
         item = self._q.get()
         if item is self._END:
-            if self._exc is not None:
-                raise self._exc
+            if self._exc_box:
+                raise self._exc_box[0]
             raise StopIteration
         return item
+
+
+def _prefetch_fill(make_batches, q, exc_box, stop_evt):
+    gen = make_batches()
+    try:
+        for b in gen:
+            placed = False
+            while not stop_evt.is_set():
+                try:
+                    q.put(b, timeout=0.25)
+                    placed = True
+                    break
+                except queue.Full:
+                    continue
+            if not placed:
+                break
+    except BaseException as e:  # surfaced on the consumer side
+        exc_box.append(e)
+    finally:
+        # abandonment path: closing the generator runs its finally,
+        # which shuts down any worker processes it forked
+        if hasattr(gen, "close"):
+            gen.close()
+        try:
+            q.put_nowait(_PrefetchIterator._END)
+        except queue.Full:
+            pass  # consumer gone; nothing is waiting for the marker
 
 
 class DataLoader:
@@ -253,6 +284,8 @@ class DataLoader:
         self.num_workers = num_workers
         self.prefetch_factor = prefetch_factor
         self.use_buffer_reader = use_buffer_reader
+        self.timeout = timeout
+        self.worker_init_fn = worker_init_fn
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
             self.batch_sampler = None
@@ -276,26 +309,133 @@ class DataLoader:
                     return
                 yield self.collate_fn(chunk)
         elif self.num_workers > 0:
-            # parallel sample fetch: a worker pool maps batches in order
-            # with bounded in-flight batches (the reference's multiprocess
-            # worker role; threads because loading is IO/numpy-bound)
-            from concurrent.futures import ThreadPoolExecutor
-
-            def load(idxs):
-                return self.collate_fn([self.dataset[i] for i in idxs])
-
-            in_flight = []
-            max_in_flight = self.num_workers * self.prefetch_factor
-            with ThreadPoolExecutor(self.num_workers) as pool:
-                for idxs in self.batch_sampler:
-                    in_flight.append(pool.submit(load, idxs))
-                    while len(in_flight) >= max_in_flight:
-                        yield in_flight.pop(0).result()
-                for f in in_flight:
-                    yield f.result()
+            yield from self._worker_batches()
         else:
             for idxs in self.batch_sampler:
                 yield self.collate_fn([self.dataset[i] for i in idxs])
+
+    def _worker_batches(self):
+        """Real worker PROCESSES (reference dataloader_iter.py:467
+        _DataLoaderIterMultiProcess): forked workers pull (batch_id,
+        indices) tasks, run dataset[i] + collate, and send pickled
+        batches back over queues; the parent reassembles in order with
+        a bounded in-flight window.  Threads remain the fallback where
+        fork is unavailable (non-Linux) — transforms are then GIL-bound,
+        which is exactly why the process path is the default."""
+        import multiprocessing as mp
+
+        try:
+            ctx = mp.get_context("fork")
+        except ValueError:
+            yield from self._thread_batches()
+            return
+
+        n_workers = self.num_workers
+        task_q = ctx.Queue()
+        result_q = ctx.Queue(maxsize=max(2, n_workers *
+                                         self.prefetch_factor))
+        dataset, collate = self.dataset, self.collate_fn
+        init_fn = self.worker_init_fn
+
+        def worker_main(wid):
+            global _worker_info
+            _worker_info = WorkerInfo(wid, n_workers, dataset)
+            if init_fn is not None:
+                init_fn(wid)
+            while True:
+                task = task_q.get()
+                if task is None:
+                    return
+                bid, idxs = task
+                try:
+                    batch = collate([dataset[i] for i in idxs])
+                    result_q.put((bid, batch, None))
+                except BaseException as e:  # surfaced in the parent
+                    import traceback
+
+                    result_q.put((bid, None, traceback.format_exc()))
+
+        procs = [ctx.Process(target=worker_main, args=(w,), daemon=True)
+                 for w in range(n_workers)]
+        for p in procs:
+            p.start()
+
+        # timeout=0 (the default) means NO user deadline — block as long
+        # as workers are alive (reference semantics); dead workers are
+        # still detected on a liveness poll
+        user_timeout = float(self.timeout) if self.timeout else None
+        pending = {}  # bid -> batch, out-of-order arrivals
+        next_out = 0
+        dispatched = 0
+        sampler_it = iter(self.batch_sampler)
+        max_in_flight = max(2, n_workers * self.prefetch_factor)
+
+        def recv():
+            nonlocal next_out
+            import queue as _q
+
+            while next_out not in pending:
+                try:
+                    bid, batch, err = result_q.get(
+                        timeout=user_timeout or 10.0)
+                except _q.Empty:
+                    dead = [w for w, p in enumerate(procs)
+                            if not p.is_alive()]
+                    if dead:
+                        raise RuntimeError(
+                            f"DataLoader worker(s) {dead} died without "
+                            f"producing their batch") from None
+                    if user_timeout:
+                        raise RuntimeError(
+                            f"DataLoader produced no batch within the "
+                            f"configured timeout={user_timeout}s") from None
+                    continue  # workers alive, no deadline: keep waiting
+                if err is not None:
+                    raise RuntimeError(
+                        f"DataLoader worker failed on batch {bid}:\n{err}")
+                pending[bid] = batch
+            out = pending.pop(next_out)
+            next_out += 1
+            return out
+
+        try:
+            exhausted = False
+            while True:
+                while not exhausted and dispatched - next_out \
+                        - len(pending) < max_in_flight:
+                    try:
+                        idxs = next(sampler_it)
+                    except StopIteration:
+                        exhausted = True
+                        break
+                    task_q.put((dispatched, list(idxs)))
+                    dispatched += 1
+                if next_out >= dispatched and exhausted:
+                    return
+                yield recv()
+        finally:
+            for _ in procs:
+                task_q.put(None)
+            for p in procs:
+                p.join(timeout=5)
+                if p.is_alive():
+                    p.terminate()
+
+    def _thread_batches(self):
+        from concurrent.futures import ThreadPoolExecutor
+
+        def load(idxs):
+            return self.collate_fn([self.dataset[i] for i in idxs])
+
+        in_flight = []
+        max_in_flight = self.num_workers * self.prefetch_factor
+        with ThreadPoolExecutor(self.num_workers) as pool:
+            for idxs in self.batch_sampler:
+                in_flight.append(pool.submit(load, idxs))
+                while len(in_flight) >= max_in_flight:
+                    yield in_flight.pop(0).result()
+            for f in in_flight:
+                yield f.result()
 
     def __iter__(self):
         if self.use_buffer_reader:
@@ -309,8 +449,21 @@ class DataLoader:
         return len(self.batch_sampler)
 
 
+class WorkerInfo:
+    """Reference fluid.dataloader worker_info: visible only inside a
+    worker process."""
+
+    def __init__(self, wid, num_workers, dataset):
+        self.id = wid
+        self.num_workers = num_workers
+        self.dataset = dataset
+
+
+_worker_info = None
+
+
 def get_worker_info():
-    return None  # single-process host pipeline (workers are threads)
+    return _worker_info  # None in the main process
 
 
 from .data_feed import MultiSlotDataFeed  # noqa: E402,F401

@@ -221,13 +221,18 @@ def _psroi_pool(ctx, op):
     ys = jnp.arange(H, dtype=jnp.float32)
     xs = jnp.arange(W, dtype=jnp.float32)
 
+    def _round_half_away(v):
+        # C++ round(): halves go AWAY from zero (jnp.round is half-even)
+        return jnp.sign(v) * jnp.floor(jnp.abs(v) + 0.5)
+
     def one_roi(roi, b):
         img = x[b]  # [C, H, W]
-        # reference rounds the scaled roi and clips bins to the image
-        x1 = jnp.round(roi[0]) * scale
-        y1 = jnp.round(roi[1]) * scale
-        x2 = jnp.round(roi[2] + 1.0) * scale
-        y2 = jnp.round(roi[3] + 1.0) * scale
+        # reference: round(roi) then +1 on the far edge, THEN scale
+        # (psroi_pool_op.h roi_start/end)
+        x1 = _round_half_away(roi[0]) * scale
+        y1 = _round_half_away(roi[1]) * scale
+        x2 = (_round_half_away(roi[2]) + 1.0) * scale
+        y2 = (_round_half_away(roi[3]) + 1.0) * scale
         rw = jnp.maximum(x2 - x1, 0.1)
         rh = jnp.maximum(y2 - y1, 0.1)
         bw = rw / pw_n

@@ -49,17 +49,28 @@ def resnet(img, depth=50, class_num=1000):
 
 
 def resnet50_train_program(batch_size=None, class_num=1000, lr=0.1,
-                           momentum=0.9, img_shape=(3, 224, 224)):
+                           momentum=0.9, img_shape=(3, 224, 224),
+                           uint8_input=False):
     """Build (main, startup, feeds, loss) for a ResNet-50 training step.
 
     Matches BASELINE.json config 2/4 (ResNet-50 ImageNet, SGD+momentum).
+    ``uint8_input`` moves image normalization ONTO the device: the feed
+    is raw uint8 (4x less host->device bandwidth — the input-pipeline
+    bench mode) and a cast+scale at the program head does the rest,
+    fused into the first conv by XLA.
     """
     from ..framework.program import Program, program_guard
     from ..optimizer import MomentumOptimizer
 
     main, startup = Program(), Program()
     with program_guard(main, startup):
-        img = layers.data("image", list(img_shape))
+        if uint8_input:
+            raw = layers.data("image", list(img_shape), dtype="uint8")
+            img = layers.scale(layers.cast(raw, "float32"), 1.0 / 127.5,
+                               bias=-1.0, bias_after_scale=True)
+            img.shape = tuple(raw.shape)
+        else:
+            img = layers.data("image", list(img_shape))
         label = layers.data("label", [1], dtype="int64")
         logits = resnet(img, depth=50, class_num=class_num)
         loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))

@@ -1,0 +1,110 @@
+"""Multiprocess DataLoader workers (reference
+fluid/dataloader/dataloader_iter.py:467 _DataLoaderIterMultiProcess):
+real processes, ordered reassembly, error propagation, worker_info.
+"""
+import os
+
+import numpy as np
+import pytest
+
+from paddle_tpu.io import DataLoader, Dataset, get_worker_info
+
+
+class _Square(Dataset):
+    def __len__(self):
+        return 20
+
+    def __getitem__(self, i):
+        return np.array([i * i], "f4"), np.array([i], "i4")
+
+
+class _PidDataset(Dataset):
+    def __len__(self):
+        return 8
+
+    def __getitem__(self, i):
+        return np.array([os.getpid()], "i8"), np.array([i], "i4")
+
+
+class _WorkerInfoDataset(Dataset):
+    def __len__(self):
+        return 8
+
+    def __getitem__(self, i):
+        info = get_worker_info()
+        wid = -1 if info is None else info.id
+        return np.array([wid], "i4"), np.array([i], "i4")
+
+
+class _Boom(Dataset):
+    def __len__(self):
+        return 8
+
+    def __getitem__(self, i):
+        if i == 5:
+            raise ValueError("synthetic decode failure at 5")
+        return np.array([i], "f4"), np.array([i], "i4")
+
+
+def test_mp_matches_serial_in_order():
+    serial = [b for b in DataLoader(_Square(), batch_size=4, num_workers=0,
+                                    use_buffer_reader=False)]
+    mp = [b for b in DataLoader(_Square(), batch_size=4, num_workers=2,
+                                use_buffer_reader=False)]
+    assert len(serial) == len(mp) == 5
+    for (sx, sy), (mx, my) in zip(serial, mp):
+        np.testing.assert_array_equal(np.asarray(sx), np.asarray(mx))
+        np.testing.assert_array_equal(np.asarray(sy), np.asarray(my))
+
+
+def test_workers_are_real_processes():
+    batches = list(DataLoader(_PidDataset(), batch_size=2, num_workers=2,
+                              use_buffer_reader=False))
+    pids = {int(p) for b in batches for p in np.asarray(b[0]).ravel()}
+    assert os.getpid() not in pids, "samples were loaded in-process"
+    assert len(pids) >= 1
+
+
+def test_worker_info_visible_in_worker():
+    batches = list(DataLoader(_WorkerInfoDataset(), batch_size=2,
+                              num_workers=2, use_buffer_reader=False))
+    wids = {int(w) for b in batches for w in np.asarray(b[0]).ravel()}
+    assert wids <= {0, 1} and wids, wids
+    assert get_worker_info() is None  # parent process
+
+
+def test_worker_error_propagates():
+    with pytest.raises(RuntimeError, match="synthetic decode failure"):
+        list(DataLoader(_Boom(), batch_size=2, num_workers=2,
+                        use_buffer_reader=False))
+
+
+def test_abandoned_iterator_reaps_workers():
+    """Breaking out of an epoch must shut the forked workers down, not
+    leak one set per abandoned epoch."""
+    import gc
+    import time
+
+    import multiprocessing as mp
+
+    before = len(mp.active_children())
+    loader = DataLoader(_Square(), batch_size=2, num_workers=2)
+    it = iter(loader)
+    next(it)
+    del it, loader
+    gc.collect()
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if len(mp.active_children()) <= before:
+            break
+        time.sleep(0.2)
+    assert len(mp.active_children()) <= before, \
+        f"{len(mp.active_children())} workers still alive"
+
+
+def test_shuffle_epoch_coverage():
+    loader = DataLoader(_Square(), batch_size=5, shuffle=True,
+                        num_workers=2, use_buffer_reader=False)
+    seen = sorted(int(i) for b in loader
+                  for i in np.asarray(b[1]).ravel())
+    assert seen == list(range(20))

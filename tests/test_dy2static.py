@@ -354,6 +354,32 @@ def test_container_for_with_break_stays_python():
         np.testing.assert_allclose(np.asarray(tl(x)[0]._value), eager)
 
 
+def test_container_for_break_still_converts_tensor_ifs():
+    """A container loop with a break must STILL convert its tensor-
+    conditioned ifs (flag rewrite + real guarded break), so the export
+    carries cond ops instead of a baked branch."""
+    def f(x):
+        acc = x * 0.0
+        for w in [1.0, 2.0, 3.0]:
+            if acc.mean() > 0.5:
+                acc = acc + x * w
+            else:
+                acc = acc + x * (2.0 * w)
+            if float(np.asarray(acc._value).sum()) > 100.0:
+                break
+        return acc
+
+    with dygraph.guard():
+        xs = [np.full((2,), v, "f4") for v in (1.0, -1.0)]
+        eager = [np.asarray(f(dygraph.to_variable(v))._value) for v in xs]
+        _, tl = djit.TracedLayer.trace(f, [dygraph.to_variable(xs[0])])
+        ops = [op.type for op in tl.program.global_block.ops]
+        assert "cond_pair" in ops, ops
+        for v, e in zip(xs, eager):
+            np.testing.assert_allclose(
+                np.asarray(tl(dygraph.to_variable(v))[0]._value), e)
+
+
 def test_static_mode_variable_dispatch():
     """convert shims route framework Variables to layers.cond."""
     from paddle_tpu import layers
