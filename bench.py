@@ -203,28 +203,97 @@ def bench_resnet_pipeline(pt, jax):
     return PIPE_BATCH * PIPE_CHUNK * PIPE_CALLS / dt
 
 
+def preflight_device(attempts=2, timeout=240):
+    """Bounded-time device-init probe in a SUBPROCESS, with one retry.
+
+    Round-4 postmortem: the first in-process jax.devices() call died
+    ("Unable to initialize backend") and zeroed every metric.  Probing
+    in a child bounds the wait (a hung init can't wedge the bench
+    process), yields a readable diagnostic, and the retry absorbs a
+    transiently-held chip (e.g. an orphaned worker that is still being
+    reaped).  Returns (platform, None) or (None, diagnostic)."""
+    import subprocess
+    import sys
+
+    code = "import jax; print(jax.devices()[0].platform)"
+    diag = "no attempts made"
+    for i in range(attempts):
+        try:
+            r = subprocess.run([sys.executable, "-c", code],
+                               capture_output=True, text=True,
+                               timeout=timeout)
+        except subprocess.TimeoutExpired:
+            diag = f"device init did not complete within {timeout}s"
+        else:
+            if r.returncode == 0 and r.stdout.strip():
+                return r.stdout.strip(), None
+            diag = (r.stderr or "no stderr").strip()[-2000:]
+        if i + 1 < attempts:
+            time.sleep(10)
+    return None, diag
+
+
 def main():
+    result = {
+        "metric": "resnet50_bf16_images_per_sec",
+        "value": 0.0,
+        "unit": "images/sec/chip",
+        "vs_baseline": 0.0,
+    }
+    errors = {}
+
+    platform, diag = preflight_device()
+    if platform is None:
+        result["error"] = f"device preflight failed: {diag}"
+        print(json.dumps(result))
+        return
+
     import jax
 
     import paddle_tpu as pt
 
-    ips = bench_resnet(pt, jax)
-    tps = bench_bert(pt, jax)
-    pipe_ips = bench_resnet_pipeline(pt, jax)
-    resnet_ratio = ips / (0.9 * A100_IMG_PER_SEC)
-    bert_ratio = tps / (0.9 * A100_BERT_TOKENS_PER_SEC)
-    print(json.dumps({
-        "metric": "resnet50_bf16_images_per_sec",
-        "value": round(ips, 1),
-        "unit": "images/sec/chip",
-        "vs_baseline": round(min(resnet_ratio, bert_ratio), 3),
-        "resnet50_images_per_sec": round(ips, 1),
-        "resnet50_vs_baseline": round(resnet_ratio, 3),
-        "bert_base_tokens_per_sec": round(tps, 1),
-        "bert_vs_baseline": round(bert_ratio, 3),
-        "resnet50_pipeline_images_per_sec": round(pipe_ips, 1),
-        "resnet50_pipeline_fraction_of_synthetic": round(pipe_ips / ips, 3),
-    }))
+    # Each flagship is isolated: one failure records its diagnostic and
+    # the rest still report (partial results beat a zeroed round).
+    ips = tps = pipe_ips = None
+    try:
+        ips = bench_resnet(pt, jax)
+    except Exception as e:
+        errors["resnet50"] = f"{type(e).__name__}: {e}"[:500]
+    try:
+        tps = bench_bert(pt, jax)
+    except Exception as e:
+        errors["bert"] = f"{type(e).__name__}: {e}"[:500]
+    try:
+        pipe_ips = bench_resnet_pipeline(pt, jax)
+    except Exception as e:
+        errors["resnet50_pipeline"] = f"{type(e).__name__}: {e}"[:500]
+
+    ratios = []
+    if ips is not None:
+        r = ips / (0.9 * A100_IMG_PER_SEC)
+        ratios.append(r)
+        result.update(value=round(ips, 1),
+                      resnet50_images_per_sec=round(ips, 1),
+                      resnet50_vs_baseline=round(r, 3))
+    if tps is not None:
+        r = tps / (0.9 * A100_BERT_TOKENS_PER_SEC)
+        ratios.append(r)
+        result.update(bert_base_tokens_per_sec=round(tps, 1),
+                      bert_vs_baseline=round(r, 3))
+    if pipe_ips is not None:
+        result["resnet50_pipeline_images_per_sec"] = round(pipe_ips, 1)
+        if ips:
+            result["resnet50_pipeline_fraction_of_synthetic"] = round(
+                pipe_ips / ips, 3)
+    # the single driver number is the MIN of the two FLAGSHIP ratios
+    # (docstring contract); it zeroes only when a flagship itself
+    # failed — a failure in the auxiliary pipeline bench is reported in
+    # "error" but does not void the round
+    flagship_ok = ips is not None and tps is not None
+    result["vs_baseline"] = round(min(ratios), 3) if flagship_ok else 0.0
+    if errors:
+        result["error"] = "; ".join(f"{k}: {v}" for k, v in errors.items())
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

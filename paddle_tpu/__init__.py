@@ -58,8 +58,10 @@ from .framework import (  # noqa: F401
     TPUPlace,
     default_main_program,
     default_startup_program,
+    get_device,
     global_scope,
     program_guard,
+    set_device,
 )
 
 from . import distribution  # noqa: F401

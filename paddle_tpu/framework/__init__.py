@@ -7,8 +7,10 @@ from .place import (  # noqa: F401
     CUDAPlace,
     Place,
     TPUPlace,
+    get_device,
     is_compiled_with_cuda,
     is_compiled_with_tpu,
+    set_device,
 )
 from .program import (  # noqa: F401
     Block,

@@ -90,9 +90,61 @@ def is_compiled_with_tpu() -> bool:
     return any(d.platform != "cpu" for d in jax.devices())
 
 
+_pinned_place: Place | None = None  # set by set_device
+
+
 def _default_place() -> Place:
     import jax
 
+    if _pinned_place is not None:
+        return _pinned_place
     if any(d.platform != "cpu" for d in jax.devices()):
         return TPUPlace(0)
     return CPUPlace()
+
+
+def set_device(device: str) -> Place:
+    """Pin the process to a device (reference paddle.set_device,
+    python/paddle/device.py).
+
+    ``set_device("cpu")`` pins the live jax platform config so ONLY the
+    CPU backend initializes — this matters on accelerator hosts where
+    initializing the accelerator plugin is expensive or (during an
+    outage) hangs: env vars alone are not enough when a site hook
+    forces the platform list after jax import.  ``set_device("tpu")``
+    (or the "gpu" compat alias) restores accelerator-first selection.
+    Already-initialized backends are cleared so the new selection takes
+    effect mid-process (existing arrays keep referencing their original
+    client and stay readable).  Returns the corresponding Place, which
+    also becomes the default place.
+    """
+    import jax
+
+    global _pinned_place
+    d = device.split(":")[0].lower()
+    idx = int(device.split(":")[1]) if ":" in device else 0
+    if d == "cpu":
+        place: Place = CPUPlace()
+        jax.config.update("jax_platforms", "cpu")
+    elif d in ("tpu", "gpu", "xpu", "npu"):
+        place = TPUPlace(idx)
+        jax.config.update("jax_platforms", None)  # accelerator-first
+    else:
+        raise ValueError(
+            f"unknown device {device!r}; expected cpu/tpu/gpu")
+    # a config update after backend init is otherwise a silent no-op
+    try:
+        from jax.extend.backend import clear_backends
+
+        clear_backends()
+    except Exception:
+        pass
+    accelerator_devices.cache_clear()
+    _pinned_place = place
+    return place
+
+
+def get_device() -> str:
+    """Reference paddle.get_device: 'cpu' or 'tpu:<id>'."""
+    p = _default_place()
+    return "cpu" if isinstance(p, CPUPlace) else f"tpu:{p.device_id}"

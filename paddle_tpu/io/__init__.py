@@ -263,13 +263,87 @@ def _prefetch_fill(make_batches, q, exc_box, stop_evt):
         exc_box.append(e)
     finally:
         # abandonment path: closing the generator runs its finally,
-        # which shuts down any worker processes it forked
+        # which shuts down any worker processes it spawned
         if hasattr(gen, "close"):
             gen.close()
+        # The _END marker must ALWAYS reach the consumer, even when the
+        # queue is still full of undrained batches (e.g. an epoch with
+        # fewer batches than the queue capacity finishes before the
+        # consumer takes its first item) — a dropped marker blocks
+        # __next__ forever.  Block-put with the same stop-event polling
+        # as normal batches; only an explicit close() abandons delivery.
+        while True:
+            try:
+                q.put(_PrefetchIterator._END, timeout=0.25)
+                break
+            except queue.Full:
+                if stop_evt.is_set():
+                    break
+
+
+_ENV_PIN_LOCK = threading.Lock()  # guards the JAX_PLATFORMS pin in start
+
+
+def _worker_loop(wid, n_workers, dataset, collate, init_fn, task_q,
+                 result_q, parent_pid):
+    """Worker-process body.  Module-level so the spawn start method can
+    pickle it by reference (a closure can't be).  Polls the task queue
+    with a short timeout and watches the parent's liveness: if the
+    parent is SIGKILL'd (daemon=True doesn't cover that), getppid() is
+    reparented and the worker exits instead of surviving as an orphan
+    holding queue/file state."""
+    import os
+    import queue as _q
+    import sys
+
+    # Never touch the accelerator from a worker.  The env pin from the
+    # parent covers normal jax installs; site hooks that force the
+    # platform list post-import (overriding JAX_PLATFORMS) need the
+    # live config pinned too — without this, any stray jax.devices()
+    # in user dataset code would initialize the device backend from
+    # every worker.
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if "jax" in sys.modules:
         try:
-            q.put_nowait(_PrefetchIterator._END)
-        except queue.Full:
-            pass  # consumer gone; nothing is waiting for the marker
+            sys.modules["jax"].config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+    global _worker_info
+    _worker_info = WorkerInfo(wid, n_workers, dataset)
+    if init_fn is not None:
+        init_fn(wid)
+
+    def put_watching_parent(item):
+        """Bounded-queue put that also watches parent liveness — a
+        worker blocked in put() when the parent is SIGKILL'd must exit,
+        not survive as an orphan."""
+        while True:
+            try:
+                result_q.put(item, timeout=2.0)
+                return True
+            except _q.Full:
+                if os.getppid() != parent_pid:
+                    return False
+
+    while True:
+        try:
+            task = task_q.get(timeout=2.0)
+        except _q.Empty:
+            if os.getppid() != parent_pid:
+                return  # parent died; don't orphan
+            continue
+        if task is None:
+            return
+        bid, idxs = task
+        try:
+            batch = collate([dataset[i] for i in idxs])
+            ok = put_watching_parent((bid, batch, None))
+        except BaseException:  # surfaced in the parent
+            import traceback
+
+            ok = put_watching_parent((bid, None, traceback.format_exc()))
+        if not ok:
+            return
 
 
 class DataLoader:
@@ -316,49 +390,79 @@ class DataLoader:
 
     def _worker_batches(self):
         """Real worker PROCESSES (reference dataloader_iter.py:467
-        _DataLoaderIterMultiProcess): forked workers pull (batch_id,
-        indices) tasks, run dataset[i] + collate, and send pickled
-        batches back over queues; the parent reassembles in order with
-        a bounded in-flight window.  Threads remain the fallback where
-        fork is unavailable (non-Linux) — transforms are then GIL-bound,
-        which is exactly why the process path is the default."""
-        import multiprocessing as mp
+        _DataLoaderIterMultiProcess): workers pull (batch_id, indices)
+        tasks, run dataset[i] + collate, and send pickled batches back
+        over queues; the parent reassembles in order with a bounded
+        in-flight window.
 
+        Workers are SPAWNED, not forked: the parent is a jax-initialized
+        multithreaded process (fork from it deadlocks, and forked
+        children would inherit live TPU client state — an orphan can
+        keep the chip unavailable to every later process).  Spawned
+        children start interpreter-fresh with JAX_PLATFORMS=cpu pinned
+        so they can never touch the device; they run only dataset +
+        collate (numpy), matching the reference's CPU-only worker
+        contract.  Fork remains an explicit opt-in
+        (PADDLE_TPU_WORKER_START=fork) for jax-free embedders; threads
+        are the fallback when the dataset doesn't pickle."""
+        import multiprocessing as mp
+        import os
+
+        start = os.environ.get("PADDLE_TPU_WORKER_START", "spawn")
         try:
-            ctx = mp.get_context("fork")
+            ctx = mp.get_context(start)
         except ValueError:
             yield from self._thread_batches()
             return
 
         n_workers = self.num_workers
         task_q = ctx.Queue()
-        result_q = ctx.Queue(maxsize=max(2, n_workers *
-                                         self.prefetch_factor))
-        dataset, collate = self.dataset, self.collate_fn
-        init_fn = self.worker_init_fn
+        # one window constant governs BOTH the result-queue capacity and
+        # the dispatch in-flight bound — they must stay equal or workers
+        # block on a queue smaller than the dispatch window
+        max_in_flight = max(2, n_workers * self.prefetch_factor)
+        result_q = ctx.Queue(maxsize=max_in_flight)
 
-        def worker_main(wid):
-            global _worker_info
-            _worker_info = WorkerInfo(wid, n_workers, dataset)
-            if init_fn is not None:
-                init_fn(wid)
-            while True:
-                task = task_q.get()
-                if task is None:
-                    return
-                bid, idxs = task
-                try:
-                    batch = collate([dataset[i] for i in idxs])
-                    result_q.put((bid, batch, None))
-                except BaseException as e:  # surfaced in the parent
-                    import traceback
+        procs = [ctx.Process(
+            target=_worker_loop,
+            args=(w, n_workers, self.dataset, self.collate_fn,
+                  self.worker_init_fn, task_q, result_q, os.getpid()),
+            daemon=True) for w in range(n_workers)]
+        # spawned children must never initialize a TPU backend even if
+        # something in their import chain touches jax — pin them to cpu
+        # for the duration of the exec (env is captured at start()).
+        # Import jax in the parent FIRST so its platform config is
+        # already snapshotted and the temporary env pin cannot leak
+        # into a concurrent first jax import on another thread.
+        import jax  # noqa: F401
 
-                    result_q.put((bid, None, traceback.format_exc()))
-
-        procs = [ctx.Process(target=worker_main, args=(w,), daemon=True)
-                 for w in range(n_workers)]
-        for p in procs:
-            p.start()
+        started = False
+        # the save/set/restore of the process-global env var must not
+        # interleave across loaders iterating concurrently (train+eval),
+        # or one thread's restore can leak the cpu pin permanently
+        with _ENV_PIN_LOCK:
+            saved_jp = os.environ.get("JAX_PLATFORMS")
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            try:
+                for p in procs:
+                    p.start()
+                started = True
+            except Exception:
+                # spawn pickles (dataset, collate_fn, worker_init_fn)
+                # by value; closures / local classes don't pickle —
+                # degrade to the thread pool rather than erroring the
+                # epoch
+                for p in procs:
+                    if p.is_alive():
+                        p.terminate()
+            finally:
+                if saved_jp is None:
+                    os.environ.pop("JAX_PLATFORMS", None)
+                else:
+                    os.environ["JAX_PLATFORMS"] = saved_jp
+        if not started:
+            yield from self._thread_batches()
+            return
 
         # timeout=0 (the default) means NO user deadline — block as long
         # as workers are alive (reference semantics); dead workers are
@@ -368,7 +472,6 @@ class DataLoader:
         next_out = 0
         dispatched = 0
         sampler_it = iter(self.batch_sampler)
-        max_in_flight = max(2, n_workers * self.prefetch_factor)
 
         def recv():
             nonlocal next_out

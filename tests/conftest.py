@@ -31,6 +31,32 @@ import pytest  # noqa: E402
 
 
 @pytest.fixture(autouse=True)
+def _test_watchdog():
+    """Per-test hang watchdog: a blocked queue/lock must surface as a
+    test FAILURE, not an unbounded suite stall (round-4 postmortem —
+    the suite deadlocked at test 50/337 and the snapshot shipped
+    unverified).  SIGALRM interrupts lock waits on the main thread, so
+    even a bare queue.get() is caught."""
+    import signal
+
+    if not hasattr(signal, "SIGALRM"):
+        yield
+        return
+
+    def _on_alarm(signum, frame):
+        raise TimeoutError(
+            "test exceeded the 300s hang watchdog (tests/conftest.py)")
+
+    old = signal.signal(signal.SIGALRM, _on_alarm)
+    signal.alarm(300)
+    try:
+        yield
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
+
+
+@pytest.fixture(autouse=True)
 def _fresh_programs():
     """Each test gets fresh default programs + scope (reference tests use
     new Programs per test via program_guard)."""

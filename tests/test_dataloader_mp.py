@@ -108,3 +108,101 @@ def test_shuffle_epoch_coverage():
     seen = sorted(int(i) for b in loader
                   for i in np.asarray(b[1]).ravel())
     assert seen == list(range(20))
+
+
+class _TinyN(Dataset):
+    """An epoch with fewer batches than the prefetch queue capacity —
+    the round-4 regression: the producer finished while the bounded
+    queue was full, dropped the _END sentinel, and __next__ blocked
+    forever."""
+
+    def __init__(self, n):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        return np.array([i], "f4")
+
+
+@pytest.mark.parametrize("n_batches", [1, 2, 3])
+def test_short_epoch_terminates(n_batches):
+    loader = DataLoader(_TinyN(4 * n_batches), batch_size=4)  # buffered
+    for _ in range(3):  # several epochs: sentinel must arrive every time
+        assert len(list(loader)) == n_batches
+
+
+@pytest.mark.parametrize("n_batches", [1, 2])
+def test_short_epoch_terminates_with_workers(n_batches):
+    loader = DataLoader(_TinyN(4 * n_batches), batch_size=4, num_workers=2)
+    assert len(list(loader)) == n_batches
+
+
+_FORK_MARKER = [0]  # mutated in the parent; survives only into FORKED children
+
+
+class _StartMethodProbe(Dataset):
+    """Forked children inherit the parent's mutated module state (and
+    with it the parent's live JAX/TPU client); spawned children
+    re-import this module fresh, so the marker reads 0."""
+
+    def __len__(self):
+        return 4
+
+    def __getitem__(self, i):
+        return np.array([_FORK_MARKER[0]], "i4")
+
+
+def test_unpicklable_dataset_falls_back_to_threads():
+    """A dataset that spawn can't pickle (local class) must degrade to
+    the thread pool, not error the epoch — and must not leave the
+    parent's JAX_PLATFORMS pin behind."""
+    class _Local(Dataset):  # local => unpicklable by spawn
+        def __len__(self):
+            return 8
+
+        def __getitem__(self, i):
+            return np.array([i], "f4")
+
+    before = os.environ.get("JAX_PLATFORMS")
+    batches = list(DataLoader(_Local(), batch_size=2, num_workers=2,
+                              use_buffer_reader=False))
+    assert len(batches) == 4
+    assert os.environ.get("JAX_PLATFORMS") == before
+
+
+def test_set_get_device_roundtrip():
+    import paddle_tpu as pt
+    from paddle_tpu.framework import place as place_mod
+
+    saved = place_mod._pinned_place
+    try:
+        p = pt.set_device("cpu")
+        assert type(p).__name__ == "CPUPlace"
+        assert pt.get_device() == "cpu"
+        p = pt.set_device("gpu:1")  # compat alias; index must stick
+        assert p.device_id == 1
+        assert pt.get_device() == "tpu:1"
+    finally:
+        place_mod._pinned_place = saved
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")  # test env contract
+        place_mod.accelerator_devices.cache_clear()
+
+
+def test_workers_are_spawned_not_forked():
+    """Workers must start interpreter-fresh (spawn): forking a
+    jax-initialized multithreaded parent risks deadlock, and a forked
+    orphan inheriting TPU client state can wedge the chip for every
+    later process (reference workers are CPU-only by contract,
+    dataloader_iter.py:467)."""
+    _FORK_MARKER[0] = os.getpid()
+    try:
+        batches = list(DataLoader(_StartMethodProbe(), batch_size=2,
+                                  num_workers=2, use_buffer_reader=False))
+    finally:
+        _FORK_MARKER[0] = 0
+    seen = {int(v) for b in batches for v in np.asarray(b).ravel()}
+    assert seen == {0}, f"workers saw parent memory (forked): {seen}"
