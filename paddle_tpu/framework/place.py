@@ -125,21 +125,26 @@ def set_device(device: str) -> Place:
     idx = int(device.split(":")[1]) if ":" in device else 0
     if d == "cpu":
         place: Place = CPUPlace()
-        jax.config.update("jax_platforms", "cpu")
+        want = "cpu"
     elif d in ("tpu", "gpu", "xpu", "npu"):
         place = TPUPlace(idx)
-        jax.config.update("jax_platforms", None)  # accelerator-first
+        want = None  # accelerator-first
     else:
         raise ValueError(
             f"unknown device {device!r}; expected cpu/tpu/gpu")
-    # a config update after backend init is otherwise a silent no-op
-    try:
-        from jax.extend.backend import clear_backends
+    if jax.config.jax_platforms != want:
+        jax.config.update("jax_platforms", want)
+        # a config update after backend init is otherwise a silent
+        # no-op; clearing rebuilds backends under the new selection.
+        # Same-platform calls (incl. index-only changes) skip this —
+        # clearing drops every jit cache and re-inits the backend.
+        try:
+            from jax.extend.backend import clear_backends
 
-        clear_backends()
-    except Exception:
-        pass
-    accelerator_devices.cache_clear()
+            clear_backends()
+        except Exception:
+            pass
+        accelerator_devices.cache_clear()
     _pinned_place = place
     return place
 

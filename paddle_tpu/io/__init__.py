@@ -436,6 +436,8 @@ class DataLoader:
         # into a concurrent first jax import on another thread.
         import jax  # noqa: F401
 
+        import pickle
+
         started = False
         # the save/set/restore of the process-global env var must not
         # interleave across loaders iterating concurrently (train+eval),
@@ -447,14 +449,32 @@ class DataLoader:
                 for p in procs:
                     p.start()
                 started = True
-            except Exception:
+            except (pickle.PicklingError, TypeError, AttributeError) as e:
                 # spawn pickles (dataset, collate_fn, worker_init_fn)
                 # by value; closures / local classes don't pickle —
                 # degrade to the thread pool rather than erroring the
-                # epoch
+                # epoch.  Loudly: threads are GIL-bound and skip
+                # worker_init_fn / get_worker_info semantics.
+                import warnings
+
+                warnings.warn(
+                    f"DataLoader: dataset/collate_fn/worker_init_fn "
+                    f"not picklable for spawned workers ({e!r}); "
+                    f"falling back to a thread pool (GIL-bound, no "
+                    f"worker_init_fn / get_worker_info). Move the "
+                    f"dataset class to module scope for real worker "
+                    f"processes.", RuntimeWarning, stacklevel=3)
                 for p in procs:
                     if p.is_alive():
                         p.terminate()
+            except BaseException:
+                # non-pickling failures (resource limits, …) are real
+                # errors: reap and propagate rather than silently
+                # changing the execution model
+                for p in procs:
+                    if p.is_alive():
+                        p.terminate()
+                raise
             finally:
                 if saved_jp is None:
                     os.environ.pop("JAX_PLATFORMS", None)
@@ -476,11 +496,17 @@ class DataLoader:
         def recv():
             nonlocal next_out
             import queue as _q
+            import time as _time
 
+            # poll in <=10s slices even under a long user timeout so a
+            # dead worker is diagnosed within seconds, not at deadline
+            deadline = (_time.monotonic() + user_timeout) \
+                if user_timeout else None
             while next_out not in pending:
+                slice_t = 10.0 if deadline is None else max(
+                    0.1, min(10.0, deadline - _time.monotonic()))
                 try:
-                    bid, batch, err = result_q.get(
-                        timeout=user_timeout or 10.0)
+                    bid, batch, err = result_q.get(timeout=slice_t)
                 except _q.Empty:
                     dead = [w for w, p in enumerate(procs)
                             if not p.is_alive()]
@@ -488,11 +514,12 @@ class DataLoader:
                         raise RuntimeError(
                             f"DataLoader worker(s) {dead} died without "
                             f"producing their batch") from None
-                    if user_timeout:
+                    if deadline is not None and \
+                            _time.monotonic() >= deadline:
                         raise RuntimeError(
                             f"DataLoader produced no batch within the "
                             f"configured timeout={user_timeout}s") from None
-                    continue  # workers alive, no deadline: keep waiting
+                    continue  # workers alive, deadline not hit: wait on
                 if err is not None:
                     raise RuntimeError(
                         f"DataLoader worker failed on batch {bid}:\n{err}")

@@ -22,6 +22,7 @@ from . import (  # noqa: F401
     nms_ops,
     nn_ops,
     optimizer_ops,
+    quant_ops,
     rnn_ops,
     sampling_ops,
     sequence_ops,

@@ -1,0 +1,193 @@
+"""Fake-quantization ops (reference operators/fake_quantize_op.cc:739
+family: fake_quantize_abs_max / fake_channel_wise_quantize_abs_max /
+fake_quantize_moving_average_abs_max / fake_quantize_range_abs_max and
+their *_dequantize_* variants, plus fake_dequantize_max_abs and the
+moving_average_abs_max_scale observer).
+
+TPU-native design: quant-dequant SIMULATION stays in float — on TPU the
+MXU wants bf16, int8 buys no training-time win, so the value of these
+ops is scale calibration + bit-exact export parity, not int arithmetic.
+The straight-through estimator falls out of the emission
+``x + stop_gradient(qdq(x) - x)``: the generic vjp path
+(ops/grad_generic.py) then yields pass-through gradients with zero
+bespoke backward kernels (the reference maintains FakeQuantDequantGrad
+kernels for the same semantics).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.lowering import register_lower
+from .common import as_scalar
+
+
+def _qmax(op):
+    return 2.0 ** (int(op.attr("bit_length", 8)) - 1) - 1
+
+
+def _abs_max(x):
+    return jnp.maximum(jnp.max(jnp.abs(x)), 1e-8)
+
+
+def _channel_abs_max(x, axis):
+    red = tuple(i for i in range(x.ndim) if i != axis)
+    return jnp.maximum(jnp.max(jnp.abs(x), axis=red), 1e-8)
+
+
+def _quant(x, scale, qmax):
+    """Quantize to the integer grid, kept in float (reference outputs
+    float tensors holding integer values)."""
+    return jnp.clip(jnp.round(x / scale * qmax), -qmax, qmax)
+
+
+def _qdq_ste(x, scale, qmax):
+    """Quant-dequant with straight-through gradient."""
+    qdq = _quant(x, scale, qmax) * scale / qmax
+    return x + jax.lax.stop_gradient(qdq - x)
+
+
+@register_lower("fake_quantize_abs_max")
+def lower_fake_quantize_abs_max(ctx, op):
+    x = ctx.in1(op, "X")
+    qmax = _qmax(op)
+    scale = _abs_max(x)
+    ctx.set_out(op, "Out", _quant(x, scale, qmax))
+    ctx.set_out(op, "OutScale", jnp.reshape(scale, (1,)))
+
+
+@register_lower("fake_quantize_dequantize_abs_max")
+def lower_fake_quantize_dequantize_abs_max(ctx, op):
+    x = ctx.in1(op, "X")
+    qmax = _qmax(op)
+    scale = _abs_max(x)
+    ctx.set_out(op, "Out", _qdq_ste(x, scale, qmax))
+    ctx.set_out(op, "OutScale", jnp.reshape(scale, (1,)))
+
+
+@register_lower("fake_channel_wise_quantize_abs_max")
+def lower_fake_channel_wise_quantize_abs_max(ctx, op):
+    x = ctx.in1(op, "X")
+    axis = int(op.attr("quant_axis", 0))
+    qmax = _qmax(op)
+    scale = _channel_abs_max(x, axis)
+    bshape = [1] * x.ndim
+    bshape[axis] = -1
+    ctx.set_out(op, "Out", _quant(x, scale.reshape(bshape), qmax))
+    ctx.set_out(op, "OutScale", scale)
+
+
+@register_lower("fake_channel_wise_quantize_dequantize_abs_max")
+def lower_fake_channel_wise_qdq_abs_max(ctx, op):
+    x = ctx.in1(op, "X")
+    axis = int(op.attr("quant_axis", 0))
+    qmax = _qmax(op)
+    scale = _channel_abs_max(x, axis)
+    bshape = [1] * x.ndim
+    bshape[axis] = -1
+    ctx.set_out(op, "Out", _qdq_ste(x, scale.reshape(bshape), qmax))
+    ctx.set_out(op, "OutScale", scale)
+
+
+def _moving_average_scale(ctx, op, x):
+    """Shared accumulator update (fake_quantize_op.cc FindMovingAverage):
+    state = rate*state + 1;  accum = rate*accum + abs_max(x);
+    scale = accum / state.  In is_test mode the stored scale is used
+    unchanged and no state is written."""
+    rate = float(op.attr("moving_rate", 0.9))
+    in_scale = as_scalar(ctx.in1(op, "InScale"))
+    if op.attr("is_test", False):
+        return jnp.maximum(in_scale, 1e-8), None, None
+    state = as_scalar(ctx.in1(op, "InState"))
+    accum = as_scalar(ctx.in1(op, "InAccum"))
+    state = rate * state + 1.0
+    accum = rate * accum + _abs_max(x)
+    scale = accum / state
+    return jnp.maximum(scale, 1e-8), state, accum
+
+
+def _emit_moving_average_state(ctx, op, scale, state, accum):
+    ctx.set_out(op, "OutScale", jnp.reshape(scale, (1,)))
+    if state is not None:
+        ctx.set_out(op, "OutState", jnp.reshape(state, (1,)))
+        ctx.set_out(op, "OutAccum", jnp.reshape(accum, (1,)))
+
+
+@register_lower("fake_quantize_moving_average_abs_max")
+def lower_fake_quantize_moving_average_abs_max(ctx, op):
+    x = ctx.in1(op, "X")
+    qmax = _qmax(op)
+    scale, state, accum = _moving_average_scale(ctx, op, x)
+    ctx.set_out(op, "Out", _quant(x, scale, qmax))
+    _emit_moving_average_state(ctx, op, scale, state, accum)
+
+
+@register_lower("fake_quantize_dequantize_moving_average_abs_max")
+def lower_fake_qdq_moving_average_abs_max(ctx, op):
+    x = ctx.in1(op, "X")
+    qmax = _qmax(op)
+    scale, state, accum = _moving_average_scale(ctx, op, x)
+    ctx.set_out(op, "Out", _qdq_ste(x, scale, qmax))
+    _emit_moving_average_state(ctx, op, scale, state, accum)
+
+
+@register_lower("fake_quantize_range_abs_max")
+def lower_fake_quantize_range_abs_max(ctx, op):
+    """Windowed running-max scale (fake_quantize_op.cc FindRangeAbsMax):
+    a [window_size] ring buffer of per-step abs-maxes; the scale is the
+    max over the window.  State rides explicit InScales/Iter slots
+    (functional in-out pairs, same var wired to both) instead of the
+    reference's in-place mutation."""
+    x = ctx.in1(op, "X")
+    qmax = _qmax(op)
+    if op.attr("is_test", False):
+        scale = jnp.maximum(as_scalar(ctx.in1(op, "InScale")), 1e-8)
+        ctx.set_out(op, "Out", _quant(x, scale, qmax))
+        return
+    window = int(op.attr("window_size", 10000))
+    cur = _abs_max(x)
+    scales = ctx.in1(op, "InScales")
+    it = jnp.asarray(as_scalar(ctx.in1(op, "Iter")), jnp.int32)
+    if scales is None:  # windowless degenerate form: running max
+        prev = as_scalar(ctx.in1(op, "InScale"))
+        scale = jnp.maximum(jnp.maximum(prev, cur), 1e-8)
+    else:
+        scales = scales.at[it % window].set(cur)
+        scale = jnp.maximum(jnp.max(scales), 1e-8)
+        ctx.set_out(op, "OutScales", scales)
+    ctx.set_out(op, "Out", _quant(x, scale, qmax))
+    ctx.set_out(op, "OutScale", jnp.reshape(scale, (1,)))
+    ctx.set_out(op, "OutIter", jnp.reshape(it + 1, (1,)))
+
+
+@register_lower("moving_average_abs_max_scale")
+def lower_moving_average_abs_max_scale(ctx, op):
+    """Observer only: Out = X unchanged, scale state updated (used by
+    the reference's OutScaleForTrainingPass)."""
+    x = ctx.in1(op, "X")
+    scale, state, accum = _moving_average_scale(ctx, op, x)
+    if ctx.out_name(op, "Out"):
+        ctx.set_out(op, "Out", x)
+    _emit_moving_average_state(ctx, op, scale, state, accum)
+
+
+@register_lower("fake_dequantize_max_abs")
+def lower_fake_dequantize_max_abs(ctx, op):
+    x = ctx.in1(op, "X")
+    scale = as_scalar(ctx.in1(op, "Scale"))
+    max_range = float(op.attr("max_range", 127.0))
+    ctx.set_out(op, "Out", x * scale / max_range)
+
+
+@register_lower("fake_channel_wise_dequantize_max_abs")
+def lower_fake_channel_wise_dequantize_max_abs(ctx, op):
+    x = ctx.in1(op, "X")
+    scales = ctx.in_list(op, "Scales")
+    axis = int(op.attr("quant_axis", 0))
+    bits = op.attr("quant_bits", [8])
+    bshape = [1] * x.ndim
+    bshape[axis] = -1
+    out = x * scales[0].reshape(bshape) / (2.0 ** (int(bits[0]) - 1) - 1)
+    if len(scales) > 1:  # second-level (whole-tensor) scale, mul path
+        out = out * as_scalar(scales[1]) / (2.0 ** (int(bits[1]) - 1) - 1)
+    ctx.set_out(op, "Out", out)

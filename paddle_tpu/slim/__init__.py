@@ -1,0 +1,8 @@
+"""Model-compression toolkit (reference
+python/paddle/fluid/contrib/slim/): quantization-aware training and
+post-training quantization over static Programs."""
+from .quantization import (  # noqa: F401
+    PostTrainingQuantization,
+    QuantizationTransformPass,
+    quant_aware,
+)

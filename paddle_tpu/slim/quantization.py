@@ -1,0 +1,343 @@
+"""Quantization passes over static Programs.
+
+Role parity: reference python/paddle/fluid/contrib/slim/quantization/
+quantization_pass.py:216 (`QuantizationTransformPass` — insert fake
+quant/dequant around the weights and activations of quantizable ops)
+and post_training_quantization.py:120 (`PostTrainingQuantization` —
+calibrate activation scales by running the model over sample data).
+
+TPU-native notes: the reference pass edits an IrGraph and targets int8
+CUDA/MKLDNN kernels; here the pass edits the proto Program directly and
+the inserted ops (ops/quant_ops.py) simulate the int8 grid in float —
+on TPU the win is QAT fidelity + exportable scales, not int arithmetic.
+Gradients need no special handling: the qdq emission carries a
+straight-through estimator, so `minimize()` AFTER `apply()` trains
+through the quantized graph exactly like the reference's QAT flow.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..framework import unique_name
+from ..framework.program import Parameter, Program
+from ..initializer import ConstantInitializer
+
+# op type -> input slots eligible for quantization (weights + activations)
+_QUANT_SLOTS: Dict[str, Sequence[str]] = {
+    "conv2d": ("Input", "Filter"),
+    "depthwise_conv2d": ("Input", "Filter"),
+    "conv2d_transpose": ("Input", "Filter"),
+    "mul": ("X", "Y"),
+    "matmul": ("X", "Y"),
+    "matmul_v2": ("X", "Y"),
+}
+
+# weight quant_axis per (op type): conv filters are OIHW -> per-output-
+# channel axis 0; mul/matmul weights are [in, out] -> axis 1 (reference
+# quantization_pass.py channel-wise rules)
+_WEIGHT_AXIS = {"conv2d": 0, "depthwise_conv2d": 0, "conv2d_transpose": 1,
+                "mul": 1, "matmul": 1, "matmul_v2": 1}
+
+SKIP_QUANT_ATTR = "skip_quant"
+
+
+class QuantizationTransformPass:
+    """Insert fake quant-dequant ops in front of quantizable ops.
+
+    Weights get `abs_max` or `channel_wise_abs_max` qdq (recomputed from
+    the live weight every step, like the reference's weight path);
+    activations get `moving_average_abs_max` qdq with persistable
+    scale/state/accum accumulators, or stateless `abs_max`.  Run
+    ``apply(main, startup)`` BEFORE ``minimize`` so the backward pass
+    differentiates through the quantized graph.
+    """
+
+    def __init__(self, weight_bits=8, activation_bits=8,
+                 activation_quantize_type="moving_average_abs_max",
+                 weight_quantize_type="channel_wise_abs_max",
+                 moving_rate=0.9,
+                 quantizable_op_type=("conv2d", "depthwise_conv2d", "mul",
+                                      "matmul", "matmul_v2")):
+        if activation_quantize_type not in ("abs_max",
+                                            "moving_average_abs_max"):
+            raise ValueError(
+                f"unknown activation_quantize_type "
+                f"{activation_quantize_type!r}")
+        if weight_quantize_type not in ("abs_max", "channel_wise_abs_max"):
+            raise ValueError(
+                f"unknown weight_quantize_type {weight_quantize_type!r}")
+        self.weight_bits = int(weight_bits)
+        self.activation_bits = int(activation_bits)
+        self.activation_quantize_type = activation_quantize_type
+        self.weight_quantize_type = weight_quantize_type
+        self.moving_rate = float(moving_rate)
+        self.quantizable_op_type = set(quantizable_op_type)
+        # var name -> qdq output name, shared across consumers
+        self._dequantized: Dict[str, str] = {}
+
+    # -- helpers ---------------------------------------------------------
+
+    def _make_state_var(self, startup, name, shape, fill):
+        sb = startup.global_block
+        sv = sb.create_var(name=name, shape=list(shape), dtype="float32",
+                           persistable=True)
+        ConstantInitializer(fill)(sv, sb)
+
+    def _insert_qdq(self, program, startup, block, index, name, is_weight,
+                    weight_axis):
+        """Insert one qdq chain before ``index``; returns (new_name,
+        n_inserted)."""
+        var = block.var(name)
+        out_name = unique_name.generate(f"{name}.quant_dequant")
+        out = block.create_var(name=out_name, shape=list(var.shape),
+                               dtype=var.dtype, stop_gradient=False)
+        scale_name = unique_name.generate(f"{name}.quant_scale")
+        if is_weight:
+            if self.weight_quantize_type == "channel_wise_abs_max":
+                n_ch = int(var.shape[weight_axis])
+                block.create_var(name=scale_name, shape=[n_ch],
+                                 dtype="float32", stop_gradient=True)
+                block._insert_op(
+                    index, "fake_channel_wise_quantize_dequantize_abs_max",
+                    inputs={"X": [name]},
+                    outputs={"Out": [out_name], "OutScale": [scale_name]},
+                    attrs={"bit_length": self.weight_bits,
+                           "quant_axis": weight_axis})
+            else:
+                block.create_var(name=scale_name, shape=[1],
+                                 dtype="float32", stop_gradient=True)
+                block._insert_op(
+                    index, "fake_quantize_dequantize_abs_max",
+                    inputs={"X": [name]},
+                    outputs={"Out": [out_name], "OutScale": [scale_name]},
+                    attrs={"bit_length": self.weight_bits})
+            return out_name, 1
+
+        if self.activation_quantize_type == "abs_max":
+            block.create_var(name=scale_name, shape=[1], dtype="float32",
+                             stop_gradient=True)
+            block._insert_op(
+                index, "fake_quantize_dequantize_abs_max",
+                inputs={"X": [name]},
+                outputs={"Out": [out_name], "OutScale": [scale_name]},
+                attrs={"bit_length": self.activation_bits})
+            return out_name, 1
+
+        # moving-average: persistable scale/state/accum round-tripped
+        # through the op (reference quantization_pass.py:471)
+        state_name = unique_name.generate(f"{name}.quant_state")
+        accum_name = unique_name.generate(f"{name}.quant_accum")
+        for nm, fill in ((scale_name, 1.0), (state_name, 1.0),
+                         (accum_name, 1.0)):
+            block.create_var(name=nm, shape=[1], dtype="float32",
+                             persistable=True, stop_gradient=True)
+            self._make_state_var(startup, nm, [1], fill)
+        block._insert_op(
+            index, "fake_quantize_dequantize_moving_average_abs_max",
+            inputs={"X": [name], "InScale": [scale_name],
+                    "InState": [state_name], "InAccum": [accum_name]},
+            outputs={"Out": [out_name], "OutScale": [scale_name],
+                     "OutState": [state_name], "OutAccum": [accum_name]},
+            attrs={"bit_length": self.activation_bits,
+                   "moving_rate": self.moving_rate, "is_test": False})
+        return out_name, 1
+
+    # -- entry points ----------------------------------------------------
+
+    def apply(self, program: Program, startup_program: Program) -> Program:
+        """In-place: rewrite ``program`` so every quantizable op consumes
+        quant-dequantized inputs."""
+        block = program.global_block
+        i = 0
+        while i < len(block.ops):
+            op = block.ops[i]
+            if (op.type not in self.quantizable_op_type
+                    or op.type not in _QUANT_SLOTS
+                    or op.attr(SKIP_QUANT_ATTR, False)):
+                i += 1
+                continue
+            for slot in _QUANT_SLOTS[op.type]:
+                for name in list(op.input(slot)):
+                    if name in self._dequantized:
+                        op._rename_input(name, self._dequantized[name])
+                        continue
+                    var = block._find_var_recursive(name)
+                    if var is None:
+                        continue
+                    is_weight = isinstance(var, Parameter) or (
+                        getattr(var, "persistable", False))
+                    new_name, n = self._insert_qdq(
+                        program, startup_program, block, i, name, is_weight,
+                        _WEIGHT_AXIS.get(op.type, 0))
+                    i += n
+                    self._dequantized[name] = new_name
+                    op._rename_input(name, new_name)
+            i += 1
+        return program
+
+
+def quant_aware(program: Program, startup_program: Program,
+                config: Optional[dict] = None) -> Program:
+    """One-call QAT entry (reference paddleslim.quant.quant_aware)."""
+    cfg = dict(config or {})
+    return QuantizationTransformPass(**cfg).apply(program, startup_program)
+
+
+class PostTrainingQuantization:
+    """Calibrate activation scales over sample data, then emit a
+    quantized inference program with FIXED scales baked in.
+
+    Reference post_training_quantization.py:120: runs the model over
+    calibration batches, records the abs-max of every quantizable-op
+    input, then inserts quant/dequant with the collected scales.  Here
+    the calibration fetch rides the normal Executor (one compiled
+    XLA call per batch, activations fetched async) and the emitted
+    program uses moving-average qdq ops in is_test mode so the stored
+    scale is authoritative.
+    """
+
+    def __init__(self, executor, program: Program, feed_list: List[str],
+                 fetch_list: List, data_loader=None, scope=None,
+                 batch_nums: Optional[int] = None,
+                 weight_bits=8, activation_bits=8,
+                 weight_quantize_type="channel_wise_abs_max",
+                 quantizable_op_type=("conv2d", "depthwise_conv2d", "mul",
+                                      "matmul", "matmul_v2")):
+        self._exe = executor
+        self._program = program
+        self._feed_list = list(feed_list)
+        self._fetch_list = list(fetch_list)
+        self._loader = data_loader
+        self._scope = scope
+        self._batch_nums = batch_nums
+        self.weight_bits = int(weight_bits)
+        self.activation_bits = int(activation_bits)
+        self.weight_quantize_type = weight_quantize_type
+        self.quantizable_op_type = set(quantizable_op_type)
+        self._act_scales: Dict[str, float] = {}
+
+    def _activation_names(self) -> List[str]:
+        block = self._program.global_block
+        names, seen = [], set()
+        for op in block.ops:
+            if op.type not in self.quantizable_op_type or \
+                    op.type not in _QUANT_SLOTS:
+                continue
+            for slot in _QUANT_SLOTS[op.type]:
+                for name in op.input(slot):
+                    var = block._find_var_recursive(name)
+                    if var is None or isinstance(var, Parameter) or \
+                            getattr(var, "persistable", False):
+                        continue
+                    if name not in seen:
+                        seen.add(name)
+                        names.append(name)
+        return names
+
+    def quantize(self) -> Program:
+        if self._loader is None:
+            raise ValueError("PostTrainingQuantization needs a data_loader "
+                             "of calibration batches")
+        act_names = self._activation_names()
+        maxes = {n: 0.0 for n in act_names}
+        n_done = 0
+        for batch in self._loader:
+            if isinstance(batch, (list, tuple)):
+                feed = dict(zip(self._feed_list, batch))
+            else:
+                feed = dict(batch)
+            outs = self._exe.run(self._program, feed=feed,
+                                 fetch_list=act_names, scope=self._scope)
+            for name, val in zip(act_names, outs):
+                maxes[name] = max(maxes[name],
+                                  float(np.max(np.abs(np.asarray(val)))))
+            n_done += 1
+            if self._batch_nums and n_done >= self._batch_nums:
+                break
+        if n_done == 0:
+            raise ValueError("calibration data_loader yielded no batches")
+        self._act_scales = {n: max(v, 1e-8) for n, v in maxes.items()}
+        return self._emit_quantized_program()
+
+    def _emit_quantized_program(self) -> Program:
+        """Clone the program and insert qdq with the calibrated scales:
+        weights use live abs-max qdq (bit-exact with QAT export);
+        activations use moving-average qdq in is_test mode whose InScale
+        is a constant initialized to the calibrated value."""
+        prog = self._program.clone(for_test=True)
+        block = prog.global_block
+        dequantized: Dict[str, str] = {}
+        i = 0
+        while i < len(block.ops):
+            op = block.ops[i]
+            if (op.type not in self.quantizable_op_type
+                    or op.type not in _QUANT_SLOTS):
+                i += 1
+                continue
+            for slot in _QUANT_SLOTS[op.type]:
+                for name in list(op.input(slot)):
+                    if name in dequantized:
+                        op._rename_input(name, dequantized[name])
+                        continue
+                    var = block._find_var_recursive(name)
+                    if var is None:
+                        continue
+                    is_weight = isinstance(var, Parameter) or \
+                        getattr(var, "persistable", False)
+                    if not is_weight and name not in self._act_scales:
+                        continue
+                    out_name = unique_name.generate(f"{name}.ptq_dequant")
+                    block.create_var(name=out_name, shape=list(var.shape),
+                                     dtype=var.dtype)
+                    scale_name = unique_name.generate(f"{name}.ptq_scale")
+                    if is_weight:
+                        axis = _WEIGHT_AXIS.get(op.type, 0)
+                        if self.weight_quantize_type == \
+                                "channel_wise_abs_max":
+                            block.create_var(name=scale_name,
+                                             shape=[int(var.shape[axis])],
+                                             dtype="float32")
+                            block._insert_op(
+                                i, "fake_channel_wise_quantize_dequantize"
+                                   "_abs_max",
+                                inputs={"X": [name]},
+                                outputs={"Out": [out_name],
+                                         "OutScale": [scale_name]},
+                                attrs={"bit_length": self.weight_bits,
+                                       "quant_axis": axis})
+                        else:
+                            block.create_var(name=scale_name, shape=[1],
+                                             dtype="float32")
+                            block._insert_op(
+                                i, "fake_quantize_dequantize_abs_max",
+                                inputs={"X": [name]},
+                                outputs={"Out": [out_name],
+                                         "OutScale": [scale_name]},
+                                attrs={"bit_length": self.weight_bits})
+                        i += 1
+                    else:
+                        # constant calibrated scale, materialized in-graph
+                        block.create_var(name=scale_name, shape=[1],
+                                         dtype="float32")
+                        block._insert_op(
+                            i, "fill_constant",
+                            inputs={},
+                            outputs={"Out": [scale_name]},
+                            attrs={"shape": [1], "dtype": 1,  # DT_FP32
+                                   "value": float(
+                                       self._act_scales[name])})
+                        block._insert_op(
+                            i + 1,
+                            "fake_quantize_dequantize_moving_average_abs"
+                            "_max",
+                            inputs={"X": [name], "InScale": [scale_name]},
+                            outputs={"Out": [out_name]},
+                            attrs={"bit_length": self.activation_bits,
+                                   "is_test": True})
+                        i += 2
+                    dequantized[name] = out_name
+                    op._rename_input(name, out_name)
+            i += 1
+        return prog

@@ -1,0 +1,276 @@
+"""Quantization: fake_quant op parity vs numpy oracles, QAT transform
+pass (STE training), PTQ calibration round-trip.
+
+Parity model: reference operators/fake_quantize_op.cc (ClipAndFakeQuant,
+FindAbsMax, FindChannelAbsMax, FindMovingAverage, FindRangeAbsMax),
+contrib/slim/quantization/quantization_pass.py:216,
+post_training_quantization.py:120.
+"""
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.framework.place import CPUPlace
+from paddle_tpu.framework.program import Program, program_guard
+from paddle_tpu.optimizer.static_opt import SGDOptimizer
+from paddle_tpu.slim import (
+    PostTrainingQuantization,
+    QuantizationTransformPass,
+)
+
+from op_test import OpTest, skip_check_grad_ci
+
+
+def _q(x, scale, qmax=127.0):
+    return np.clip(np.round(x / scale * qmax), -qmax, qmax)
+
+
+@skip_check_grad_ci(reason="round has zero true gradient; STE covered "
+                          "by the QAT training test")
+class TestFakeQuantizeAbsMax(OpTest):
+    op_type = "fake_quantize_abs_max"
+
+    def setup(self):
+        rs = np.random.RandomState(0)
+        x = rs.randn(4, 6).astype("f4")
+        scale = np.abs(x).max()
+        self.inputs = {"X": [("x", x)]}
+        self.attrs = {"bit_length": 8}
+        self.outputs = {"Out": [("o", _q(x, scale).astype("f4"))],
+                        "OutScale": [("s", np.array([scale], "f4"))]}
+
+    def test_output(self):
+        self.check_output()
+
+
+@skip_check_grad_ci(reason="STE covered by QAT training test")
+class TestFakeQuantizeDequantizeAbsMax(OpTest):
+    op_type = "fake_quantize_dequantize_abs_max"
+
+    def setup(self):
+        rs = np.random.RandomState(1)
+        x = rs.randn(3, 5).astype("f4")
+        scale = np.abs(x).max()
+        out = (_q(x, scale) * scale / 127.0).astype("f4")
+        self.inputs = {"X": [("x", x)]}
+        self.attrs = {"bit_length": 8}
+        self.outputs = {"Out": [("o", out)],
+                        "OutScale": [("s", np.array([scale], "f4"))]}
+
+    def test_output(self):
+        self.check_output()
+
+
+@skip_check_grad_ci(reason="STE covered by QAT training test")
+class TestFakeChannelWiseQuantizeAbsMax(OpTest):
+    op_type = "fake_channel_wise_quantize_abs_max"
+
+    def setup(self):
+        rs = np.random.RandomState(2)
+        x = rs.randn(4, 3, 2, 2).astype("f4")  # OIHW, quant_axis 0
+        scales = np.abs(x).reshape(4, -1).max(axis=1)
+        out = _q(x, scales.reshape(4, 1, 1, 1)).astype("f4")
+        self.inputs = {"X": [("x", x)]}
+        self.attrs = {"bit_length": 8, "quant_axis": 0}
+        self.outputs = {"Out": [("o", out)],
+                        "OutScale": [("s", scales.astype("f4"))]}
+
+    def test_output(self):
+        self.check_output()
+
+
+@skip_check_grad_ci(reason="state update, not a training op")
+class TestFakeQuantizeMovingAverageAbsMax(OpTest):
+    op_type = "fake_quantize_moving_average_abs_max"
+
+    def setup(self):
+        rs = np.random.RandomState(3)
+        x = rs.randn(4, 4).astype("f4")
+        rate = 0.9
+        state = rate * 1.0 + 1.0
+        accum = rate * 1.0 + np.abs(x).max()
+        scale = accum / state
+        self.inputs = {"X": [("x", x)],
+                       "InScale": [("is", np.array([1.0], "f4"))],
+                       "InState": [("ist", np.array([1.0], "f4"))],
+                       "InAccum": [("ia", np.array([1.0], "f4"))]}
+        self.attrs = {"bit_length": 8, "moving_rate": rate,
+                      "is_test": False}
+        self.outputs = {
+            "Out": [("o", _q(x, scale).astype("f4"))],
+            "OutScale": [("os", np.array([scale], "f4"))],
+            "OutState": [("ost", np.array([state], "f4"))],
+            "OutAccum": [("oa", np.array([accum], "f4"))]}
+
+    def test_output(self):
+        self.check_output()
+
+
+@skip_check_grad_ci(reason="windowed state update")
+class TestFakeQuantizeRangeAbsMax(OpTest):
+    op_type = "fake_quantize_range_abs_max"
+
+    def setup(self):
+        rs = np.random.RandomState(4)
+        x = rs.randn(4, 4).astype("f4")
+        window = np.array([0.5, 3.0, 0.0, 0.0], "f4")  # it=1 slot updated
+        cur = np.abs(x).max()
+        new_window = window.copy()
+        new_window[1] = cur
+        scale = max(new_window.max(), 1e-8)
+        self.inputs = {"X": [("x", x)],
+                       "InScale": [("is", np.array([0.5], "f4"))],
+                       "InScales": [("iw", window)],
+                       "Iter": [("it", np.array([1], "i4"))]}
+        self.attrs = {"bit_length": 8, "window_size": 4,
+                      "is_test": False}
+        self.outputs = {
+            "Out": [("o", _q(x, scale).astype("f4"))],
+            "OutScale": [("os", np.array([scale], "f4"))],
+            "OutScales": [("ow", new_window)],
+            "OutIter": [("oi", np.array([2], "i4"))]}
+
+    def test_output(self):
+        self.check_output()
+
+
+@skip_check_grad_ci(reason="pure dequant scaling")
+class TestFakeDequantizeMaxAbs(OpTest):
+    op_type = "fake_dequantize_max_abs"
+
+    def setup(self):
+        rs = np.random.RandomState(5)
+        x = _q(rs.randn(3, 4), 2.0).astype("f4")
+        self.inputs = {"X": [("x", x)],
+                       "Scale": [("s", np.array([2.0], "f4"))]}
+        self.attrs = {"max_range": 127.0}
+        self.outputs = {"Out": [("o", (x * 2.0 / 127.0).astype("f4"))]}
+
+    def test_output(self):
+        self.check_output()
+
+
+# -- graph-level: QAT + PTQ -------------------------------------------
+
+
+def _lenet_programs(qat_pass=None, with_loss=True):
+    """Tiny conv net; optionally quantized BEFORE minimize (QAT).
+    ``with_loss=False`` builds the inference form (the program shape
+    PostTrainingQuantization expects, like the reference's
+    load_inference_model output)."""
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        img = layers.data("img", shape=[1, 8, 8], dtype="float32")
+        h = layers.conv2d(img, num_filters=4, filter_size=3, act="relu")
+        h = layers.pool2d(h, pool_size=2, pool_type="max")
+        h = layers.fc(h, size=4)
+        if not with_loss:
+            return main, startup, h
+        lbl = layers.data("lbl", shape=[1], dtype="int32")
+        loss = layers.mean(layers.softmax_with_cross_entropy(h, lbl))
+        if qat_pass is not None:
+            qat_pass.apply(main, startup)
+        SGDOptimizer(learning_rate=0.1).minimize(loss)
+    return main, startup, loss
+
+
+def _proto_batch(rs, protos, n=32):
+    c = rs.randint(0, 4, n)
+    x = protos[c] + 0.1 * rs.randn(n, 1, 8, 8).astype("f4")
+    return x.astype("f4"), c.reshape(-1, 1).astype("i4")
+
+
+def test_qat_lenet_trains():
+    """QAT: the quantized graph trains through the STE — loss drops and
+    the quantizable ops now consume quant-dequantized inputs."""
+    tp = QuantizationTransformPass()
+    main, startup, loss = _lenet_programs(qat_pass=tp)
+    qdq_types = [op.type for op in main.global_block.ops
+                 if op.type.startswith("fake_")]
+    assert any("channel_wise" in t for t in qdq_types), qdq_types
+    assert any("moving_average" in t for t in qdq_types), qdq_types
+
+    exe = pt.Executor(CPUPlace())
+    exe.run(startup)
+    rs = np.random.RandomState(0)
+    protos = rs.randn(4, 1, 8, 8).astype("f4")
+    losses = []
+    for step in range(40):
+        x, y = _proto_batch(rs, protos)
+        out = exe.run(main, feed={"img": x, "lbl": y}, fetch_list=[loss])
+        losses.append(float(np.asarray(out[0])))
+    assert losses[0] / losses[-1] > 2.0, (losses[0], losses[-1])
+
+
+def test_qat_moving_average_scale_updates():
+    """The persistable activation-scale accumulators must move during
+    training (the op round-trips its state through the scope)."""
+    tp = QuantizationTransformPass()
+    main, startup, loss = _lenet_programs(qat_pass=tp)
+    scale_vars = [op.output("OutScale")[0]
+                  for op in main.global_block.ops
+                  if op.type ==
+                  "fake_quantize_dequantize_moving_average_abs_max"]
+    assert scale_vars
+    exe = pt.Executor(CPUPlace())
+    scope = pt.framework.Scope()
+    exe.run(startup, scope=scope)
+    rs = np.random.RandomState(1)
+    protos = rs.randn(4, 1, 8, 8).astype("f4")
+    x, y = _proto_batch(rs, protos)
+    exe.run(main, feed={"img": x, "lbl": y}, fetch_list=[loss],
+            scope=scope)
+    v0 = np.asarray(scope.find_var(scale_vars[0]).get_tensor())
+    exe.run(main, feed={"img": x, "lbl": y}, fetch_list=[loss],
+            scope=scope)
+    v1 = np.asarray(scope.find_var(scale_vars[0]).get_tensor())
+    assert not np.allclose(v0, 1.0), v0  # moved off the init
+    assert not np.allclose(v0, v1)  # still adapting
+
+
+def test_qat_clone_for_test_freezes_scales():
+    tp = QuantizationTransformPass()
+    main, startup, _ = _lenet_programs(qat_pass=tp)
+    test_prog = main.clone(for_test=True)
+    for op in test_prog.global_block.ops:
+        if op.type == "fake_quantize_dequantize_moving_average_abs_max":
+            assert op.attr("is_test") is True
+            return
+    raise AssertionError("no moving-average qdq op found in clone")
+
+
+def test_ptq_round_trip_close_to_fp32():
+    """PTQ: calibrate on sample batches; the quantized inference program
+    must track the fp32 program within int8 simulation tolerance."""
+    main, startup, logits = _lenet_programs(with_loss=False)
+    infer = main.clone(for_test=True)
+    exe = pt.Executor(CPUPlace())
+    scope = pt.framework.Scope()
+    exe.run(startup, scope=scope)
+
+    rs = np.random.RandomState(2)
+    protos = rs.randn(4, 1, 8, 8).astype("f4")
+    fc_out = [op for op in infer.global_block.ops if op.type == "mul"]
+    assert fc_out
+
+    calib = [{"img": _proto_batch(rs, protos)[0]} for _ in range(4)]
+    ptq = PostTrainingQuantization(
+        exe, infer, feed_list=["img"], fetch_list=[],
+        data_loader=calib, scope=scope, batch_nums=4)
+    qprog = ptq.quantize()
+    qdq = [op.type for op in qprog.global_block.ops
+           if op.type.startswith("fake_")]
+    assert qdq, "PTQ emitted no quant ops"
+
+    x, _ = _proto_batch(rs, protos, n=16)
+    # compare the final quantizable op's output downstream: fetch loss
+    # inputs is awkward; instead fetch the fc output var by name
+    out_name = fc_out[-1].output("Out")[0]
+    ref = np.asarray(exe.run(infer, feed={"img": x},
+                             fetch_list=[out_name], scope=scope)[0])
+    got = np.asarray(exe.run(qprog, feed={"img": x},
+                             fetch_list=[out_name], scope=scope)[0])
+    # int8 simulation error bound: a few quantization steps
+    denom = max(np.abs(ref).max(), 1e-6)
+    assert np.abs(ref - got).max() / denom < 0.1, \
+        np.abs(ref - got).max() / denom
