@@ -67,6 +67,7 @@ from .framework import (  # noqa: F401
 from . import distribution  # noqa: F401
 from . import inference  # noqa: F401
 from . import jit  # noqa: F401
+from . import monitor  # noqa: F401
 from . import profiler  # noqa: F401
 from . import static  # noqa: F401
 from . import text  # noqa: F401

@@ -315,6 +315,75 @@ class GradientMergeMetaOptimizer(MetaOptimizerBase):
         return opt_ops, params_grads
 
 
+class DGCMetaOptimizer(MetaOptimizerBase):
+    """Deep gradient compression (reference
+    fleet/meta_optimizers/dgc_optimizer.py + operators/dgc_op.cc):
+    per-param momentum/residual accumulators feed a top-k sparsifying
+    `dgc` op between backward and the optimizer apply; the sparsified
+    grad is what rides the data-parallel allreduce.
+
+    Pair with a plain SGD inner optimizer: the momentum correction
+    lives INSIDE the dgc op's U accumulator (the reference's
+    DGCMomentumOptimizer collapses both for the same reason — applying
+    an outer momentum too would double it)."""
+
+    def _can_apply(self):
+        return self.user_strategy.dgc
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        from ...framework import unique_name
+        from ...framework.program import default_startup_program
+        from ...initializer import ConstantInitializer
+
+        cfg = self.user_strategy.dgc_configs or {}
+        ratio = 1.0 - float((cfg.get("sparsity") or [0.999])[0])
+        rampup_begin = float(cfg.get("rampup_begin_step", 0))
+        m = 0.9  # reference DGCMomentumOptimizer default; DGCConfig
+        # carries no momentum field (distributed_strategy.proto)
+
+        params_grads = self.inner_opt.backward(
+            loss, startup_program, parameter_list, no_grad_set)
+        block = loss.block.program.global_block
+        startup = startup_program or default_startup_program()
+
+        def persistent(name, shape, value):
+            v = block.create_var(name=name, shape=list(shape),
+                                 dtype="float32", persistable=True,
+                                 stop_gradient=True)
+            sv = startup.global_block.create_var(
+                name=name, shape=list(shape), dtype="float32",
+                persistable=True)
+            ConstantInitializer(value)(sv, startup.global_block)
+            return v
+
+        step = persistent(unique_name.generate("dgc_step"), [1], 0.0)
+        block.append_op("increment", {"X": [step.name]},
+                        {"Out": [step.name]}, {"step": 1.0})
+
+        compressed = []
+        for p, g in params_grads:
+            u = persistent(unique_name.generate(p.name + "_dgc_u"),
+                           p.shape, 0.0)
+            v = persistent(unique_name.generate(p.name + "_dgc_v"),
+                           p.shape, 0.0)
+            enc = block.create_var(
+                name=unique_name.generate(g.name + ".dgc"),
+                shape=list(p.shape), dtype="float32", stop_gradient=True)
+            block.append_op(
+                "dgc",
+                {"Grad": [g.name], "U": [u.name], "V": [v.name],
+                 "CurrentStep": [step.name]},
+                {"U_out": [u.name], "V_out": [v.name],
+                 "EncodeGrad": [enc.name], "Grad_out": [enc.name]},
+                {"m": m, "ratio": ratio,
+                 "rampup_begin_step": rampup_begin})
+            compressed.append((p, block.var(enc.name)))
+        opt_ops = self.inner_opt.apply_gradients(compressed)
+        loss.block.program._bump()
+        return opt_ops, params_grads
+
+
 class FP16AllReduceMetaOptimizer(MetaOptimizerBase):
     """Cast grads to fp16/bf16 around the allreduce
     (reference fp16_allreduce_optimizer.py)."""
@@ -629,6 +698,7 @@ META_OPTIMIZERS = [
     # GradientMerge innermost of the wrappers: it drives backward/apply
     # directly, so program-rewrite metas (AMP) must run outside it
     GradientMergeMetaOptimizer,
+    DGCMetaOptimizer,
     AMPMetaOptimizer,
     RecomputeMetaOptimizer,
     FP16AllReduceMetaOptimizer,
@@ -641,7 +711,7 @@ META_OPTIMIZERS = [
 # strategy flags with no implementation yet: refuse loudly rather than
 # silently training without the requested behavior (the reference raises
 # when a meta-optimizer is unavailable too)
-_UNSUPPORTED_FLAGS = ("dgc", "a_sync", "elastic", "tensor_parallel",
+_UNSUPPORTED_FLAGS = ("a_sync", "elastic", "tensor_parallel",
                       "sequence_parallel")
 
 

@@ -182,6 +182,22 @@ def _trace_ifelse(rec, pred, true_fn, false_fn, args):
     return outs[0]
 
 
+def ret_select(flag, then_fn, else_fn):
+    """Value select for the rewritten return cascade
+    (_ReturnRewriter): chooses the fired return site's expression.
+    Python flags evaluate only the taken leg; tensor flags trace both
+    and merge (layers.cond in static graphs, cond_pair under trace)."""
+    if _is_static_var(flag):
+        from .. import layers
+
+        return layers.cond(flag, then_fn, else_fn)
+    rec = _tracing()
+    if rec is not None and _is_dytensor(flag):
+        return _trace_ifelse(rec, flag, lambda: then_fn(),
+                             lambda: else_fn(), ())
+    return then_fn() if _truth(flag) else else_fn()
+
+
 def convert_while_loop(cond_fn, body_fn, names, caller_locals):
     """Reference convert_operators.convert_while_loop."""
     args = tuple(caller_locals.get(n, _Undefined(n)) for n in names)
@@ -217,7 +233,24 @@ def _trace_while(rec, cond_fn, body_fn, args, probe=None):
     vals = tuple(
         v if isinstance(v, _Undefined) else _wrap_tensor(v) for v in args)
     parent = rec.block
-    var_names = [rec.ensure_name(v) if not isinstance(v, _Undefined)
+
+    def carry_name(v):
+        """A loop-carried var must be a per-call TEMPORARY: captured
+        python scalars (break/return flags) land in persistable consts,
+        and carrying the const itself would make the while's write-back
+        mutate saved state — replay N's final flag would become replay
+        N+1's initial value.  Copy persistables into a parent temp and
+        carry that."""
+        n = rec.ensure_name(v)
+        var = parent._find_var_recursive(n)
+        if var is not None and getattr(var, "persistable", False):
+            tmp = rec.new_parent_var(parent, v)
+            parent.append_op("assign", {"X": [n]}, {"Out": [tmp]}, {})
+            rec.bind(v, tmp)
+            return tmp
+        return n
+
+    var_names = [carry_name(v) if not isinstance(v, _Undefined)
                  else None for v in vals]
 
     if probe is not None and all(v is a for v, a in zip(vals, args)):
@@ -341,6 +374,40 @@ def to_bool(x):
     """Eager truth value for the real break/continue guards kept inside
     python container loops (tensors evaluate eagerly)."""
     return _truth(x)
+
+
+def convert_iterable(it):
+    """for-over-tensor support (reference dygraph_to_static/
+    break_continue_transformer.py:31 ForToWhileTransformer +
+    list_transformer.py:90 list semantics): a tensor with a static
+    leading dim iterates as its rows.  TPU-native design: static shapes
+    make UNROLLING the idiomatic lowering — each row access records a
+    slice, XLA sees a flat op sequence it can fuse, and python-list
+    accumulation (append in the loop, concat/stack after) works
+    unchanged because the list lives at trace time."""
+    if _is_static_var(it) or _is_dytensor(it):
+        shape = getattr(it, "shape", None)
+        if not shape or shape[0] is None or int(shape[0]) < 0:
+            raise NotImplementedError(
+                "to_static can only iterate a tensor whose leading "
+                "dimension is static; got shape " + repr(shape))
+        n = int(shape[0])
+        if _is_static_var(it):
+            from .. import layers
+
+            return [layers.squeeze(
+                layers.slice(it, axes=[0], starts=[k], ends=[k + 1]),
+                axes=[0]) for k in range(n)]
+        # dygraph: rows come from the IR slice op (run_op records it on
+        # an active trace — plain jnp indexing would be trace-invisible
+        # and bake the traced input's rows as constants)
+        from .eager import run_op
+
+        return [run_op("slice", {"Input": it},
+                       {"axes": [0], "starts": [k], "ends": [k + 1],
+                        "decrease_axis": [0]})["Out"]
+                for k in range(n)]
+    return it
 
 
 def init_loop_var(caller_locals, name, default):
@@ -513,6 +580,134 @@ class _BreakContinueRewriter:
         return st, False
 
 
+def _legacy_return_ok(stmts) -> bool:
+    """True when every `return` already sits where the direct conversion
+    handles it: the block's final statement, or a tail-position if/else
+    whose BOTH branches end in return.  Anything else (return in a loop,
+    guard-style early return, mixed forms) goes through _ReturnRewriter.
+    """
+    for i, s in enumerate(stmts):
+        if not _contains_return([s]):
+            continue
+        tail = i == len(stmts) - 1
+        if isinstance(s, ast.Return):
+            if not tail:
+                return False
+        elif isinstance(s, ast.If):
+            if not (tail and s.body and s.orelse
+                    and isinstance(s.body[-1], ast.Return)
+                    and isinstance(s.orelse[-1], ast.Return)
+                    and _legacy_return_ok(s.body[:-1] or [])
+                    and _legacy_return_ok(s.orelse[:-1] or [])):
+                return False
+        else:
+            return False
+    return True
+
+
+class _ReturnRewriter:
+    """Reference dygraph_to_static/return_transformer.py:135, in a form
+    that fits the trace machinery: each return SITE k becomes a boolean
+    flag assignment ``_pt_ret_f<k> = True`` (plus ``break`` inside
+    loops — the loop converter folds it into the loop condition for
+    tensor flags); statements after a possibly-returning construct are
+    guarded by ``not (f1 or f2 or ...)``; and the function closes with
+    ONE nested select ``ret_select(f1, e1, ret_select(f2, e2, tail))``
+    that re-evaluates each site's expression at function end.
+
+    Why flags-only (no carried return VALUE): a carried value would
+    need a typed initial placeholder before the first loop, which is
+    unknowable statically.  Re-evaluating e_k at the end is sound
+    because once a flag fires every later statement is guarded, so the
+    variables e_k reads still hold their values from the firing point
+    (loop vars exit through the normal carry)."""
+
+    def __init__(self):
+        self.flags: List[str] = []
+        self.sites: List = []  # [(flag, expr_src)] in program order
+        self.tail_expr = "None"
+
+    def _fired(self):
+        return " or ".join(self.flags) if self.flags else "False"
+
+    def rewrite_function(self, fdef):
+        body = self._block(list(fdef.body), in_loop=False, top=True)
+        init = _parse_stmts(
+            "\n".join(f"{f} = False" for f in self.flags))
+        ret = "(" + self.tail_expr + ")"
+        for f, e in reversed(self.sites):
+            ret = (f"_jst.ret_select({f}, lambda: ({e}), "
+                   f"lambda: {ret})")
+        fdef.body = init + body + _parse_stmts(f"return {ret}")
+
+    def _block(self, stmts, in_loop, top=False):
+        out = []
+        for idx, s in enumerate(stmts):
+            if isinstance(s, ast.Return):
+                expr = ast.unparse(s.value) if s.value is not None \
+                    else "None"
+                if top and idx == len(stmts) - 1:
+                    self.tail_expr = expr  # the default select leg
+                    return out
+                flag = f"_pt_ret_f{len(self.flags) + 1}"
+                self.flags.append(flag)
+                self.sites.append((flag, expr))
+                out += _parse_stmts(f"{flag} = True")
+                if in_loop:
+                    out.append(ast.Break())
+                return out  # statements after `return` are unreachable
+            if not _contains_return([s]):
+                out.append(s)
+                continue
+            if isinstance(s, ast.If):
+                s.body = self._block(s.body, in_loop)
+                s.orelse = self._block(s.orelse, in_loop)
+            elif isinstance(s, (ast.While, ast.For)):
+                s.body = self._block(s.body, in_loop=True)
+            else:
+                raise NotImplementedError(
+                    f"to_static does not support `return` inside a "
+                    f"{type(s).__name__.lower()} block")
+            out.append(s)
+            if in_loop:
+                # the construct may have fired a return: exit the
+                # ENCLOSING loop too
+                out += _parse_stmts(f"if {self._fired()}:\n    break")
+            rest = self._block(list(stmts[idx + 1:]), in_loop, top=top)
+            if rest:
+                guard = ast.parse(
+                    f"if _jst.convert_logical_not({self._fired()}):\n"
+                    f"    pass").body[0]
+                guard.body = rest
+                out.append(guard)
+            return out
+        return out
+
+
+def _is_append_stmt(s):
+    return (isinstance(s, ast.Expr) and isinstance(s.value, ast.Call)
+            and isinstance(s.value.func, ast.Attribute)
+            and s.value.func.attr == "append"
+            and isinstance(s.value.func.value, ast.Name)
+            and len(s.value.args) == 1 and not s.value.keywords)
+
+
+def _branch_appends(stmts):
+    """Top-level ``name.append(expr)`` statements: [(list_name, idx)]."""
+    return [(s.value.func.value.id, i) for i, s in enumerate(stmts)
+            if _is_append_stmt(s)]
+
+
+def _replace_append(stmts, lname, tmp):
+    """Swap the first top-level ``lname.append(e)`` for ``tmp = e``."""
+    for i, s in enumerate(stmts):
+        if _is_append_stmt(s) and s.value.func.value.id == lname:
+            stmts[i] = ast.copy_location(
+                _parse_stmts(f"{tmp} = {ast.unparse(s.value.args[0])}")[0],
+                s)
+            return
+
+
 class _Dy2StaticTransformer(ast.NodeTransformer):
     def __init__(self):
         self.n = 0
@@ -584,6 +779,27 @@ class _Dy2StaticTransformer(ast.NodeTransformer):
             )
             return _parse_stmts(src)
 
+        # list_transformer role (reference list_transformer.py:90):
+        # symmetric `L.append(e)` in both branches hoists to a merged
+        # temp assigned in each branch + ONE append after the merge, so
+        # the appended value is a parent-block cond output instead of a
+        # sub-block temp the rest of the graph cannot read
+        post = []
+        appends_t = _branch_appends(node.body)
+        appends_f = _branch_appends(node.orelse)
+        if appends_t and [a[0] for a in appends_t] == \
+                [a[0] for a in appends_f]:
+            for k, ((lname, _), _) in enumerate(zip(appends_t, appends_f)):
+                tmp = f"_pt_app_{i}_{k}"
+                _replace_append(node.body, lname, tmp)
+                _replace_append(node.orelse, lname, tmp)
+                post += _parse_stmts(f"{lname}.append({tmp})")
+            outs = sorted(set(outs)
+                          | {f"_pt_app_{i}_{k}"
+                             for k in range(len(appends_t))})
+            arglist = ", ".join(outs)
+            names_lit = repr(tuple(outs))
+
         ret_tuple = "(" + ", ".join(outs) + ("," if len(outs) == 1 else "") \
             + ")" if outs else "()"
         target = ret_tuple if outs else "_pt_void_%d" % i
@@ -597,7 +813,7 @@ class _Dy2StaticTransformer(ast.NodeTransformer):
             f"{target} = _jst.convert_ifelse(({test_src}), _pt_t_{i}, "
             f"_pt_f_{i}, {names_lit}, locals())\n"
         )
-        return _parse_stmts(src)
+        return _parse_stmts(src) + post
 
     # -- loops --------------------------------------------------------
     def _build_while(self, i, test_src, body_stmts, init_src, outs):
@@ -661,12 +877,17 @@ class _Dy2StaticTransformer(ast.NodeTransformer):
         it = node.iter
         if not (isinstance(it, ast.Call) and isinstance(it.func, ast.Name)
                 and it.func.id == "range"):
-            # iteration over python containers stays a python loop, but
-            # its body still converts (tensor ifs must not bake).  A raw
+            # tensors iterate as their rows (convert_iterable unrolls a
+            # static leading dim); python containers pass through.
+            # Either way the loop stays a python loop whose body still
+            # converts (tensor ifs must not bake).  A raw
             # break/continue cannot move into a generated branch
             # function (SyntaxError), so rewrite them into flags first
             # and emit REAL break/continue at the loop-body top level,
             # guarded by the (possibly tensor-valued) flags.
+            node.iter = ast.parse(
+                f"_jst.convert_iterable({ast.unparse(it)})",
+                mode="eval").body
             if _contains_break_or_continue(node.body):
                 i = self._next()
                 rw = _BreakContinueRewriter(i)
@@ -775,6 +996,8 @@ def convert_to_static(fn):
     if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
         return fn
     fdef.decorator_list = []  # strip @to_static etc. (reference does too)
+    if _contains_return(fdef.body) and not _legacy_return_ok(fdef.body):
+        _ReturnRewriter().rewrite_function(fdef)
     _Dy2StaticTransformer().visit(fdef)
     ast.fix_missing_locations(tree)
 

@@ -193,7 +193,21 @@ class Tensor:
     def __getitem__(self, idx):
         from .eager import apply_jax
 
+        # jnp indexing CLAMPS out-of-range indices, but the python
+        # sequence protocol (iteration, reversed, in) needs IndexError
+        # to terminate — without it `for row in tensor` spins forever
+        if isinstance(idx, (int, np.integer)):
+            n = int(self._value.shape[0]) if self._value.ndim else 0
+            if idx < -n or idx >= n:
+                raise IndexError(
+                    f"index {idx} out of range for dim 0 of size {n}")
         return apply_jax(lambda v: v[idx], self)
+
+    def __iter__(self):
+        """Iterate rows (reference VarBase iterates dim 0)."""
+        if self._value.ndim == 0:
+            raise TypeError("iteration over a 0-d tensor")
+        return (self[i] for i in range(int(self._value.shape[0])))
 
     # -- common methods -----------------------------------------------------
     def astype(self, dtype):

@@ -397,13 +397,19 @@ class Executor:
             ops is not None,
             nan_scan,
         )
+        from ..monitor import stat_add
+
         entry = self._cache.get(key)
         if entry is None:
+            stat_add("executor_compile")
             entry = self._compile(program, spec, state_in, state_out,
                                   fetch_names, mesh=mesh,
                                   multi_step=multi_step, scan_steps=scan_steps,
                                   ops=ops, nan_scan=nan_scan)
             self._cache[key] = entry
+        else:
+            stat_add("executor_cache_hit")
+        stat_add("executor_run")
 
         # rng key lives in the scope so runs are deterministic/resumable
         if not scope.has_var(RNG_VAR) or scope.get_var(RNG_VAR) is None:

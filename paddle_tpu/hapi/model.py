@@ -29,7 +29,16 @@ class InputSpec:
 
 
 class Model:
+    """Mode follows the global graph mode at construction (reference
+    hapi/model.py:819 picks _AdapterStatic vs dynamic the same way):
+    under ``paddle_tpu.enable_static()`` the Model builds train/eval/
+    predict Programs once in prepare() and drives them through the
+    Executor — one XLA compile per program, the TPU-friendly loop —
+    while dygraph mode runs eager batches."""
+
     def __init__(self, network, inputs=None, labels=None):
+        from ..dygraph.base import in_dygraph_mode
+
         self.network = network
         self._inputs = inputs
         self._labels = labels
@@ -37,6 +46,8 @@ class Model:
         self._loss = None
         self._metrics: List[Metric] = []
         self.stop_training = False
+        self._static_mode = not in_dygraph_mode()
+        self._st = None  # static-mode program bundle
 
     # -- setup -----------------------------------------------------------
     def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
@@ -48,7 +59,119 @@ class Model:
             self._metrics = metrics if isinstance(metrics, (list, tuple)) else [metrics]
         for m in self._metrics:
             assert isinstance(m, Metric), "metrics must be paddle.metric.Metric"
+        if self._static_mode:
+            self._build_static()
         return self
+
+    # -- static adapter ---------------------------------------------------
+    def _swap_params_static(self):
+        """Swap every eager parameter for a static graph Parameter (same
+        name, NumpyArrayInitializer from the live value) for the
+        duration of a program build — otherwise the dual dispatch bakes
+        the weights as inline constants and nothing trains.  Returns the
+        restore list."""
+        from ..initializer import NumpyArrayInitializer
+        from ..layer_helper import LayerHelper
+        from ..param_attr import ParamAttr
+
+        helper = LayerHelper("hapi_static")
+        saved = []
+        for _, sub in self.network.named_sublayers(include_self=True):
+            for pname, p in list(sub._parameters.items()):
+                if p is None:
+                    continue
+                arr = np.asarray(p.numpy())
+                sv = helper.create_parameter(
+                    attr=ParamAttr(name=p.name,
+                                   initializer=NumpyArrayInitializer(arr),
+                                   trainable=getattr(p, "trainable", True)),
+                    shape=list(arr.shape), dtype=str(arr.dtype))
+                saved.append((sub, pname, p))
+                sub._parameters[pname] = sv
+        return saved
+
+    @staticmethod
+    def _restore_params(saved):
+        for sub, pname, p in saved:
+            sub._parameters[pname] = p
+
+    def _build_static(self):
+        from .. import Executor, layers
+        from ..framework.place import _default_place
+        from ..framework.program import Program, program_guard
+        from ..framework.scope import Scope
+
+        if not self._inputs:
+            raise ValueError(
+                "static-graph Model needs inputs=[InputSpec(...)] at "
+                "construction (reference hapi/model.py static adapter)")
+
+        def feeds(specs, prefix):
+            vars_ = []
+            for i, s in enumerate(specs or []):
+                shape = list(s.shape)
+                if shape and (shape[0] is None or shape[0] == -1):
+                    shape = shape[1:]  # layers.data adds the batch dim
+                vars_.append(layers.data(s.name or f"{prefix}_{i}", shape,
+                                         dtype=s.dtype))
+            return vars_
+
+        st = {"startup": Program(), "train": Program()}
+        with program_guard(st["train"], st["startup"]):
+            saved = self._swap_params_static()
+            try:
+                ins = feeds(self._inputs, "input")
+                lbs = feeds(self._labels, "label")
+                outs = self.network(*ins)
+                outs_l = list(outs) if isinstance(outs, (list, tuple)) \
+                    else [outs]
+                st["feed_names"] = [v.name for v in ins]
+                st["label_names"] = [v.name for v in lbs]
+                st["out_names"] = [o.name for o in outs_l]
+                loss = None
+                if self._loss is not None and lbs:
+                    loss = self._loss(*outs_l, *lbs)
+                    st["loss_name"] = loss.name
+                # eval shares the graph with is_test flipped, cloned
+                # BEFORE the optimizer ops join
+                st["eval"] = st["train"].clone(for_test=True)
+                if self._optimizer is not None and loss is not None:
+                    self._optimizer.minimize(
+                        loss, startup_program=st["startup"])
+            finally:
+                self._restore_params(saved)
+        # predict program: same network, no labels; parameters keep
+        # their names, so it reads the one scope the train startup fills
+        st["predict"] = Program()
+        with program_guard(st["predict"], Program()):
+            saved = self._swap_params_static()
+            try:
+                ins = feeds(self._inputs, "input")
+                outs = self.network(*ins)
+                outs_l = list(outs) if isinstance(outs, (list, tuple)) \
+                    else [outs]
+                st["pred_feed_names"] = [v.name for v in ins]
+                st["pred_out_names"] = [o.name for o in outs_l]
+            finally:
+                self._restore_params(saved)
+        st["predict"] = st["predict"].clone(for_test=True)
+        st["scope"] = Scope()
+        st["exe"] = Executor(_default_place())
+        st["exe"].run(st["startup"], scope=st["scope"])
+        self._st = st
+
+    def _sync_scope_to_network(self):
+        """After static training, push scope values back into the eager
+        parameters (names tie them) so save()/state_dict see the result."""
+        scope = self._st["scope"]
+        for p in self.network.parameters():
+            v = scope.find_var(p.name) if scope.has_var(p.name) else None
+            if v is not None:
+                p.set_value(np.asarray(v.get_tensor()))
+
+    def _static_feed(self, names, data):
+        vals = data if isinstance(data, (list, tuple)) else [data]
+        return {n: np.asarray(v) for n, v in zip(names, vals)}
 
     # -- single-batch steps ----------------------------------------------
     def _to_vars(self, data):
@@ -57,6 +180,8 @@ class Model:
         return [to_variable(np.asarray(data))]
 
     def train_batch(self, inputs, labels=None):
+        if self._static_mode:
+            return self._static_batch("train", inputs, labels)
         self.network.train()
         ins = self._to_vars(inputs)
         outs = self.network(*ins)
@@ -75,6 +200,8 @@ class Model:
 
     @no_grad()
     def eval_batch(self, inputs, labels=None):
+        if self._static_mode:
+            return self._static_batch("eval", inputs, labels)
         self.network.eval()
         ins = self._to_vars(inputs)
         outs = self.network(*ins)
@@ -91,10 +218,47 @@ class Model:
 
     @no_grad()
     def predict_batch(self, inputs):
+        if self._static_mode:
+            st = self._require_static()
+            feed = self._static_feed(st["pred_feed_names"], inputs)
+            outs = st["exe"].run(st["predict"], feed=feed,
+                                 fetch_list=st["pred_out_names"],
+                                 scope=st["scope"])
+            return [np.asarray(o) for o in outs]
         self.network.eval()
         outs = self.network(*self._to_vars(inputs))
         outs_list = outs if isinstance(outs, (list, tuple)) else [outs]
         return [np.asarray(o.numpy()) for o in outs_list]
+
+    def _require_static(self):
+        if self._st is None:
+            raise RuntimeError("static-graph Model: call prepare() first")
+        return self._st
+
+    def _static_batch(self, kind, inputs, labels):
+        st = self._require_static()
+        feed = self._static_feed(st["feed_names"], inputs)
+        if labels is not None:
+            feed.update(self._static_feed(st["label_names"], labels))
+        fetch = list(st["out_names"])
+        has_loss = "loss_name" in st and labels is not None
+        if has_loss:
+            fetch.append(st["loss_name"])
+        prog = st["train"] if kind == "train" else st["eval"]
+        outs = st["exe"].run(prog, feed=feed, fetch_list=fetch,
+                             scope=st["scope"])
+        logs = {}
+        if has_loss:
+            logs["loss"] = float(np.asarray(outs[-1]).ravel()[0])
+        if labels is not None and self._metrics:
+            from ..dygraph.tensor import Tensor
+
+            pred = Tensor(np.asarray(outs[0]))
+            lbl = Tensor(np.asarray(
+                labels[0] if isinstance(labels, (list, tuple)) else labels))
+            for m in self._metrics:
+                _metric_update(m, pred, lbl)
+        return logs
 
     # -- loops -----------------------------------------------------------
     def _as_loader(self, data, batch_size, shuffle, drop_last=False):
@@ -208,6 +372,10 @@ class Model:
                     "Model.save(training=False) needs the Model to be "
                     "constructed with `inputs=[InputSpec(...)]` so the "
                     "forward can be traced for export")
+            if self._static_mode and self._st is not None:
+                # trained values live in the executor scope; the traced
+                # export reads the eager parameters
+                self._sync_scope_to_network()
             was_training = getattr(self.network, "training", False)
             self.network.eval()
             try:
@@ -219,6 +387,8 @@ class Model:
         dirname = os.path.dirname(path)
         if dirname:
             os.makedirs(dirname, exist_ok=True)
+        if self._static_mode and self._st is not None:
+            self._sync_scope_to_network()
         sd = {k: np.asarray(v.numpy())
               for k, v in self.network.state_dict().items()}
         with open(path + ".pdparams", "wb") as f:
@@ -238,6 +408,12 @@ class Model:
             raise RuntimeError(
                 f"state dict mismatch: missing={missing}, "
                 f"unexpected={unexpected} (pass skip_mismatch=True to ignore)")
+        if self._static_mode and self._st is not None:
+            # push loaded values into the executor scope (names tie the
+            # eager parameters to the static vars)
+            scope = self._st["scope"]
+            for p in self.network.parameters():
+                scope.set_var(p.name, np.asarray(p.numpy()))
         if not reset_optimizer and self._optimizer is not None \
                 and os.path.exists(path + ".pdopt"):
             with open(path + ".pdopt", "rb") as f:

@@ -196,16 +196,88 @@ def _c_noop(ctx, op):
 
 @register_lower("send_v2", "partial_send")
 def _send_v2(ctx, op):
-    # p2p send: value is moved by the matching recv's ppermute; nothing to
-    # emit here (SPMD: both peers run the same program)
-    pass
+    """Generic p2p send (reference collective/send_v2_op.cc).
+
+    SPMD redesign: the reference runs DIFFERENT programs per rank and
+    moves bytes over an NCCL channel; here every rank runs the SAME
+    program, so a send_v2/recv_v2 pair with one ring_id forms a
+    point-to-point channel lowered by the RECV into a single ppermute
+    edge (src = recv's peer, dst = send's peer).  The send just parks
+    its operand for the matching recv in program order."""
+    x = ctx.in1(op, "X")
+    pend = getattr(ctx, "_pending_sends", None)
+    if pend is None:
+        pend = ctx._pending_sends = {}
+    ring = int(op.attr("ring_id", 0) or 0)
+    pend.setdefault(ring, []).append((int(op.attr("peer", 0) or 0), x))
 
 
 @register_lower("recv_v2", "partial_recv")
 def _recv_v2(ctx, op):
-    raise NotImplementedError(
-        "p2p recv_v2 lowers via ppermute inside the pipeline executor; "
-        "use paddle_tpu.distributed.pipeline utilities")
+    """Generic p2p recv: pairs with the program-order-matching send_v2
+    on the same ring and emits one ppermute edge src->dst.  Ranks off
+    the edge receive zeros (XLA ppermute semantics; the reference's
+    other ranks simply would not run the op).  Reference
+    collective/recv_v2_op.cc."""
+    ring = int(op.attr("ring_id", 0) or 0)
+    pend = getattr(ctx, "_pending_sends", {}) or {}
+    queue = pend.get(ring) or []
+    if not queue:
+        raise NotImplementedError(
+            f"recv_v2(ring_id={ring}) has no matching send_v2 earlier "
+            f"in this program: SPMD p2p lowers a send/recv PAIR to one "
+            f"ppermute edge, so both ops must appear in the same "
+            f"program (the pipeline executor pairs them per stage); a "
+            f"recv with no send has no defined source value")
+    dst, x = queue.pop(0)
+    src = int(op.attr("peer", 0) or 0)
+    ax = _axis(ctx, op)
+    out = x if ax is None else lax.ppermute(
+        x, ax if not isinstance(ax, tuple) else ax[0], [(src, dst)])
+    ctx.set_out(op, "Out", out)
+
+
+@register_lower("dgc")
+def _dgc(ctx, op):
+    """Deep gradient compression (reference operators/dgc_op.cc):
+    momentum-corrected top-k gradient sparsification with local
+    residual accumulation.
+
+        u = m*u + g;  v = v + u
+        mask = |v| among the top-k   (k = ratio * numel, static)
+        encoded = v * mask;  v' = v*(1-mask);  u' = u*(1-mask)
+
+    Pre-rampup steps (CurrentStep < rampup_begin_step) pass the dense
+    grad through untouched.  TPU-native note: the reference ships k
+    (value,index) pairs over NCCL; XLA collectives are dense, so the
+    masked-dense tensor rides the normal psum — convergence semantics
+    (what DGC is for) are identical, and the top-k stays a static-shape
+    lax.top_k the MXU pipeline can schedule."""
+    g = ctx.in1(op, "Grad")
+    u = ctx.in1(op, "U")
+    v = ctx.in1(op, "V")
+    step = ctx.in1(op, "CurrentStep")
+    m = float(op.attr("m", 0.9))
+    ratio = float(op.attr("ratio", 0.001))
+    rampup_begin = float(op.attr("rampup_begin_step", 0.0))
+
+    u_new = m * u + g
+    v_new = v + u_new
+    flat = jnp.abs(v_new).reshape(-1)
+    k = max(1, int(round(ratio * flat.shape[0])))
+    thr = lax.top_k(flat, k)[0][-1]
+    mask = (jnp.abs(v_new) >= thr).astype(g.dtype)
+    engaged = (jnp.reshape(step, ()) >= rampup_begin) if step is not None \
+        else jnp.asarray(True)
+    encoded = jnp.where(engaged, v_new * mask, g)
+    keep = 1.0 - mask
+    ctx.set_out(op, "U_out", jnp.where(engaged, u_new * keep, u_new))
+    ctx.set_out(op, "V_out", jnp.where(engaged, v_new * keep,
+                                       jnp.zeros_like(v_new)))
+    ctx.set_out(op, "EncodeGrad", encoded)
+    ctx.set_out(op, "Grad_out", encoded)
+    if ctx.out_name(op, "GatherBuff"):
+        ctx.set_out(op, "GatherBuff", encoded)
 
 
 @register_lower("c_shard_slice")

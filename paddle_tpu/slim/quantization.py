@@ -43,6 +43,28 @@ _WEIGHT_AXIS = {"conv2d": 0, "depthwise_conv2d": 0, "conv2d_transpose": 1,
 SKIP_QUANT_ATTR = "skip_quant"
 
 
+def _insert_weight_qdq(block, index, name, var, out_name, scale_name,
+                       weight_quantize_type, weight_bits, axis):
+    """Shared weight quant-dequant emitter (used by both the QAT
+    transform pass and the PTQ export so the two cannot diverge)."""
+    if weight_quantize_type == "channel_wise_abs_max":
+        block.create_var(name=scale_name, shape=[int(var.shape[axis])],
+                         dtype="float32", stop_gradient=True)
+        block._insert_op(
+            index, "fake_channel_wise_quantize_dequantize_abs_max",
+            inputs={"X": [name]},
+            outputs={"Out": [out_name], "OutScale": [scale_name]},
+            attrs={"bit_length": weight_bits, "quant_axis": axis})
+    else:
+        block.create_var(name=scale_name, shape=[1], dtype="float32",
+                         stop_gradient=True)
+        block._insert_op(
+            index, "fake_quantize_dequantize_abs_max",
+            inputs={"X": [name]},
+            outputs={"Out": [out_name], "OutScale": [scale_name]},
+            attrs={"bit_length": weight_bits})
+
+
 class QuantizationTransformPass:
     """Insert fake quant-dequant ops in front of quantizable ops.
 
@@ -74,8 +96,6 @@ class QuantizationTransformPass:
         self.weight_quantize_type = weight_quantize_type
         self.moving_rate = float(moving_rate)
         self.quantizable_op_type = set(quantizable_op_type)
-        # var name -> qdq output name, shared across consumers
-        self._dequantized: Dict[str, str] = {}
 
     # -- helpers ---------------------------------------------------------
 
@@ -95,24 +115,9 @@ class QuantizationTransformPass:
                                dtype=var.dtype, stop_gradient=False)
         scale_name = unique_name.generate(f"{name}.quant_scale")
         if is_weight:
-            if self.weight_quantize_type == "channel_wise_abs_max":
-                n_ch = int(var.shape[weight_axis])
-                block.create_var(name=scale_name, shape=[n_ch],
-                                 dtype="float32", stop_gradient=True)
-                block._insert_op(
-                    index, "fake_channel_wise_quantize_dequantize_abs_max",
-                    inputs={"X": [name]},
-                    outputs={"Out": [out_name], "OutScale": [scale_name]},
-                    attrs={"bit_length": self.weight_bits,
-                           "quant_axis": weight_axis})
-            else:
-                block.create_var(name=scale_name, shape=[1],
-                                 dtype="float32", stop_gradient=True)
-                block._insert_op(
-                    index, "fake_quantize_dequantize_abs_max",
-                    inputs={"X": [name]},
-                    outputs={"Out": [out_name], "OutScale": [scale_name]},
-                    attrs={"bit_length": self.weight_bits})
+            _insert_weight_qdq(block, index, name, var, out_name,
+                               scale_name, self.weight_quantize_type,
+                               self.weight_bits, weight_axis)
             return out_name, 1
 
         if self.activation_quantize_type == "abs_max":
@@ -150,6 +155,10 @@ class QuantizationTransformPass:
         """In-place: rewrite ``program`` so every quantizable op consumes
         quant-dequantized inputs."""
         block = program.global_block
+        # var name -> qdq output name, shared across consumers; local to
+        # this apply() — carrying it across programs would rename vars
+        # to qdq outputs that only exist in the earlier program
+        dequantized: Dict[str, str] = {}
         i = 0
         while i < len(block.ops):
             op = block.ops[i]
@@ -160,8 +169,8 @@ class QuantizationTransformPass:
                 continue
             for slot in _QUANT_SLOTS[op.type]:
                 for name in list(op.input(slot)):
-                    if name in self._dequantized:
-                        op._rename_input(name, self._dequantized[name])
+                    if name in dequantized:
+                        op._rename_input(name, dequantized[name])
                         continue
                     var = block._find_var_recursive(name)
                     if var is None:
@@ -172,7 +181,7 @@ class QuantizationTransformPass:
                         program, startup_program, block, i, name, is_weight,
                         _WEIGHT_AXIS.get(op.type, 0))
                     i += n
-                    self._dequantized[name] = new_name
+                    dequantized[name] = new_name
                     op._rename_input(name, new_name)
             i += 1
         return program
@@ -293,29 +302,10 @@ class PostTrainingQuantization:
                                      dtype=var.dtype)
                     scale_name = unique_name.generate(f"{name}.ptq_scale")
                     if is_weight:
-                        axis = _WEIGHT_AXIS.get(op.type, 0)
-                        if self.weight_quantize_type == \
-                                "channel_wise_abs_max":
-                            block.create_var(name=scale_name,
-                                             shape=[int(var.shape[axis])],
-                                             dtype="float32")
-                            block._insert_op(
-                                i, "fake_channel_wise_quantize_dequantize"
-                                   "_abs_max",
-                                inputs={"X": [name]},
-                                outputs={"Out": [out_name],
-                                         "OutScale": [scale_name]},
-                                attrs={"bit_length": self.weight_bits,
-                                       "quant_axis": axis})
-                        else:
-                            block.create_var(name=scale_name, shape=[1],
-                                             dtype="float32")
-                            block._insert_op(
-                                i, "fake_quantize_dequantize_abs_max",
-                                inputs={"X": [name]},
-                                outputs={"Out": [out_name],
-                                         "OutScale": [scale_name]},
-                                attrs={"bit_length": self.weight_bits})
+                        _insert_weight_qdq(
+                            block, i, name, var, out_name, scale_name,
+                            self.weight_quantize_type, self.weight_bits,
+                            _WEIGHT_AXIS.get(op.type, 0))
                         i += 1
                     else:
                         # constant calibrated scale, materialized in-graph

@@ -226,3 +226,52 @@ class TestVisionModels:
         assert out.min() >= -1.001 and out.max() <= 1.001
         r = Resize((14, 14))(out)
         assert r.shape == (3, 14, 14)
+
+
+class TestStaticModel:
+    """Static-graph Model mode (reference hapi/model.py _AdapterStatic):
+    prepare() builds train/eval/predict programs once; fit/evaluate/
+    predict drive the Executor with one XLA compile per program."""
+
+    def _make(self):
+        from paddle_tpu.hapi.model import InputSpec
+
+        paddle.enable_static()
+        model = paddle.Model(
+            MLPNet(),
+            inputs=[InputSpec([None, 1, 4, 4], "float32", "img")],
+            labels=[InputSpec([None, 1], "int64", "lbl")])
+        model.prepare(
+            paddle.optimizer.SGD(0.1, parameters=model.parameters()),
+            nn.CrossEntropyLoss(), Accuracy())
+        return model
+
+    def test_static_fit_evaluate_predict(self):
+        try:
+            model = self._make()
+            assert model._static_mode and model._st is not None
+            hist = model.fit(self._fake(), epochs=3, batch_size=16,
+                             verbose=0, shuffle=False)
+            assert hist["loss"][-1] < hist["loss"][0] / 2, hist["loss"]
+            logs = model.evaluate(self._fake(32), batch_size=16, verbose=0)
+            assert logs["acc"] > 0.5
+            preds = model.predict(self._fake(32), batch_size=16,
+                                  stack_outputs=True)
+            assert preds[0].shape == (32, 4)
+        finally:
+            paddle.disable_static()
+
+    def test_static_save_syncs_trained_params(self, tmp_path):
+        try:
+            model = self._make()
+            before = np.asarray(model.parameters()[0].numpy()).copy()
+            model.fit(self._fake(32), epochs=2, batch_size=16, verbose=0)
+            model.save(str(tmp_path / "m"))
+            after = np.asarray(model.parameters()[0].numpy())
+            assert not np.allclose(before, after), \
+                "trained scope values must sync back into parameters"
+        finally:
+            paddle.disable_static()
+
+    def _fake(self, n=64):
+        return FakeData(num_samples=n, image_shape=(1, 4, 4), num_classes=4)

@@ -257,7 +257,10 @@ def test_use_prune_keeps_cond_passthrough_producers():
         np.testing.assert_allclose(np.asarray(out[0]), [2.0, 2.0])
 
 
-def test_early_return_tensor_cond_is_loud():
+def test_early_return_tensor_cond_converts():
+    """Round-4 gap (reference return_transformer.py:135): a guard-style
+    early return over a TENSOR condition now converts via the return
+    flag/value rewrite instead of raising."""
     def f(x):
         if x.mean() > 0:
             return x
@@ -265,9 +268,95 @@ def test_early_return_tensor_cond_is_loud():
         return x
 
     with dygraph.guard():
-        x = dygraph.to_variable(np.ones((2,), "f4"))
-        with pytest.raises(NotImplementedError, match="return"):
-            djit.TracedLayer.trace(f, [x])
+        pos = dygraph.to_variable(np.ones((2,), "f4"))
+        neg = dygraph.to_variable(np.full((2,), -1.0, "f4"))
+        eager = [np.asarray(f(dygraph.to_variable(
+            np.asarray(t._value).copy()))._value) for t in (pos, neg)]
+        _, tl = djit.TracedLayer.trace(f, [pos])
+        for t, e in zip((pos, neg), eager):
+            np.testing.assert_allclose(np.asarray(tl(t)[0]._value), e)
+
+
+def test_return_inside_while_loop():
+    """Return inside a data-dependent while: the return flag folds into
+    the loop condition and the value merges through the carry."""
+    def f(x):
+        while x.sum() < 100.0:
+            x = x * 2.0
+            if x.mean() > 20.0:
+                return x - 1.0
+        return x + 0.5
+
+    with dygraph.guard():
+        ins = [np.full((4,), v, "f4") for v in (1.0, 30.0, 99.0)]
+        eager = [np.asarray(f(dygraph.to_variable(v))._value) for v in ins]
+        _, tl = djit.TracedLayer.trace(
+            f, [dygraph.to_variable(ins[0])])
+        for v, e in zip(ins, eager):
+            np.testing.assert_allclose(
+                np.asarray(tl(dygraph.to_variable(v))[0]._value), e)
+
+
+def test_return_inside_for_range_loop():
+    def f(x):
+        acc = x * 0.0
+        for i in range(10):
+            acc = acc + x
+            if acc.sum() > 50.0:
+                return acc * 10.0
+        return acc
+
+    with dygraph.guard():
+        ins = [np.full((2,), v, "f4") for v in (1.0, 30.0)]
+        eager = [np.asarray(f(dygraph.to_variable(v))._value) for v in ins]
+        _, tl = djit.TracedLayer.trace(
+            f, [dygraph.to_variable(ins[0])])
+        for v, e in zip(ins, eager):
+            np.testing.assert_allclose(
+                np.asarray(tl(dygraph.to_variable(v))[0]._value), e)
+
+
+def test_statements_after_returning_loop_are_guarded():
+    """Code after a loop that may have returned must be skipped when the
+    return fired (the not-flag guard cascade)."""
+    def f(x):
+        for i in range(4):
+            x = x + 1.0
+            if x.mean() > 3.0:
+                return x * 100.0
+        x = x - 0.25
+        return x
+
+    with dygraph.guard():
+        ins = [np.full((2,), v, "f4") for v in (0.0, 5.0)]
+        eager = [np.asarray(f(dygraph.to_variable(v))._value) for v in ins]
+        _, tl = djit.TracedLayer.trace(
+            f, [dygraph.to_variable(ins[0])])
+        for v, e in zip(ins, eager):
+            np.testing.assert_allclose(
+                np.asarray(tl(dygraph.to_variable(v))[0]._value), e)
+
+
+def test_for_over_tensor_rows_with_list_append():
+    """Iterating a tensor yields its rows (ForToWhileTransformer /
+    list_transformer roles); appended rows concat back together."""
+    from paddle_tpu import tensor as pt_tensor
+
+    def f(x):
+        rows = []
+        for r in x:
+            if r.sum() > 0:
+                rows.append(r * 2.0)
+            else:
+                rows.append(r - 1.0)
+        return pt_tensor.stack(rows)
+
+    with dygraph.guard():
+        a = np.array([[1.0, 2.0], [-3.0, 1.0], [0.5, -2.0]], "f4")
+        eager = np.asarray(f(dygraph.to_variable(a))._value)
+        _, tl = djit.TracedLayer.trace(f, [dygraph.to_variable(a)])
+        np.testing.assert_allclose(
+            np.asarray(tl(dygraph.to_variable(a))[0]._value), eager)
 
 
 def test_python_guard_early_return_still_traces():
@@ -321,7 +410,9 @@ def test_zero_trip_range_keeps_existing_var():
         np.testing.assert_allclose(np.asarray(tl(x)[0]._value), [5.0, 5.0])
 
 
-def test_return_inside_loop_is_loud():
+def test_return_inside_loop_converts():
+    """Formerly a loud error; the return rewriter now converts it
+    (reference return_transformer.py:135)."""
     def f(x):
         acc = x * 0.0
         for i in range(3):
@@ -331,9 +422,13 @@ def test_return_inside_loop_is_loud():
         return acc
 
     with dygraph.guard():
-        x = dygraph.to_variable(np.ones((2,), "f4"))
-        with pytest.raises(NotImplementedError, match="loop"):
-            djit.TracedLayer.trace(f, [x])
+        ins = [np.full((2,), v, "f4") for v in (1.0, 0.1)]
+        eager = [np.asarray(f(dygraph.to_variable(v))._value) for v in ins]
+        _, tl = djit.TracedLayer.trace(
+            f, [dygraph.to_variable(ins[0])])
+        for v, e in zip(ins, eager):
+            np.testing.assert_allclose(
+                np.asarray(tl(dygraph.to_variable(v))[0]._value), e)
 
 
 def test_container_for_with_break_stays_python():
