@@ -163,37 +163,53 @@ class OptimizerWithMixedPrecision:
         self._create_scale_state(block, startup)
         scaled_loss = block.create_var(
             name=unique_name.generate(loss.name + ".scaled"),
+            shape=list(loss.shape) or [1],
             dtype="float32", stop_gradient=False)
         block.append_op("elementwise_mul",
                         {"X": [loss.name], "Y": [self._loss_scaling.name]},
                         {"Out": [scaled_loss.name]}, {"axis": -1})
 
+        def unscale_and_update(params_grads):
+            grad_names = [g.name if hasattr(g, "name") else g
+                          for _, g in params_grads]
+            found_inf = block.create_var(
+                name=unique_name.generate("found_inf"), dtype="bool",
+                stop_gradient=True)
+            block.append_op(
+                "check_finite_and_unscale",
+                {"X": grad_names, "Scale": self._loss_scaling.name},
+                {"Out": grad_names, "FoundInfinite": found_inf.name})
+            if self._dynamic:
+                block.append_op(
+                    "update_loss_scaling",
+                    {"X": grad_names, "FoundInfinite": found_inf.name,
+                     "PrevLossScaling": self._loss_scaling.name,
+                     "InGoodSteps": self._good_steps.name,
+                     "InBadSteps": self._bad_steps.name},
+                    {"Out": grad_names,
+                     "LossScaling": self._loss_scaling.name,
+                     "OutGoodSteps": self._good_steps.name,
+                     "OutBadSteps": self._bad_steps.name},
+                    {"incr_every_n_steps": self._incr_every,
+                     "decr_every_n_nan_or_inf": self._decr_every,
+                     "incr_ratio": self._incr_ratio,
+                     "decr_ratio": self._decr_ratio})
+            return params_grads
+
+        if getattr(self._optimizer, "supports_grad_transform", False):
+            # gradient_merge composition: the merge optimizer drives
+            # backward/apply itself, so the unscale + scaling-state
+            # update ride its grad-transform hook — they land inside the
+            # masked region, and the merge machinery select-restores the
+            # loss-scaling counters on non-update steps (otherwise the
+            # masked zero-grads would count as "good steps" every step)
+            return self._optimizer.minimize(
+                scaled_loss, startup, parameter_list, no_grad_set,
+                grad_transform=unscale_and_update)
+
         params_grads = self._optimizer.backward(
             scaled_loss, startup, parameter_list, no_grad_set)
-
-        grad_names = [g.name if hasattr(g, "name") else g
-                      for _, g in params_grads]
-        found_inf = block.create_var(
-            name=unique_name.generate("found_inf"), dtype="bool",
-            stop_gradient=True)
-        block.append_op(
-            "check_finite_and_unscale",
-            {"X": grad_names, "Scale": self._loss_scaling.name},
-            {"Out": grad_names, "FoundInfinite": found_inf.name})
-        if self._dynamic:
-            block.append_op(
-                "update_loss_scaling",
-                {"X": grad_names, "FoundInfinite": found_inf.name,
-                 "PrevLossScaling": self._loss_scaling.name,
-                 "InGoodSteps": self._good_steps.name,
-                 "InBadSteps": self._bad_steps.name},
-                {"Out": grad_names, "LossScaling": self._loss_scaling.name,
-                 "OutGoodSteps": self._good_steps.name,
-                 "OutBadSteps": self._bad_steps.name},
-                {"incr_every_n_steps": self._incr_every,
-                 "decr_every_n_nan_or_inf": self._decr_every,
-                 "incr_ratio": self._incr_ratio,
-                 "decr_ratio": self._decr_ratio})
+        unscale_and_update(params_grads)
         opt_ops = self._optimizer.apply_gradients(params_grads)
         return opt_ops, params_grads
 

@@ -133,16 +133,22 @@ class AMPMetaOptimizer(MetaOptimizerBase):
                 cfg.get("use_dynamic_loss_scaling", True)),
             # TPU-native default: bf16, no loss scaling
             use_bf16=bool(cfg.get("use_bf16", True)))
-        if not wrapped._use_bf16:
-            # fp16 mode drives backward/apply_gradients directly, which
-            # would silently bypass a gradient-merge inner chain
+        if not wrapped._use_bf16 and not getattr(
+                self.inner_opt, "supports_grad_transform", False):
+            # fp16 mode drives backward/apply_gradients directly; a
+            # DIRECT gradient-merge inner composes via the grad-transform
+            # hook (static_amp routes unscale + scaling-state updates
+            # through the merge mask), but a merge buried deeper in the
+            # chain would be silently bypassed — refuse that loudly
             o = self.inner_opt
             while isinstance(o, MetaOptimizerBase):
                 if isinstance(o, GradientMergeMetaOptimizer):
                     raise NotImplementedError(
-                        "amp (fp16 + loss scaling) composed with "
-                        "gradient_merge is not supported; use bf16 amp "
-                        "(amp_configs={'use_bf16': True}, the TPU default)")
+                        "amp (fp16 + loss scaling) composes with "
+                        "gradient_merge only when gradient_merge is the "
+                        "direct inner optimizer; use bf16 amp "
+                        "(amp_configs={'use_bf16': True}, the TPU "
+                        "default) for this chain")
                 o = o.inner_opt
         return wrapped.minimize(loss, startup_program, parameter_list,
                                 no_grad_set)
@@ -183,11 +189,13 @@ class GradientMergeMetaOptimizer(MetaOptimizerBase):
     update steps.  XLA fuses the selects; there is no control-flow
     divergence on device."""
 
+    supports_grad_transform = True  # fp16-AMP composes through the mask
+
     def _can_apply(self):
         return self.user_strategy.gradient_merge
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
-                 no_grad_set=None):
+                 no_grad_set=None, grad_transform=None):
         from ...framework.program import default_startup_program
         from ...initializer import ConstantInitializer
         from ...framework import unique_name
@@ -196,8 +204,16 @@ class GradientMergeMetaOptimizer(MetaOptimizerBase):
         k = int(cfg.get("k_steps", 1))
         avg = bool(cfg.get("avg", True))
         if k <= 1:
-            return self.inner_opt.minimize(loss, startup_program,
-                                           parameter_list, no_grad_set)
+            if grad_transform is None:
+                return self.inner_opt.minimize(loss, startup_program,
+                                               parameter_list, no_grad_set)
+            # degenerate merge still owes the caller its transform (fp16
+            # AMP's unscale + overflow check ride it — dropping it would
+            # apply loss-scaled gradients)
+            pgs = self.inner_opt.backward(loss, startup_program,
+                                          parameter_list, no_grad_set)
+            pgs = grad_transform(pgs)
+            return self.inner_opt.apply_gradients(pgs), pgs
 
         params_grads = self.inner_opt.backward(
             loss, startup_program, parameter_list, no_grad_set)
@@ -243,6 +259,7 @@ class GradientMergeMetaOptimizer(MetaOptimizerBase):
 
         merged = []
         acc_names = []
+        gm_map = {}  # orig grad name -> {acc, merged}; read by sharding
         for p, g in params_grads:
             acc = persistent(unique_name.generate(p.name + "_gm_acc"),
                              p.shape, 0.0)
@@ -261,10 +278,18 @@ class GradientMergeMetaOptimizer(MetaOptimizerBase):
                                 {"scale": 1.0 / k, "bias": 0.0,
                                  "bias_after_scale": True})
             merged.append((p, block.var(mg.name)))
+            gm_map[g.name] = {"acc": acc.name, "merged": mg.name}
+        loss.block.program._gm_map = gm_map
 
         # optimizer ops run every step on the masked grad; snapshot every
-        # state var they overwrite and select-restore on non-update steps
+        # state var they overwrite and select-restore on non-update steps.
+        # The mark sits BEFORE the grad transform so state the transform
+        # writes (e.g. fp16-AMP's loss-scaling counters, which would
+        # otherwise advance on masked zero-grads every step) is snapshot
+        # and select-restored exactly like optimizer state.
         mark = len(block.ops)
+        if grad_transform is not None:
+            merged = grad_transform(merged)
         opt_ops = self.inner_opt.apply_gradients(merged)
         appended = block.ops[mark:]
         state_names = []
@@ -475,14 +500,6 @@ class ShardingMetaOptimizer(MetaOptimizerBase):
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):
-        o = self.inner_opt
-        while isinstance(o, MetaOptimizerBase):
-            if isinstance(o, GradientMergeMetaOptimizer):
-                raise NotImplementedError(
-                    "sharding composed with gradient_merge is not "
-                    "supported yet: the merge accumulators are full-shape "
-                    "while sharded updates consume grad shards")
-            o = o.inner_opt
         ops, params_grads = self.inner_opt.minimize(
             loss, startup_program, parameter_list, no_grad_set)
         prog = loss.block.program
@@ -492,9 +509,14 @@ class ShardingMetaOptimizer(MetaOptimizerBase):
             raise ValueError(
                 "strategy.sharding=True but no parameter has dim0 divisible "
                 f"by the dp degree {n}; sharding would be a no-op")
+        # gradient_merge composition: the merge chain moves into shard
+        # space — acc/merged ride the grad SHARD (c_reducescatter output)
+        # and join the sharded optimizer state, so merge-accumulator
+        # memory also drops by the dp degree
+        gm_map = getattr(prog, "_gm_map", None) or {}
         self._transpile_grads(prog, params_grads, sharded_params,
-                              loss.name + GRAD_SUFFIX)
-        self._shard_optimizer_ops(prog, n, sharded_params)
+                              loss.name + GRAD_SUFFIX, gm_map=gm_map)
+        self._shard_optimizer_ops(prog, n, sharded_params, gm_map=gm_map)
         return ops, params_grads
 
     def _sharded_param_set(self, prog, params_grads, nranks):
@@ -509,7 +531,7 @@ class ShardingMetaOptimizer(MetaOptimizerBase):
         return out
 
     def _transpile_grads(self, prog, params_grads, sharded_params,
-                         loss_grad_name):
+                         loss_grad_name, gm_map=None):
         """ZeRO-1 grad comm: `c_reducescatter` for sharded params (each
         rank receives only its grad shard — half the volume of
         allreduce+slice), plain `c_allreduce_sum` for params left
@@ -572,13 +594,29 @@ class ShardingMetaOptimizer(MetaOptimizerBase):
                         new_ops.append(Operator(
                             block, "cast", {"X": [g]}, {"Out": [g]},
                             {"out_dtype": dtypes.to_enum("float32")}))
+        # gradient_merge composition: the merge accumulation must consume
+        # the grad SHARD (its X/Out accumulator joins the sharded state),
+        # not the pre-scatter full grad
+        if gm_map:
+            for op in new_ops:
+                if op.type != "elementwise_add":
+                    continue
+                y = op.inputs.get("Y", [])
+                if len(y) == 1 and y[0] in gm_map \
+                        and grad_to_param.get(y[0]) in sharded_params \
+                        and op.inputs.get("X") == [gm_map[y[0]]["acc"]]:
+                    op.inputs["Y"] = [y[0] + "@SHARD"]
         block.ops[:] = new_ops
         prog._bump()
 
-    def _shard_optimizer_ops(self, prog, nranks, sharded_params):
+    def _shard_optimizer_ops(self, prog, nranks, sharded_params,
+                             gm_map=None):
         from ...framework.program import Operator
 
         block = prog.global_block
+        # merged-grad name -> its accumulator (gradient_merge composition)
+        merged_to_acc = {info["merged"]: info["acc"]
+                         for info in (gm_map or {}).values()}
         new_ops = []
         for op in block.ops:
             if op.type not in _OPTIMIZER_OP_TYPES:
@@ -595,7 +633,11 @@ class ShardingMetaOptimizer(MetaOptimizerBase):
             shard_shape = [int(pvar.shape[0]) // nranks] + [
                 int(s) for s in pvar.shape[1:]]
             p_shard = pname + "@SHARD"
-            g_shard = gname + "@SHARD"
+            # a merged grad already lives in shard space (the merge chain
+            # consumed the reducescatter output); plain grads rewire to
+            # the @SHARD var the scatter produced
+            g_shard = gname if gname in merged_to_acc \
+                else gname + "@SHARD"
             if not block.has_var(p_shard):
                 block.create_var(name=p_shard, shape=shard_shape,
                                  dtype=pvar.dtype, stop_gradient=True)
@@ -631,6 +673,11 @@ class ShardingMetaOptimizer(MetaOptimizerBase):
             for slot, names in list(op.outputs.items()):
                 op.outputs[slot] = [p_shard if nm == pname else nm
                                     for nm in names]
+            if gname in merged_to_acc:
+                # the merge accumulator carries shard-space values:
+                # record it so the executor gives it a P('dp') spec —
+                # merge memory drops by the dp degree like other state
+                sharded_accs.append(merged_to_acc[gname])
             op.attrs["__sharded_accumulators__"] = sharded_accs
             new_ops.append(op)
             new_ops.append(Operator(block, "c_allgather",

@@ -84,3 +84,6 @@ define_flag("allocator_strategy", "xla", "memory is PJRT/XLA-owned")
 define_flag("cpu_deterministic", False,
             "force deterministic reductions on CPU runs")
 define_flag("seed", 0, "global random seed override (0 = program seed)")
+define_flag("flash_attention", "auto",
+            "fused attention kernel engagement: 'auto' (flash only when "
+            "the score tensor would threaten HBM), 'always', 'never'")

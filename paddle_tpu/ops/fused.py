@@ -1,16 +1,21 @@
-"""Fused ops: multi-head attention via the Pallas TPU flash kernel.
+"""Fused ops: multi-head attention, flash-kernel engagement by flag.
 
 Role parity: reference operators/fused/multihead_matmul_op.cu (the
 transformer attention fusion used by inference + the fused bert encoder
-functors in operators/math/bert_encoder_functor.cu).  TPU-native: the
-whole scores->mask->softmax->context chain runs as one Pallas flash
-kernel — the [B,H,S,S] probability tensor never touches HBM, which is
-the difference between ~39% and ~48% MFU on BERT-base (see BENCH_r03).
+functors in operators/math/bert_encoder_functor.cu).
 
-The kernel ships its own custom VJP, so the framework's generic
-vjp-replay gradient path (ops/grad_generic.py) differentiates through it
-for free.  Off-TPU (CPU tests, simulation meshes) the lowering falls
-back to the plain jnp composition with identical semantics.
+Three lowerings share one op:
+- plain XLA composition (default; XLA's own fusion is speed-competitive
+  with flash at flagship shapes — see _flash_engaged's measurements);
+- the stock jax Pallas flash kernel for big UNBIASED attention (keeps
+  the [B,H,S,S] score tensor out of HBM);
+- the custom Pallas kernel (ops/pallas_attention.py) for big BIASED
+  attention — it streams the additive mask block-by-block, which the
+  stock kernel cannot.
+Engagement is controlled by FLAGS_flash_attention (auto/always/never)
+and tested off-TPU through interpret mode.  All kernels carry a custom
+VJP, so the framework's generic vjp-replay gradient path
+(ops/grad_generic.py) differentiates through them unchanged.
 """
 from __future__ import annotations
 
@@ -37,19 +42,40 @@ def _plain_attention(q, k, v, bias, sm_scale, causal=False):
     return jnp.einsum("bhqk,bhkd->bhqd", p, v)
 
 
-def _flash_ok(b, h, sq, sk, d):
-    # pallas kernel wants lane-aligned sequence blocks; head dims are
-    # padded internally so 64/128/256 all map cleanly onto the MXU.
-    # Measured on v5e: XLA's own attention fusion MATCHES the pallas
-    # kernel on speed through S=4096 fwd+bwd (0.94-1.02x) and beats it
-    # at S=128 (235 vs 335 ms/step on BERT-base), so the kernel's value
-    # is the MEMORY ceiling, not throughput: the plain path materializes
-    # the [B,H,Sq,Sk] fp32 score tensor in backward.  Engage flash only
-    # when that tensor would be big enough to threaten HBM (>2 GB).
-    if not (sq % 128 == 0 and sk % 128 == 0 and d in (64, 128, 256)):
+_FORCE_INTERPRET = False  # tests: engage the pallas path on CPU
+
+
+def _flash_mode() -> str:
+    from ..framework.flags import flag
+
+    return str(flag("flash_attention"))
+
+
+def _shape_ok(sq, sk, d):
+    # pallas kernels want lane-aligned sequence blocks; head dims
+    # 64/128/256 map cleanly onto the MXU
+    return sq % 128 == 0 and sk % 128 == 0 and d in (64, 128, 256)
+
+
+def _flash_engaged(b, h, sq, sk, d):
+    """Flag-controlled engagement (FLAGS_flash_attention).
+
+    'auto': measured on v5e, XLA's own attention fusion MATCHES the
+    pallas kernel on speed through S=4096 fwd+bwd (0.94-1.02x) and
+    beats it at S=128 (235 vs 335 ms/step on BERT-base), so flash's
+    value is the MEMORY ceiling, not throughput — the plain path
+    materializes the [B,H,Sq,Sk] fp32 score tensor in backward.  Auto
+    engages only when that tensor would threaten HBM (>2 GB).
+    'always' engages at any aligned shape (A/B testing, memory-bound
+    configs the heuristic misses); 'never' forces the plain path."""
+    mode = _flash_mode()
+    if mode == "never" or not _shape_ok(sq, sk, d):
         return False
-    scores_bytes = 4 * b * h * sq * sk
-    return scores_bytes > (2 << 30)
+    if not (_FORCE_INTERPRET or jax.default_backend() == "tpu"):
+        return False
+    if mode == "always":
+        return True
+    return 4 * b * h * sq * sk > (2 << 30)
 
 
 @register_lower("fused_multihead_attention")
@@ -88,25 +114,30 @@ def _fused_mha(ctx, op):
                 "an additive bias yet (pack sequences; causal via attr)")
         out = ring_attention(qh, kh, vh, axis_name="sp", sm_scale=sm_scale,
                              causal=causal)
-    elif jax.default_backend() == "tpu" and _flash_ok(b, n_heads, s, s, d):
-        from jax.experimental.pallas.ops.tpu.flash_attention import (
-            flash_attention,
-        )
-
-        ab = None
+    elif _flash_engaged(b, n_heads, s, s, d):
         if bias is not None:
-            # pallas applies sm_scale AFTER adding ab (s += ab; s *=
-            # sm_scale in flash_attention.py), while our semantics are
-            # softmax(sm_scale*qk + bias): pre-divide the bias so both
-            # paths agree.  The broadcast does materialize [B,H,S,S] in
-            # HBM — acceptable for additive relative-position biases,
-            # wasteful for pure key-padding masks (TODO: lower 0/-inf
-            # key masks to the kernel's segment_ids instead).
-            ab = jnp.broadcast_to(
-                (bias.astype(jnp.float32) / sm_scale).astype(qh.dtype),
-                (b, n_heads, s, s))
-        out = flash_attention(qh, kh, vh, ab=ab, sm_scale=sm_scale,
-                              causal=causal)
+            # biased attention: OUR kernel streams the additive mask
+            # block-by-block (pallas_attention.py) — the stock kernel
+            # only takes a pre-materialized [B,H,S,S] `ab`, which is the
+            # HBM blowup flash exists to avoid
+            from .pallas_attention import flash_attention_bias
+
+            out = flash_attention_bias(
+                qh, kh, vh, bias, sm_scale=sm_scale, causal=causal,
+                interpret=jax.default_backend() != "tpu")
+        elif jax.default_backend() == "tpu":
+            from jax.experimental.pallas.ops.tpu.flash_attention import (
+                flash_attention,
+            )
+
+            out = flash_attention(qh, kh, vh, sm_scale=sm_scale,
+                                  causal=causal)
+        else:  # _FORCE_INTERPRET engagement off-TPU (tests)
+            from .pallas_attention import flash_attention_bias
+
+            out = flash_attention_bias(qh, kh, vh, None,
+                                       sm_scale=sm_scale, causal=causal,
+                                       interpret=True)
     else:
         out = _plain_attention(qh, kh, vh, bias, sm_scale, causal=causal)
 

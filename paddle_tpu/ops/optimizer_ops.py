@@ -248,6 +248,15 @@ def _check_finite_and_unscale(ctx, op):
         xs = x.astype(jnp.float32) / scale
         found_inf = found_inf | ~jnp.all(jnp.isfinite(xs))
         ctx.set(name_out, xs.astype(x.dtype) if x.dtype != jnp.float16 else xs)
+    if ctx.axis_env:
+        # cross-replica agreement: an overflow on ANY dp shard must shrink
+        # the (replicated) loss scale on every shard, or the scaling state
+        # diverges across replicas (reference runs the check after the
+        # dense allreduce; here pre-comm local grads can differ)
+        from jax import lax
+
+        found_inf = lax.pmax(found_inf.astype(jnp.int32),
+                             tuple(ctx.axis_env)).astype(jnp.bool_)
     ctx.set_out(op, "FoundInfinite", found_inf.reshape((1,)))
 
 

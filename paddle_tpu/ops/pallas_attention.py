@@ -1,0 +1,264 @@
+"""Custom Pallas TPU flash-attention kernel with streamed additive bias.
+
+Role parity: reference operators/fused/multihead_matmul_op.cu +
+operators/math/bert_encoder_functor.cu (the fused scores->mask->softmax->
+context chain).  The stock jax flash kernel takes an `ab` bias only as a
+materialized [B,H,S,S] tensor — exactly the HBM blowup flash exists to
+avoid; a [B,1,1,S] key-padding mask broadcast to BERT-base shapes at
+S=4096 is 8 GiB.  This kernel STREAMS the bias block-by-block instead:
+key-mask form [B,1,1,S] is read as (1,1,BK) tiles (broadcast over rows
+in-register), full form [B,H,S,S] as (1,BQ,BK) tiles, so HBM traffic for
+a key mask is O(B*S), not O(B*H*S^2).
+
+Forward: classic online-softmax flash (running row-max/denominator in
+VMEM scratch, one (BQ,BK) tile in flight).  Backward: a q-chunked
+recomputation — peak memory O(BQ*Sk) per chunk instead of the plain
+path's O(Sq*Sk) score tensor — wired through jax.custom_vjp so the
+framework's generic vjp-replay gradients (ops/grad_generic.py)
+differentiate through it unchanged.  ``interpret=True`` runs the same
+kernel on CPU for tests (tests/test_pallas_attention.py).
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG_INF = -1e30
+_LANES = 128  # TPU vector lane width; row stats broadcast across lanes
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, m_scr, l_scr,
+                acc_scr, *, sm_scale, causal, block_q, block_k, n_k,
+                bias_mode):
+    import jax.experimental.pallas as pl
+
+    qb = pl.program_id(1)
+    kb = pl.program_id(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    if causal:
+        # blocks fully above the diagonal contribute nothing
+        run = (kb * block_k) <= (qb * block_q + block_q - 1)
+    else:
+        run = True
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)           # (BQ, D)
+        k = k_ref[0].astype(jnp.float32)           # (BK, D)
+        v = v_ref[0].astype(jnp.float32)           # (BK, D)
+        s = lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale  # (BQ, BK)
+        if bias_mode == "key":
+            s = s + bias_ref[0, 0, 0].astype(jnp.float32)[None, :]
+        elif bias_mode == "full":
+            s = s + bias_ref[0, 0].astype(jnp.float32)
+        if causal:
+            rows = qb * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = kb * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+
+        m_prev = m_scr[:, :1]                      # (BQ, 1)
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = alpha * l_scr[:, :1] + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[...] = acc_scr[...] * alpha + lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(kb == n_k - 1)
+    def _flush():
+        l = l_scr[:, :1]
+        o_ref[0] = (acc_scr[...] / jnp.where(l == 0.0, 1.0, l)).astype(
+            o_ref.dtype)
+
+
+def _bias_layout(bias, h, block_q, block_k):
+    """(mode, BlockSpec) for the bias in its NATURAL 4-D shape — no
+    broadcast materialization: broadcast dims map to block 0 in the
+    index map, so HBM traffic stays at the bias's true size."""
+    import jax.experimental.pallas as pl
+
+    if bias is None:
+        return "none", pl.BlockSpec((1, 1, 1, 1),
+                                    lambda bh, qb, kb: (0, 0, 0, 0))
+    bb, bh_, bq, _bk = bias.shape
+    if bq == 1:  # key mask: one row broadcast over all queries
+        return "key", pl.BlockSpec(
+            (1, 1, 1, block_k),
+            lambda bh, qb, kb: (0 if bb == 1 else bh // h,
+                                0 if bh_ == 1 else bh % h, 0, kb))
+    return "full", pl.BlockSpec(
+        (1, 1, block_q, block_k),
+        lambda bh, qb, kb: (0 if bb == 1 else bh // h,
+                            0 if bh_ == 1 else bh % h, qb, kb))
+
+
+def _flash_call(q, k, v, bias, sm_scale, causal, block_q, block_k,
+                interpret):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    n_q, n_k = sq // block_q, sk // block_k
+    bias_mode, bias_spec = _bias_layout(bias, h, block_q, block_k)
+    bias_arr = bias if bias is not None else \
+        jnp.zeros((1, 1, 1, 1), q.dtype)
+
+    kern = functools.partial(
+        _fwd_kernel, sm_scale=sm_scale, causal=causal, block_q=block_q,
+        block_k=block_k, n_k=n_k, bias_mode=bias_mode)
+    out = pl.pallas_call(
+        kern,
+        grid=(b * h, n_q, n_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qb, kb: (bh, qb, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qb, kb: (bh, kb, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qb, kb: (bh, kb, 0)),
+            bias_spec,
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d),
+                               lambda bh, qb, kb: (bh, qb, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _LANES), jnp.float32),  # running max
+            pltpu.VMEM((block_q, _LANES), jnp.float32),  # running denom
+            pltpu.VMEM((block_q, d), jnp.float32),       # output acc
+        ],
+        interpret=interpret,
+    )(q.reshape(b * h, sq, d), k.reshape(b * h, sk, d),
+      v.reshape(b * h, sk, d), bias_arr)
+    return out.reshape(b, h, sq, d)
+
+
+# -- backward: q-chunked recompute ------------------------------------
+
+
+def _chunked_bwd(q, k, v, bias, do, sm_scale, causal, block_q):
+    """dq/dk/dv/dbias with O(BQ*Sk) live scores: scan over q chunks,
+    accumulating dk/dv (and a broadcast-reduced dbias) in the carry —
+    the flash backward recurrence expressed as XLA ops, fusion keeps
+    each chunk on-chip."""
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    n_chunks = sq // block_q
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    bias_q_bcast = bias is not None and bias.shape[2] == 1
+
+    def chunk(carry, idx):
+        dk_acc, dv_acc, db_acc = carry
+        off = idx * block_q
+        qc = lax.dynamic_slice_in_dim(q, off, block_q, 2).astype(
+            jnp.float32)                              # (B,H,BQ,D)
+        doc = lax.dynamic_slice_in_dim(do, off, block_q, 2).astype(
+            jnp.float32)
+        s = jnp.einsum("bhqd,bhkd->bhqk", qc, kf) * sm_scale
+        if bias is not None:
+            bb = bias.astype(jnp.float32)
+            bq = bb if bias_q_bcast else \
+                lax.dynamic_slice_in_dim(bb, off, block_q, 2)
+            s = s + bq
+        if causal:
+            rows = off + lax.broadcasted_iota(
+                jnp.int32, (block_q, sk), 0)
+            cols = lax.broadcasted_iota(jnp.int32, (block_q, sk), 1)
+            s = jnp.where((rows >= cols)[None, None], s, _NEG_INF)
+        p = jax.nn.softmax(s, axis=-1)                # (B,H,BQ,Sk)
+        dv_c = jnp.einsum("bhqk,bhqd->bhkd", p, doc)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", doc, vf)
+        delta = jnp.sum(p * dp, axis=-1, keepdims=True)
+        ds_raw = p * (dp - delta)       # = dL/ds before the qk scale
+        ds = ds_raw * sm_scale
+        dq_c = jnp.einsum("bhqk,bhkd->bhqd", ds, kf)
+        dk_c = jnp.einsum("bhqk,bhqd->bhkd", ds, qc)
+        db_c = None
+        if bias is not None:
+            db_c = ds_raw  # dL/dbias contribution of this q chunk
+            if bias.shape[1] == 1:
+                db_c = db_c.sum(1, keepdims=True)
+            if bias.shape[0] == 1:
+                db_c = db_c.sum(0, keepdims=True)
+            if bias_q_bcast:
+                db_acc = db_acc + db_c.sum(2, keepdims=True)
+                db_c = jnp.zeros((), jnp.float32)  # carried, not stacked
+        return (dk_acc + dk_c, dv_acc + dv_c, db_acc), (dq_c, db_c)
+
+    db_init = jnp.zeros((), jnp.float32) if bias is None or not \
+        bias_q_bcast else jnp.zeros(
+            (bias.shape[0], bias.shape[1], 1, sk), jnp.float32)
+    init = (jnp.zeros((b, h, sk, d), jnp.float32),
+            jnp.zeros((b, h, sk, d), jnp.float32), db_init)
+    (dk, dv, db_acc), (dq_chunks, db_chunks) = lax.scan(
+        chunk, init, jnp.arange(n_chunks))
+    dq = jnp.moveaxis(dq_chunks, 0, 2).reshape(b, h, sq, d)
+    dbias = None
+    if bias is not None:
+        if bias_q_bcast:
+            dbias = db_acc.astype(bias.dtype)
+        else:
+            dbias = jnp.moveaxis(db_chunks, 0, 2).reshape(
+                bias.shape[0], bias.shape[1], sq, sk).astype(bias.dtype)
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
+            dbias)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash(q, k, v, bias, sm_scale, causal, block_q, block_k, interpret):
+    return _flash_call(q, k, v, bias, sm_scale, causal, block_q, block_k,
+                       interpret)
+
+
+def _flash_fwd_rule(q, k, v, bias, sm_scale, causal, block_q, block_k,
+                    interpret):
+    out = _flash_call(q, k, v, bias, sm_scale, causal, block_q, block_k,
+                      interpret)
+    return out, (q, k, v, bias)
+
+
+def _flash_bwd_rule(sm_scale, causal, block_q, block_k, interpret, res,
+                    do):
+    q, k, v, bias = res
+    dq, dk, dv, dbias = _chunked_bwd(q, k, v, bias, do, sm_scale,
+                                     causal, block_q)
+    return dq, dk, dv, dbias
+
+
+_flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention_bias(q, k, v, bias=None, *, sm_scale=None,
+                         causal=False, block_q=128, block_k=128,
+                         interpret=False):
+    """Flash attention over (B, H, S, D) tensors with a streamed
+    additive bias: ``bias`` is None, a key mask [B,1,1,Sk], or a full
+    [B,H,Sq,Sk] tensor (additive -1e9-style masks included).
+    Differentiable (q-chunked recompute backward; bias treated as a
+    constant)."""
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    if sq % block_q or sk % block_k:
+        raise ValueError(
+            f"flash_attention_bias needs seq multiples of the block "
+            f"({block_q}/{block_k}); got Sq={sq}, Sk={sk}")
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+    return _flash(q, k, v, bias, float(sm_scale), bool(causal),
+                  int(block_q), int(block_k), bool(interpret))

@@ -1,0 +1,154 @@
+"""Custom Pallas flash-attention kernel: numpy/jnp-oracle parity in
+interpret mode, bias streaming forms, causal masking, gradients, and
+flag-controlled engagement through the fused_multihead_attention op.
+
+Parity model: reference operators/fused/multihead_matmul_op.cu (the
+scores->mask->softmax->context fusion); oracle is the plain composition
+(ops/fused.py _plain_attention).
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops import fused as fused_mod
+from paddle_tpu.ops.fused import _plain_attention
+from paddle_tpu.ops.pallas_attention import flash_attention_bias
+
+
+def _qkv(rs, B=2, H=2, S=256, D=64):
+    return (jnp.asarray(rs.randn(B, H, S, D).astype("f4")),
+            jnp.asarray(rs.randn(B, H, S, D).astype("f4")),
+            jnp.asarray(rs.randn(B, H, S, D).astype("f4")))
+
+
+def _key_mask(rs, B=2, S=256):
+    keep = rs.rand(B, 1, 1, S) > 0.2
+    return jnp.asarray(np.where(keep, 0.0, -1e9).astype("f4"))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("bias_kind", ["none", "key", "full"])
+def test_forward_parity(causal, bias_kind):
+    rs = np.random.RandomState(0)
+    q, k, v = _qkv(rs)
+    if bias_kind == "none":
+        bias = None
+    elif bias_kind == "key":
+        bias = _key_mask(rs)
+    else:
+        bias = jnp.asarray(rs.randn(2, 2, 256, 256).astype("f4"))
+    ref = _plain_attention(q, k, v, bias, 0.125, causal=causal)
+    got = flash_attention_bias(q, k, v, bias, sm_scale=0.125,
+                               causal=causal, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-5, rtol=1e-4)
+
+
+def test_gradients_match_plain_path():
+    rs = np.random.RandomState(1)
+    q, k, v = _qkv(rs)
+    mask = _key_mask(rs)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(_plain_attention(q, k, v, mask, 0.125) ** 2)
+
+    def loss_got(q, k, v):
+        return jnp.sum(flash_attention_bias(
+            q, k, v, mask, sm_scale=0.125, interpret=True) ** 2)
+
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    gg = jax.grad(loss_got, argnums=(0, 1, 2))(q, k, v)
+    for a, b, n in zip(gr, gg, "qkv"):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   atol=5e-4, rtol=1e-3, err_msg=n)
+
+
+@pytest.mark.parametrize("bias_shape", [(2, 1, 1, 256), (1, 1, 256, 256),
+                                        (2, 2, 256, 256)])
+def test_bias_gradient_matches_plain_path(bias_shape):
+    """A LEARNABLE additive bias must receive its true gradient from the
+    kernel path (a silent zero cotangent would freeze e.g. a relative-
+    position bias whenever flash engages)."""
+    rs = np.random.RandomState(4)
+    q, k, v = _qkv(rs)
+    bias = jnp.asarray(rs.randn(*bias_shape).astype("f4"))
+
+    def loss_ref(b):
+        return jnp.sum(_plain_attention(q, k, v, b, 0.125) ** 2)
+
+    def loss_got(b):
+        return jnp.sum(flash_attention_bias(
+            q, k, v, b, sm_scale=0.125, interpret=True) ** 2)
+
+    gr = jax.grad(loss_ref)(bias)
+    gg = jax.grad(loss_got)(bias)
+    np.testing.assert_allclose(np.asarray(gg), np.asarray(gr),
+                               atol=5e-4, rtol=1e-3)
+
+
+def test_unaligned_shapes_are_loud():
+    rs = np.random.RandomState(2)
+    q, k, v = _qkv(rs, S=200)
+    with pytest.raises(ValueError, match="multiples"):
+        flash_attention_bias(q, k, v, interpret=True)
+
+
+def test_fused_op_engages_kernel_under_always_flag():
+    """FLAGS_flash_attention=always routes the fused op through the
+    pallas kernel (interpret off-TPU) and matches the plain lowering."""
+    from paddle_tpu import layers
+    import paddle_tpu as pt
+    from paddle_tpu.framework.flags import set_flags
+    from paddle_tpu.framework.program import Program, program_guard
+
+    rs = np.random.RandomState(3)
+    B, S, H, D = 2, 128, 2, 64
+    qkv = {n: rs.randn(B, S, H * D).astype("f4") for n in "qkv"}
+    mask = np.where(rs.rand(B, 1, 1, S) > 0.2, 0.0, -1e9).astype("f4")
+
+    def run():
+        main, startup = Program(), Program()
+        with program_guard(main, startup):
+            qv = layers.data("q", [S, H * D])
+            kv = layers.data("k", [S, H * D])
+            vv = layers.data("v", [S, H * D])
+            bv = layers.data("bias", [1, 1, S])
+            out = main.global_block.create_var(
+                name="mha_out", shape=[-1, S, H * D], dtype="float32")
+            main.global_block.append_op(
+                "fused_multihead_attention",
+                {"Q": [qv.name], "K": [kv.name], "V": [vv.name],
+                 "BiasQK": [bv.name]},
+                {"Out": [out.name]}, {"head_number": H})
+        exe = pt.Executor(pt.CPUPlace())
+        return np.asarray(exe.run(
+            main, feed={"q": qkv["q"], "k": qkv["k"], "v": qkv["v"],
+                        "bias": mask},
+            fetch_list=[out])[0])
+
+    plain = run()
+    fused_mod._FORCE_INTERPRET = True
+    set_flags({"FLAGS_flash_attention": "always"})
+    try:
+        flash = run()
+    finally:
+        fused_mod._FORCE_INTERPRET = False
+        set_flags({"FLAGS_flash_attention": "auto"})
+    np.testing.assert_allclose(flash, plain, atol=2e-5, rtol=1e-4)
+
+
+def test_never_flag_forces_plain_path(monkeypatch):
+    """FLAGS_flash_attention=never keeps flash out even at huge scores
+    (no kernel import happens)."""
+    from paddle_tpu.framework.flags import set_flags
+
+    set_flags({"FLAGS_flash_attention": "never"})
+    try:
+        assert not fused_mod._flash_engaged(64, 16, 4096, 4096, 128)
+    finally:
+        set_flags({"FLAGS_flash_attention": "auto"})
+    # auto at the same (huge) shape engages on TPU
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    assert fused_mod._flash_engaged(64, 16, 4096, 4096, 128)
