@@ -1,0 +1,88 @@
+"""A/B harness: plain XLA attention vs flash kernels, fwd+bwd, on the
+real chip.  Writes artifacts/flash_ab.json; the numbers back the
+engagement heuristic documented in ops/fused.py:_flash_engaged.
+
+Run (TPU):  python artifacts/flash_ab.py
+Each config measures a grad step of sum(attention(q,k,v,mask)^2) —
+forward + backward, the training-shaped workload the heuristic serves.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def timeit(fn, *args, iters=10):
+    fn(*args)[0].block_until_ready() if isinstance(fn(*args), tuple) \
+        else jax.block_until_ready(fn(*args))  # compile
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    from paddle_tpu.ops.fused import _plain_attention
+    from paddle_tpu.ops.pallas_attention import flash_attention_bias
+
+    on_tpu = jax.default_backend() == "tpu"
+    results = {"backend": jax.default_backend(), "configs": []}
+    shapes = [
+        # (B, H, S, D) — BERT-base-ish through long-context
+        (32, 12, 128, 64),
+        (8, 12, 512, 64),
+        (4, 12, 1024, 64),
+        (2, 12, 2048, 64),
+        (1, 12, 4096, 64),
+    ]
+    for b, h, s, d in shapes:
+        rs = np.random.RandomState(0)
+        q = jnp.asarray(rs.randn(b, h, s, d).astype("float32"))
+        k = jnp.asarray(rs.randn(b, h, s, d).astype("float32"))
+        v = jnp.asarray(rs.randn(b, h, s, d).astype("float32"))
+        mask = jnp.asarray(
+            np.where(rs.rand(b, 1, 1, s) > 0.2, 0.0, -1e9)
+            .astype("float32"))
+        scale = 1.0 / np.sqrt(d)
+
+        @jax.jit
+        def step_plain(q, k, v):
+            def loss(q, k, v):
+                return jnp.sum(
+                    _plain_attention(q, k, v, mask, scale) ** 2)
+            return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+        @jax.jit
+        def step_flash(q, k, v):
+            def loss(q, k, v):
+                return jnp.sum(flash_attention_bias(
+                    q, k, v, mask, sm_scale=scale,
+                    interpret=not on_tpu) ** 2)
+            return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+        t_plain = timeit(step_plain, q, k, v)
+        t_flash = timeit(step_flash, q, k, v)
+        results["configs"].append({
+            "shape": [b, h, s, d],
+            "plain_ms": round(t_plain * 1e3, 3),
+            "flash_bias_ms": round(t_flash * 1e3, 3),
+            "flash_speedup": round(t_plain / t_flash, 3),
+            "scores_mb": round(4 * b * h * s * s / 2**20, 1),
+        })
+        print(results["configs"][-1], flush=True)
+
+    out = os.path.join(os.path.dirname(__file__), "flash_ab.json")
+    with open(out, "w") as f:
+        json.dump(results, f, indent=1)
+    print("wrote", out)
+
+
+if __name__ == "__main__":
+    main()

@@ -259,14 +259,18 @@ class GradientMergeMetaOptimizer(MetaOptimizerBase):
 
         merged = []
         acc_names = []
-        gm_map = {}  # orig grad name -> {acc, merged}; read by sharding
         for p, g in params_grads:
             acc = persistent(unique_name.generate(p.name + "_gm_acc"),
                              p.shape, 0.0)
             acc_names.append(acc.name)
+            # __gm_grad__ marks the accumulate op for the sharding
+            # transpiler (an op attr, not a python side channel, so the
+            # linkage survives clone/proto round-trips like
+            # __sharded_accumulators__ does)
             block.append_op("elementwise_add",
                             {"X": [acc.name], "Y": [g.name]},
-                            {"Out": [acc.name]}, {"axis": -1})
+                            {"Out": [acc.name]},
+                            {"axis": -1, "__gm_grad__": g.name})
             mg = block.create_var(name=unique_name.generate(g.name + ".gm"),
                                   shape=list(p.shape), dtype="float32",
                                   stop_gradient=True)
@@ -278,8 +282,6 @@ class GradientMergeMetaOptimizer(MetaOptimizerBase):
                                 {"scale": 1.0 / k, "bias": 0.0,
                                  "bias_after_scale": True})
             merged.append((p, block.var(mg.name)))
-            gm_map[g.name] = {"acc": acc.name, "merged": mg.name}
-        loss.block.program._gm_map = gm_map
 
         # optimizer ops run every step on the masked grad; snapshot every
         # state var they overwrite and select-restore on non-update steps.
@@ -513,11 +515,32 @@ class ShardingMetaOptimizer(MetaOptimizerBase):
         # space — acc/merged ride the grad SHARD (c_reducescatter output)
         # and join the sharded optimizer state, so merge-accumulator
         # memory also drops by the dp degree
-        gm_map = getattr(prog, "_gm_map", None) or {}
+        gm_map = self._collect_gm_map(prog.global_block)
         self._transpile_grads(prog, params_grads, sharded_params,
                               loss.name + GRAD_SUFFIX, gm_map=gm_map)
         self._shard_optimizer_ops(prog, n, sharded_params, gm_map=gm_map)
         return ops, params_grads
+
+    @staticmethod
+    def _collect_gm_map(block):
+        """Reconstruct {orig grad -> {acc, merged}} from the __gm_grad__
+        attrs the merge optimizer stamps on its accumulate ops (attrs,
+        not a python side channel, so a clone/proto round-trip between
+        the two meta-optimizers cannot lose the linkage)."""
+        out = {}
+        for i, op in enumerate(block.ops):
+            g = op.attr("__gm_grad__", None)
+            if not g:
+                continue
+            acc = op.inputs["X"][0]
+            for op2 in block.ops[i + 1:]:
+                if op2.type == "elementwise_mul" \
+                        and op2.inputs.get("X") == [acc] \
+                        and op2.outputs.get("Out") != [acc]:
+                    out[g] = {"acc": acc,
+                              "merged": op2.outputs["Out"][0]}
+                    break
+        return out
 
     def _sharded_param_set(self, prog, params_grads, nranks):
         block = prog.global_block

@@ -18,7 +18,8 @@ from __future__ import annotations
 import math
 
 
-def ring_attention(q, k, v, axis_name="sp", sm_scale=None, causal=False):
+def ring_attention(q, k, v, axis_name="sp", sm_scale=None, causal=False,
+                   bias=None):
     """Per-shard attention inside shard_map.
 
     Args:
@@ -27,8 +28,15 @@ def ring_attention(q, k, v, axis_name="sp", sm_scale=None, causal=False):
       sm_scale: score scale; defaults to 1/sqrt(D).
       causal: causal masking with GLOBAL sequence positions (shard i
         holds positions [i*S_local, (i+1)*S_local)).
+      bias: optional additive KEY mask [B, 1, 1, S_local] — each rank
+        holds the mask shard for ITS keys; the shard rotates around the
+        ring with its k/v block, so a padding mask costs one extra
+        O(B*S_local) ppermute per step.  (A full [B,H,Sq,Sk] bias has
+        no shardable rotation form and is rejected upstream.)
 
-    Returns [B, H, S_local, D] in q.dtype.
+    Returns [B, H, S_local, D] in q.dtype.  Differentiable by
+    construction — ppermute's transpose rule makes jax.vjp of this the
+    reverse ring, including the bias cotangent.
     """
     import jax
     import jax.numpy as jnp
@@ -44,8 +52,10 @@ def ring_attention(q, k, v, axis_name="sp", sm_scale=None, causal=False):
     qf = q.astype(jnp.float32) * sm_scale
     neg = jnp.float32(-1e30)
 
-    def block(qf, kj, vj, j_rank):
+    def block(qf, kj, vj, bj, j_rank):
         s = jnp.einsum("bhqd,bhkd->bhqk", qf, kj.astype(jnp.float32))
+        if bj is not None:
+            s = s + bj.astype(jnp.float32)  # [B,1,1,Sk] broadcasts
         if causal:
             q_pos = r * s_local + jnp.arange(s_local)
             k_pos = j_rank * s_local + jnp.arange(s_local)
@@ -57,13 +67,13 @@ def ring_attention(q, k, v, axis_name="sp", sm_scale=None, causal=False):
         o = jnp.einsum("bhqk,bhkd->bhqd", e, vj.astype(jnp.float32))
         return m, l, o
 
-    # carry: (k_block, v_block, owner_rank, m_run, l_run, acc)
+    # carry: (k_block, v_block, bias_block, owner_rank, m/l/acc)
     m_run = jnp.full((b, h, s_local), neg)
     l_run = jnp.zeros((b, h, s_local), jnp.float32)
     acc = jnp.zeros((b, h, s_local, d), jnp.float32)
-    kj, vj, owner = k, v, r
+    kj, vj, bj, owner = k, v, bias, r
     for _step in range(p):
-        m_j, l_j, o_j = block(qf, kj, vj, owner)
+        m_j, l_j, o_j = block(qf, kj, vj, bj, owner)
         m_new = jnp.maximum(m_run, m_j)
         alpha = jnp.exp(m_run - m_new)  # rescale old accumulator
         beta = jnp.exp(m_j - m_new)  # rescale this block
@@ -73,6 +83,8 @@ def ring_attention(q, k, v, axis_name="sp", sm_scale=None, causal=False):
         if _step < p - 1:
             kj = lax.ppermute(kj, axis_name, perm)
             vj = lax.ppermute(vj, axis_name, perm)
+            if bj is not None:
+                bj = lax.ppermute(bj, axis_name, perm)
             owner = (owner - 1) % p
     out = acc / jnp.maximum(l_run[..., None], 1e-30)
     return out.astype(q.dtype)
@@ -82,26 +94,30 @@ _SHARDED_CACHE = {}
 
 
 def ring_attention_sharded(q, k, v, mesh, axis_name="sp", sm_scale=None,
-                           causal=False):
+                           causal=False, bias=None):
     """Convenience wrapper: global [B, H, S, D] arrays in, shard_map over
-    the sequence dim, global array out (for tests / eager use).  The
-    jitted callable is cached per (mesh, axis, scale, causal) so repeated
-    calls hit the compile cache instead of retracing."""
+    the sequence dim, global array out (for tests / eager use).  A key
+    mask ``bias`` [B, 1, 1, S] shards on its key dim.  The jitted
+    callable is cached per (mesh, axis, scale, causal, has-bias) so
+    repeated calls hit the compile cache instead of retracing."""
     import jax
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
-    key = (id(mesh), axis_name, sm_scale, causal)
+    key = (id(mesh), axis_name, sm_scale, causal, bias is not None)
     fn = _SHARDED_CACHE.get(key)
     if fn is None:
         spec = P(None, None, axis_name, None)
+        in_specs = (spec, spec, spec) + (
+            (P(None, None, None, axis_name),) if bias is not None else ())
 
-        def f(q, k, v):
+        def f(q, k, v, bias=None):
             return ring_attention(q, k, v, axis_name=axis_name,
-                                  sm_scale=sm_scale, causal=causal)
+                                  sm_scale=sm_scale, causal=causal,
+                                  bias=bias)
 
-        fn = jax.jit(shard_map(
-            f, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-            check_vma=False))
+        fn = jax.jit(shard_map(f, mesh=mesh, in_specs=in_specs,
+                               out_specs=spec, check_vma=False))
         _SHARDED_CACHE[key] = fn
-    return fn(q, k, v)
+    args = (q, k, v) if bias is None else (q, k, v, bias)
+    return fn(*args)

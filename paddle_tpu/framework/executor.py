@@ -396,6 +396,9 @@ class Executor:
             id(mesh),
             ops is not None,
             nan_scan,
+            # lowering-behavior flags read at trace time must key the
+            # cache, or flipping them between runs is silently ignored
+            str(flags.flag("flash_attention")),
         )
         from ..monitor import stat_add
 

@@ -108,13 +108,19 @@ def _fused_mha(ctx, op):
                 "fused_multihead_attention(sequence_parallel=True) needs "
                 "an 'sp' mesh axis in scope (run under a sequence-sharded "
                 "shard_map)")
-        if bias is not None:
+        if bias is not None and not (bias.shape[1] == 1
+                                     and bias.shape[2] == 1):
             raise NotImplementedError(
-                "fused attention under sequence parallelism does not take "
-                "an additive bias yet (pack sequences; causal via attr)")
+                "fused attention under sequence parallelism takes only a "
+                "key mask [B,1,1,S_local] (it rotates around the ring "
+                "with its k/v shard); a full [B,H,S,S] bias has no "
+                "shardable rotation form")
         out = ring_attention(qh, kh, vh, axis_name="sp", sm_scale=sm_scale,
-                             causal=causal)
+                             causal=causal, bias=bias)
     elif _flash_engaged(b, n_heads, s, s, d):
+        from ..monitor import stat_add
+
+        stat_add("flash_attention_engaged")
         if bias is not None:
             # biased attention: OUR kernel streams the additive mask
             # block-by-block (pallas_attention.py) — the stock kernel

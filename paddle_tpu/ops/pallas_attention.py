@@ -258,6 +258,17 @@ def flash_attention_bias(q, k, v, bias=None, *, sm_scale=None,
         raise ValueError(
             f"flash_attention_bias needs seq multiples of the block "
             f"({block_q}/{block_k}); got Sq={sq}, Sk={sk}")
+    if bias is not None:
+        # Mosaic CLAMPS out-of-range block indices — a mis-sized bias
+        # would silently reuse the last tile instead of erroring
+        ok = (bias.ndim == 4
+              and bias.shape[0] in (1, b) and bias.shape[1] in (1, h)
+              and bias.shape[2] in (1, sq) and bias.shape[3] == sk)
+        if not ok:
+            raise ValueError(
+                f"bias shape {tuple(bias.shape)} does not broadcast to "
+                f"(B={b}, H={h}, Sq={sq}, Sk={sk}); the key dim must be "
+                f"exactly Sk")
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(d)
     return _flash(q, k, v, bias, float(sm_scale), bool(causal),

@@ -139,6 +139,46 @@ def test_fused_op_engages_kernel_under_always_flag():
     np.testing.assert_allclose(flash, plain, atol=2e-5, rtol=1e-4)
 
 
+def test_flag_flip_takes_effect_on_same_executor():
+    """FLAGS_flash_attention keys the executor compile cache: flipping
+    it between runs of ONE program on ONE executor must re-lower (a
+    stale cached lowering would silently ignore the flag)."""
+    from paddle_tpu import layers
+    import paddle_tpu as pt
+    from paddle_tpu.framework.flags import set_flags
+    from paddle_tpu.framework.program import Program, program_guard
+    from paddle_tpu.monitor import stat_get, stat_reset
+
+    rs = np.random.RandomState(5)
+    B, S, H, D = 2, 128, 2, 64
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        qv = layers.data("q", [S, H * D])
+        out = main.global_block.create_var(
+            name="mha_out2", shape=[-1, S, H * D], dtype="float32")
+        main.global_block.append_op(
+            "fused_multihead_attention",
+            {"Q": [qv.name], "K": [qv.name], "V": [qv.name]},
+            {"Out": [out.name]}, {"head_number": H})
+    exe = pt.Executor(pt.CPUPlace())
+    feed = {"q": rs.randn(B, S, H * D).astype("f4")}
+
+    stat_reset("flash_attention_engaged")
+    plain = np.asarray(exe.run(main, feed=feed, fetch_list=[out])[0])
+    assert stat_get("flash_attention_engaged") == 0
+    fused_mod._FORCE_INTERPRET = True
+    set_flags({"FLAGS_flash_attention": "always"})
+    try:
+        flash = np.asarray(exe.run(main, feed=feed,
+                                   fetch_list=[out])[0])
+        assert stat_get("flash_attention_engaged") >= 1, \
+            "flag flip ignored: stale compile-cache entry reused"
+    finally:
+        fused_mod._FORCE_INTERPRET = False
+        set_flags({"FLAGS_flash_attention": "auto"})
+    np.testing.assert_allclose(flash, plain, atol=2e-5, rtol=1e-4)
+
+
 def test_never_flag_forces_plain_path(monkeypatch):
     """FLAGS_flash_attention=never keeps flash out even at huge scores
     (no kernel import happens)."""

@@ -131,3 +131,40 @@ def test_fused_op_uses_ring_under_sp(mesh):
         B, S, hidden)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=1e-4, atol=1e-5)
+
+
+def test_key_mask_bias_matches_full_attention(mesh):
+    """A padding key-mask rotates around the ring with its k/v shard
+    (round-5: the SP path previously rejected any bias)."""
+    q, k, v = _qkv(5)
+    rs = np.random.RandomState(6)
+    bias = jnp.asarray(
+        np.where(rs.rand(B, 1, 1, S) > 0.25, 0.0, -1e9), jnp.float32)
+    got = ring_attention_sharded(q, k, v, mesh, bias=bias)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(D) + bias
+    want = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1), v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_key_mask_bias_backward(mesh):
+    q, k, v = _qkv(7)
+    rs = np.random.RandomState(8)
+    bias = jnp.asarray(
+        np.where(rs.rand(B, 1, 1, S) > 0.25, 0.0, -1e9), jnp.float32)
+
+    def loss_ring(q, k, v):
+        return jnp.sum(ring_attention_sharded(q, k, v, mesh,
+                                              bias=bias) ** 2)
+
+    def loss_full(q, k, v):
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(D) + bias
+        o = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1), v)
+        return jnp.sum(o ** 2)
+
+    gr = jax.grad(loss_full, argnums=(0, 1, 2))(q, k, v)
+    gg = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gr, gg, "qkv"):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=2e-4, atol=2e-5,
+                                   err_msg=f"grad {name}")
