@@ -19,8 +19,7 @@ import numpy as np
 
 
 def timeit(fn, *args, iters=10):
-    fn(*args)[0].block_until_ready() if isinstance(fn(*args), tuple) \
-        else jax.block_until_ready(fn(*args))  # compile
+    jax.block_until_ready(fn(*args))  # compile + warm
     t0 = time.perf_counter()
     for _ in range(iters):
         out = fn(*args)

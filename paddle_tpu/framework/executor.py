@@ -396,9 +396,10 @@ class Executor:
             id(mesh),
             ops is not None,
             nan_scan,
-            # lowering-behavior flags read at trace time must key the
-            # cache, or flipping them between runs is silently ignored
-            str(flags.flag("flash_attention")),
+            # flags read at trace time must key the cache, or flipping
+            # them between runs is silently ignored; any flag defined
+            # with affects_lowering=True joins automatically
+            flags.lowering_key(),
         )
         from ..monitor import stat_add
 

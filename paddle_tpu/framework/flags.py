@@ -38,10 +38,24 @@ class _Flag:
 _REGISTRY: Dict[str, _Flag] = {}
 
 
-def define_flag(name: str, default, help_: str = ""):
+_LOWERING_FLAGS: set = set()  # flags read at trace time (key compiles)
+
+
+def lowering_key() -> tuple:
+    """State of every flag that affects op lowering — folded into the
+    Executor compile-cache key so flipping any of them re-lowers
+    instead of silently reusing a stale compiled program."""
+    return tuple(sorted(
+        (n, _REGISTRY[n].value) for n in _LOWERING_FLAGS))
+
+
+def define_flag(name: str, default, help_: str = "",
+                affects_lowering: bool = False):
     if name in _REGISTRY:
         raise KeyError(f"flag {name!r} already defined")
     _REGISTRY[name] = _Flag(name, default, help_)
+    if affects_lowering:
+        _LOWERING_FLAGS.add(name)
 
 
 def get_flags(flags):
@@ -86,4 +100,5 @@ define_flag("cpu_deterministic", False,
 define_flag("seed", 0, "global random seed override (0 = program seed)")
 define_flag("flash_attention", "auto",
             "fused attention kernel engagement: 'auto' (flash only when "
-            "the score tensor would threaten HBM), 'always', 'never'")
+            "the score tensor would threaten HBM), 'always', 'never'",
+            affects_lowering=True)

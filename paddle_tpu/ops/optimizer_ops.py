@@ -238,6 +238,40 @@ def _ftrl(ctx, op):
 # ---------------------------------------------------------------------------
 
 
+@register_lower("dpsgd")
+def _dpsgd(ctx, op):
+    """Differentially-private SGD (reference operators/optimizers/
+    dpsgd_op.cc): L2-clip the per-batch gradient to ``clip`` and add
+    Gaussian noise scaled by ``sigma/batch_size`` before the SGD step."""
+    from .common import op_seed_key
+
+    p = ctx.in1(op, "Param")
+    g = ctx.in1(op, "Grad").astype(jnp.float32)
+    lr = as_scalar(ctx.in1(op, "LearningRate")).astype(jnp.float32)
+    clip = jnp.float32(op.attr("clip", 10.0))
+    batch_size = jnp.float32(op.attr("batch_size", 16.0))
+    sigma = jnp.float32(op.attr("sigma", 1.0))
+    norm = jnp.sqrt(jnp.sum(g * g))
+    g = g * jnp.minimum(1.0, clip / jnp.maximum(norm, 1e-12))
+    noise = jax.random.normal(op_seed_key(ctx, op), g.shape,
+                              jnp.float32) * (clip * sigma / batch_size)
+    ctx.set_out(op, "ParamOut",
+                (p.astype(jnp.float32) - lr * (g + noise)).astype(p.dtype))
+
+
+@register_lower("ema_update")
+def _ema_update(ctx, op):
+    """Shadow accumulator for ExponentialMovingAverage (reference
+    optimizer.py:3443 builds this from scale/sum primitives; one op here
+    keeps it fusable): shadow' = decay*shadow + (1-decay)*param."""
+    p = ctx.in1(op, "Param").astype(jnp.float32)
+    s = ctx.in1(op, "Shadow").astype(jnp.float32)
+    decay = as_scalar(ctx.in1(op, "Decay")) if op.inputs.get("Decay") \
+        else jnp.float32(op.attr("decay", 0.999))
+    out = decay * s + (1.0 - decay) * p
+    ctx.set_out(op, "ShadowOut", out)
+
+
 @register_lower("check_finite_and_unscale")
 def _check_finite_and_unscale(ctx, op):
     scale = as_scalar(ctx.in1(op, "Scale"))

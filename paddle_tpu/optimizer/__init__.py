@@ -19,9 +19,13 @@ from .static_opt import (  # noqa: F401  (fluid-compat re-exports)
     AdamaxOptimizer,
     AdamOptimizer,
     AdamWOptimizer,
+    DpsgdOptimizer,
+    ExponentialMovingAverage,
     FtrlOptimizer,
     LambOptimizer,
     LarsMomentumOptimizer,
+    LookaheadOptimizer,
+    ModelAverage,
     MomentumOptimizer,
     Optimizer as _FluidOptimizer,
     RMSPropOptimizer,

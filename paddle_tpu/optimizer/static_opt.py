@@ -484,3 +484,367 @@ RMSProp = RMSPropOptimizer
 Lamb = LambOptimizer
 LarsMomentum = LarsMomentumOptimizer
 Ftrl = FtrlOptimizer
+
+
+def _make_persistent(block, startup, name, shape, value, init_from=None):
+    """Persistable var in the main block + startup init (constant or
+    copy-from another var).  Single definition for every accumulator
+    these wrapper optimizers create."""
+    v = block.create_var(name=name, shape=list(shape), dtype="float32",
+                         persistable=True, stop_gradient=True)
+    sv = startup.global_block.create_var(
+        name=name, shape=list(shape), dtype="float32", persistable=True)
+    if init_from is None:
+        ConstantInitializer(value)(sv, startup.global_block)
+    else:
+        startup.global_block.append_op(
+            "assign", {"X": [init_from]}, {"Out": [name]}, {})
+    return v
+
+
+class _ScopeSwap:
+    """Shared apply()/restore() machinery for EMA / ModelAverage: swap
+    computed values into the parameters, with backups held ON the
+    instance so apply(need_restore=False) followed by a later
+    restore() works (the reference pattern)."""
+
+    def _swap_in(self, sc, values):
+        self._backups = {}
+        for pname, arr in values.items():
+            import numpy as np
+
+            self._backups[pname] = np.asarray(sc.get_var(pname)).copy()
+            sc.set_var(pname, arr)
+        self._backup_scope = sc
+
+    def restore(self, executor=None, scope=None):
+        from ..framework.scope import global_scope
+
+        sc = scope or getattr(self, "_backup_scope", None) or global_scope()
+        for pname, arr in (getattr(self, "_backups", None) or {}).items():
+            sc.set_var(pname, arr)
+        self._backups = {}
+
+    def _guard(self, sc, values, need_restore):
+        import contextlib
+
+        @contextlib.contextmanager
+        def guard():
+            self._swap_in(sc, values)
+            try:
+                yield
+            finally:
+                if need_restore:
+                    self.restore(scope=sc)
+
+        return guard()
+
+
+class DpsgdOptimizer(Optimizer):
+    """Differentially-private SGD (reference optimizer.py Dpsgd +
+    operators/optimizers/dpsgd_op.cc): clip + Gaussian noise on the
+    batch gradient."""
+
+    def __init__(self, learning_rate=0.001, clip=10.0, batch_size=16.0,
+                 sigma=1.0, **kw):
+        super().__init__(learning_rate, **kw)
+        self._clip = float(clip)
+        self._batch_size = float(batch_size)
+        self._sigma = float(sigma)
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        return block.append_op(
+            "dpsgd",
+            {"Param": p, "Grad": g, "LearningRate": self._lr_var},
+            {"ParamOut": p},
+            {"clip": self._clip, "batch_size": self._batch_size,
+             "sigma": self._sigma},
+        )
+
+
+class ExponentialMovingAverage(_ScopeSwap):
+    """EMA of parameters (reference fluid.optimizer.
+    ExponentialMovingAverage, optimizer.py:3443): ``update()`` appends
+    shadow-accumulator ops to the current main program (run them every
+    train step); ``apply(exe)`` swaps the bias-corrected shadow values
+    into the parameters for evaluation (context manager, or
+    need_restore=False + a later ``restore()``).  ``thres_steps`` turns
+    on the reference's decay ramp min(decay, (1+t)/(10+t))."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = float(decay)
+        self._thres_steps = thres_steps
+        self._name = name or "ema"
+        self._shadows = {}  # param name -> shadow var name
+        self._step_name = None
+        self._decay_hist = None  # prod of (per-step decay) for bias corr
+
+    def update(self):
+        from ..framework import unique_name
+        from ..framework.program import (default_main_program,
+                                         default_startup_program)
+
+        main = default_main_program()
+        startup = default_startup_program()
+        block = main.global_block
+
+        step = unique_name.generate(f"{self._name}_step")
+        _make_persistent(block, startup, step, [1], 0.0)
+        self._step_name = step
+        block.append_op("increment", {"X": [step]}, {"Out": [step]},
+                        {"step": 1.0})
+        decay_inputs = {}
+        if self._thres_steps is not None:
+            # ramped decay: min(decay, (1+t)/(10+t)) — early steps lean
+            # on recent weights instead of the near-zero shadow
+            num = unique_name.generate(f"{self._name}_dnum")
+            den = unique_name.generate(f"{self._name}_dden")
+            ramp = unique_name.generate(f"{self._name}_ramp")
+            for nm in (num, den, ramp):
+                block.create_var(name=nm, shape=[1], dtype="float32",
+                                 stop_gradient=True)
+            block.append_op("scale", {"X": [step]}, {"Out": [num]},
+                            {"scale": 1.0, "bias": 1.0,
+                             "bias_after_scale": True})
+            block.append_op("scale", {"X": [step]}, {"Out": [den]},
+                            {"scale": 1.0, "bias": 10.0,
+                             "bias_after_scale": True})
+            block.append_op("elementwise_div",
+                            {"X": [num], "Y": [den]}, {"Out": [ramp]},
+                            {"axis": -1})
+            block.append_op("clip", {"X": [ramp]}, {"Out": [ramp]},
+                            {"min": 0.0, "max": self._decay})
+            decay_inputs = {"Decay": [ramp]}
+            # bias correction needs prod(decay_t): carry it as state
+            hist = unique_name.generate(f"{self._name}_dhist")
+            _make_persistent(block, startup, hist, [1], 1.0)
+            block.append_op("elementwise_mul",
+                            {"X": [hist], "Y": [ramp]}, {"Out": [hist]},
+                            {"axis": -1})
+            self._decay_hist = hist
+        for p in main.all_parameters():
+            shadow = unique_name.generate(f"{p.name}_{self._name}")
+            _make_persistent(block, startup, shadow, p.shape, 0.0)
+            block.append_op(
+                "ema_update",
+                {"Param": [p.name], "Shadow": [shadow], **decay_inputs},
+                {"ShadowOut": [shadow]}, {"decay": self._decay})
+            self._shadows[p.name] = shadow
+
+    def apply(self, executor=None, need_restore=True, scope=None):
+        """params <- shadow / (1 - prod(decay_t))  (bias corrected)."""
+        import numpy as np
+
+        from ..framework.scope import global_scope
+
+        sc = scope or global_scope()
+        if self._decay_hist is not None and sc.has_var(self._decay_hist):
+            prod = float(np.asarray(sc.get_var(self._decay_hist))
+                         .ravel()[0])
+        else:
+            t = float(np.asarray(sc.get_var(self._step_name)).ravel()[0]) \
+                if self._step_name and sc.has_var(self._step_name) else 0.0
+            prod = self._decay ** t if t > 0 else 0.0
+        corr = max(1.0 - prod, 1e-12)
+        values = {p: np.asarray(sc.get_var(s)) / corr
+                  for p, s in self._shadows.items()}
+        return self._guard(sc, values, need_restore)
+
+
+class ModelAverage(_ScopeSwap):
+    """Windowed average of parameters (reference fluid.optimizer.
+    ModelAverage, optimizer.py:3134).  The reference bounds the window
+    with a sum_1/sum_2/sum_3 rotation; here a TWO-buffer masked
+    rotation keeps the averaging window within
+    [max_average_window, 2*max_average_window] with one fewer buffer
+    (no control flow — the rotation is a masked select, XLA-friendly):
+    when the current buffer's count hits the window, it rolls into the
+    old buffer and restarts."""
+
+    def __init__(self, average_window_rate=0.15, min_average_window=10000,
+                 max_average_window=10000, name=None):
+        self._name = name or "model_avg"
+        self._window = max(1, int(max_average_window))
+        self._sums = {}       # param -> (sum_cur, sum_old)
+        self._cnt_cur = None
+        self._cnt_old = None
+        self.update()
+
+    def update(self):
+        from ..framework import unique_name
+        from ..framework.program import (default_main_program,
+                                         default_startup_program)
+
+        main = default_main_program()
+        startup = default_startup_program()
+        block = main.global_block
+
+        def temp(name, shape=(1,)):
+            block.create_var(name=name, shape=list(shape),
+                             dtype="float32", stop_gradient=True)
+            return name
+
+        cnt = unique_name.generate(f"{self._name}_cnt")
+        cnt_old = unique_name.generate(f"{self._name}_cnt_old")
+        _make_persistent(block, startup, cnt, [1], 0.0)
+        _make_persistent(block, startup, cnt_old, [1], 0.0)
+        self._cnt_cur, self._cnt_old = cnt, cnt_old
+        block.append_op("increment", {"X": [cnt]}, {"Out": [cnt]},
+                        {"step": 1.0})
+        # rotation mask: cnt == window
+        w = temp(unique_name.generate(f"{self._name}_w"))
+        block.append_op("fill_constant", {}, {"Out": [w]},
+                        {"shape": [1], "dtype": "float32",
+                         "value": float(self._window)})
+        cond = unique_name.generate(f"{self._name}_cond")
+        block.create_var(name=cond, shape=[1], dtype="bool",
+                         stop_gradient=True)
+        block.append_op("equal", {"X": [cnt], "Y": [w]}, {"Out": [cond]})
+        mask = temp(unique_name.generate(f"{self._name}_mask"))
+        block.append_op("cast", {"X": [cond]}, {"Out": [mask]},
+                        {"out_dtype": "float32"})
+        inv = temp(unique_name.generate(f"{self._name}_inv"))
+        block.append_op("scale", {"X": [mask]}, {"Out": [inv]},
+                        {"scale": -1.0, "bias": 1.0,
+                         "bias_after_scale": True})
+
+        def rotate(cur, old, shape=(1,)):
+            # old' = (1-mask)*old + mask*cur ; cur' = (1-mask)*cur
+            keep = temp(unique_name.generate(f"{self._name}_keep"),
+                        shape=shape)
+            roll = temp(unique_name.generate(f"{self._name}_roll"),
+                        shape=shape)
+            block.append_op("elementwise_mul", {"X": [old], "Y": [inv]},
+                            {"Out": [keep]}, {"axis": -1})
+            block.append_op("elementwise_mul", {"X": [cur], "Y": [mask]},
+                            {"Out": [roll]}, {"axis": -1})
+            block.append_op("elementwise_add", {"X": [keep], "Y": [roll]},
+                            {"Out": [old]}, {"axis": -1})
+            block.append_op("elementwise_mul", {"X": [cur], "Y": [inv]},
+                            {"Out": [cur]}, {"axis": -1})
+
+        for p in main.all_parameters():
+            s = unique_name.generate(f"{p.name}_{self._name}_sum")
+            s_old = unique_name.generate(f"{p.name}_{self._name}_sum_old")
+            _make_persistent(block, startup, s, p.shape, 0.0)
+            _make_persistent(block, startup, s_old, p.shape, 0.0)
+            block.append_op("elementwise_add",
+                            {"X": [s], "Y": [p.name]}, {"Out": [s]},
+                            {"axis": -1})
+            rotate(s, s_old, shape=p.shape)
+            self._sums[p.name] = (s, s_old)
+        rotate(cnt, cnt_old)
+
+    def apply(self, executor=None, need_restore=True, scope=None):
+        import numpy as np
+
+        from ..framework.scope import global_scope
+
+        sc = scope or global_scope()
+        n = (float(np.asarray(sc.get_var(self._cnt_cur)).ravel()[0])
+             + float(np.asarray(sc.get_var(self._cnt_old)).ravel()[0]))
+        values = {}
+        if n > 0:
+            for pname, (s, s_old) in self._sums.items():
+                values[pname] = (np.asarray(sc.get_var(s))
+                                 + np.asarray(sc.get_var(s_old))) / n
+        return self._guard(sc, values, need_restore)
+
+
+class LookaheadOptimizer:
+    """Lookahead wrapper (reference optimizer.py:4853): the inner
+    optimizer updates the fast weights every step; every k steps the
+    slow weights move toward the fast ones (slow += alpha*(fast-slow))
+    and the fast weights reset to them.  Masked-update form (no
+    control flow), like GradientMergeOptimizer."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5):
+        if not 0.0 <= alpha <= 1.0:
+            raise ValueError("alpha must be in [0, 1]")
+        self.inner = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        from ..framework import unique_name
+        from ..framework.program import default_startup_program
+
+        ops, pgs = self.inner.minimize(loss, startup_program,
+                                       parameter_list, no_grad_set)
+        main = loss.block.program
+        startup = startup_program or default_startup_program()
+        block = main.global_block
+
+        def persistent(name, shape, value, init_from=None):
+            return _make_persistent(block, startup, name, shape, value,
+                                    init_from=init_from)
+
+        step = unique_name.generate("la_step")
+        persistent(step, [1], 0.0)
+        block.append_op("increment", {"X": [step]}, {"Out": [step]},
+                        {"step": 1.0})
+        k_const = unique_name.generate("la_k")
+        block.append_op("fill_constant", {}, {"Out": [k_const]},
+                        {"shape": [1], "dtype": "float32",
+                         "value": float(self.k)})
+        cond = unique_name.generate("la_cond")
+        block.create_var(name=cond, shape=[1], dtype="bool",
+                         stop_gradient=True)
+        block.append_op("equal", {"X": [step], "Y": [k_const]},
+                        {"Out": [cond]})
+        mask = unique_name.generate("la_mask")
+        block.create_var(name=mask, shape=[1], dtype="float32",
+                         stop_gradient=True)
+        block.append_op("cast", {"X": [cond]}, {"Out": [mask]},
+                        {"out_dtype": "float32"})
+        inv = unique_name.generate("la_inv")
+        block.create_var(name=inv, shape=[1], dtype="float32",
+                         stop_gradient=True)
+        block.append_op("scale", {"X": [mask]}, {"Out": [inv]},
+                        {"scale": -1.0, "bias": 1.0,
+                         "bias_after_scale": True})
+        block.append_op("elementwise_mul", {"X": [step], "Y": [inv]},
+                        {"Out": [step]}, {"axis": -1})
+
+        for p, _ in pgs:
+            slow = unique_name.generate(p.name + "_la_slow")
+            persistent(slow, p.shape, 0.0, init_from=p.name)
+            # slow' = slow + mask*alpha*(fast - slow)
+            diff = unique_name.generate(p.name + "_la_diff")
+            block.create_var(name=diff, shape=list(p.shape),
+                             dtype="float32", stop_gradient=True)
+            block.append_op("elementwise_sub",
+                            {"X": [p.name], "Y": [slow]}, {"Out": [diff]},
+                            {"axis": -1})
+            block.append_op("scale", {"X": [diff]}, {"Out": [diff]},
+                            {"scale": self.alpha, "bias": 0.0,
+                             "bias_after_scale": True})
+            block.append_op("elementwise_mul",
+                            {"X": [diff], "Y": [mask]}, {"Out": [diff]},
+                            {"axis": -1})
+            block.append_op("elementwise_add",
+                            {"X": [slow], "Y": [diff]}, {"Out": [slow]},
+                            {"axis": -1})
+            # fast' = (1-mask)*fast + mask*slow'
+            keep = unique_name.generate(p.name + "_la_keep")
+            block.create_var(name=keep, shape=list(p.shape),
+                             dtype="float32", stop_gradient=True)
+            block.append_op("elementwise_mul",
+                            {"X": [p.name], "Y": [inv]}, {"Out": [keep]},
+                            {"axis": -1})
+            upd = unique_name.generate(p.name + "_la_upd")
+            block.create_var(name=upd, shape=list(p.shape),
+                             dtype="float32", stop_gradient=True)
+            block.append_op("elementwise_mul",
+                            {"X": [slow], "Y": [mask]}, {"Out": [upd]},
+                            {"axis": -1})
+            block.append_op("elementwise_add",
+                            {"X": [keep], "Y": [upd]}, {"Out": [p.name]},
+                            {"axis": -1})
+        main._bump()
+        return ops, pgs
+
+
+Dpsgd = DpsgdOptimizer
