@@ -425,16 +425,9 @@ class Model:
         return self.network.parameters(*args, **kwargs)
 
     def summary(self, input_size=None, dtype=None):
-        lines = [f"Model: {type(self.network).__name__}"]
-        total = 0
-        for name, p in self.network.named_parameters():
-            n = int(np.prod(p.shape))
-            total += n
-            lines.append(f"  {name:40s} {str(p.shape):20s} {n}")
-        lines.append(f"Total params: {total}")
-        s = "\n".join(lines)
-        print(s)
-        return {"total_params": total}
+        from .model_stat import summary as _summary
+
+        return _summary(self.network, input_size=input_size, dtypes=dtype)
 
 
 def _as_list(x):

@@ -1,0 +1,174 @@
+"""Model statistics: parameter counts and FLOPs.
+
+Role parity: reference python/paddle/hapi (paddle.summary / paddle.flops
+backed by fluid/contrib/model_stat.py).  TPU-native: stats come from a
+static Program walk — the same op stream XLA compiles — so the numbers
+cover exactly what runs, including fused attention and backward ops when
+a whole train program is passed.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+
+def _prod(xs):
+    out = 1
+    for x in xs:
+        # dynamic (-1) dims count as 1: a static-graph program with a
+        # symbolic batch reports per-sample FLOPs (traced programs have
+        # concrete batch dims, so paddle.flops(net, input_size) is exact)
+        out *= max(int(x), 1)
+    return out
+
+
+def _shape_of(block, name):
+    v = block._find_var_recursive(name)
+    return list(v.shape) if v is not None and v.shape else []
+
+
+def _conv_flops(block, op):
+    out = _shape_of(block, op.output("Output")[0])
+    w = _shape_of(block, op.input("Filter")[0])
+    if len(out) < 3 or not w:
+        return 0
+    if op.type == "conv2d_transpose":
+        # filter is (Cin, Cout/groups, kh, kw): each INPUT element
+        # scatters into Cout/g*kh*kw outputs — MACs = in_elems*prod(w[1:])
+        inp = _shape_of(block, op.input("Input")[0])
+        return 2 * _prod(inp) * _prod(w[1:])
+    # forward conv filter is (Cout, Cin/groups, kh, kw): w[1:] is the
+    # per-output fan-in.  MACs = out_elems * prod(w[1:]); FLOPs = 2*MACs
+    return 2 * _prod(out) * _prod(w[1:])
+
+
+def _matmul_flops(block, op):
+    x = _shape_of(block, op.input("X")[0])
+    y = _shape_of(block, op.input("Y")[0])
+    out_slot = "Out"
+    out = _shape_of(block, op.output(out_slot)[0])
+    if not x or not y:
+        return 0
+    k = x[-1] if not bool(op.attr("transpose_X",
+                                  op.attr("trans_x", False))) else x[-2]
+    return 2 * _prod(out) * int(k) if out else 0
+
+
+_ELEMENTWISE = {
+    "elementwise_add", "elementwise_sub", "elementwise_mul",
+    "elementwise_div", "elementwise_max", "elementwise_min", "relu",
+    "sigmoid", "tanh", "gelu", "scale", "softmax", "cast", "clip",
+}
+
+
+def program_flops(program, detail=False):
+    """FLOPs of one execution of ``program``'s global block.
+
+    Matmuls/convs count 2*MACs (the MXU work); elementwise ops count one
+    FLOP per output element (VPU work); everything else is free (layout,
+    control, IO).  Returns total FLOPs, plus a per-op-type breakdown
+    when ``detail=True``."""
+    block = program.global_block
+    per_type: Dict[str, int] = {}
+    for op in block.ops:
+        if op.type in ("conv2d", "depthwise_conv2d", "conv2d_transpose"):
+            f = _conv_flops(block, op)
+        elif op.type in ("matmul", "matmul_v2", "mul"):
+            f = _matmul_flops(block, op)
+        elif op.type in _ELEMENTWISE:
+            outs = op.output_arg_names()
+            f = _prod(_shape_of(block, outs[0])) if outs else 0
+        else:
+            f = 0
+        if f:
+            per_type[op.type] = per_type.get(op.type, 0) + f
+    total = sum(per_type.values())
+    if detail:
+        return total, dict(sorted(per_type.items(),
+                                  key=lambda kv: -kv[1]))
+    return total
+
+
+_DTYPE_BYTES = {"float32": 4, "float64": 4, "int32": 4, "int64": 4,
+                "float16": 2, "bfloat16": 2, "uint8": 1, "int8": 1,
+                "bool": 1}
+
+
+def memory_usage(program, batch_size=1) -> Dict[str, float]:
+    """Rough per-device memory estimate for one execution (reference
+    fluid/contrib/memory_usage_calc.py role).  Under XLA the true peak
+    depends on fusion/liveness, so this is the same upper-bound the
+    reference computes: sum of var sizes, split into parameters vs
+    activations, with -1 batch dims filled by ``batch_size``."""
+    params = acts = 0
+    for var in program.global_block.vars.values():
+        shape = list(var.shape or [])
+        if not shape:
+            continue
+        n = 1
+        for s in shape:
+            n *= batch_size if int(s) in (-1, 0) else int(s)
+        dt = getattr(var, "dtype_str", None) or str(var.dtype)
+        nbytes = n * _DTYPE_BYTES.get(str(dt), 4)
+        if getattr(var, "persistable", False):
+            params += nbytes
+        else:
+            acts += nbytes
+    return {"parameter_mb": round(params / 2**20, 3),
+            "activation_mb": round(acts / 2**20, 3),
+            "total_mb": round((params + acts) / 2**20, 3)}
+
+
+def flops(net, input_size=None, dtype="float32", print_detail=False):
+    """Reference paddle.flops: FLOPs of one forward pass.
+
+    ``net`` is an nn.Layer (traced into a program at ``input_size``,
+    which includes the batch dim) or an already-built static Program.
+    """
+    from ..framework.program import Program
+
+    if isinstance(net, Program):
+        prog = net
+    else:
+        if input_size is None:
+            raise ValueError("flops(net, input_size=...) needs the input "
+                             "shape (batch dim included)")
+        from ..dygraph import base as dy_base
+        from ..dygraph import jit as djit
+        from ..dygraph.tensor import Tensor
+
+        x = Tensor(np.zeros(tuple(input_size), dtype))
+        with dy_base.guard():
+            _, tl = djit.TracedLayer.trace(
+                net.forward if hasattr(net, "forward") else net, [x])
+        prog = tl.program
+    total, per_type = program_flops(prog, detail=True)
+    if print_detail:
+        print(f"Total FLOPs: {total:,}")
+        for t, f in per_type.items():
+            print(f"  {t:24s} {f:,}")
+    return total
+
+
+def summary(net, input_size=None, dtypes=None):
+    """Reference paddle.summary: parameter table + totals for a Layer.
+    ``dtypes`` sets the traced input dtype for the FLOPs pass (e.g.
+    'int64' for embedding inputs)."""
+    lines = [f"Model: {type(net).__name__}"]
+    total = trainable = 0
+    for name, p in net.named_parameters():
+        n = _prod(p.shape)
+        total += n
+        if getattr(p, "trainable", True):
+            trainable += n
+        lines.append(f"  {name:40s} {str(list(p.shape)):20s} {n}")
+    lines.append(f"Total params: {total}")
+    lines.append(f"Trainable params: {trainable}")
+    print("\n".join(lines))
+    out = {"total_params": total, "trainable_params": trainable}
+    if input_size is not None:
+        dt = dtypes if isinstance(dtypes, str) else \
+            (dtypes[0] if dtypes else "float32")
+        out["flops"] = flops(net, input_size, dtype=dt)
+    return out

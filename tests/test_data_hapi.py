@@ -275,3 +275,29 @@ class TestStaticModel:
 
     def _fake(self, n=64):
         return FakeData(num_samples=n, image_shape=(1, 4, 4), num_classes=4)
+
+
+class TestModelStat:
+    """paddle.flops / paddle.summary / memory_usage (reference hapi +
+    fluid/contrib/model_stat.py, memory_usage_calc.py)."""
+
+    def test_summary_and_flops(self):
+        net = nn.Sequential(nn.Linear(16, 32), nn.ReLU(),
+                            nn.Linear(32, 4))
+        stats = paddle.summary(net, input_size=(2, 16))
+        assert stats["total_params"] == 16 * 32 + 32 + 32 * 4 + 4
+        # exact: 2 matmuls (2*MACs) + bias adds + relu, batch 2
+        expect = 2*2*16*32 + 2*2*32*4 + 2*32 + 2*4 + 2*32
+        assert stats["flops"] == expect, stats["flops"]
+
+    def test_program_memory_usage(self):
+        from paddle_tpu.framework.program import Program, program_guard
+        from paddle_tpu.hapi.model_stat import memory_usage
+
+        main, startup = Program(), Program()
+        with program_guard(main, startup):
+            x = paddle.fluid.layers.data("img", [16])
+            h = nn.functional.relu(x)
+        m = memory_usage(main, batch_size=64)
+        assert m["total_mb"] > 0
+        assert m["activation_mb"] >= 64 * 16 * 4 / 2**20
