@@ -492,3 +492,46 @@ def test_static_mode_variable_dispatch():
     o2 = exe.run(main, feed={"x": -np.ones((1, 3), "f4")}, fetch_list=[out])
     np.testing.assert_allclose(np.asarray(o1[0]), np.full((1, 3), 2.0))
     np.testing.assert_allclose(np.asarray(o2[0]), np.full((1, 3), -2.0))
+
+
+def test_return_inside_nested_loop():
+    """Return from a while nested in a for: the inner break folds into
+    the inner loop condition, the fired-flag guard breaks the outer."""
+    def f(x):
+        for i in range(3):
+            while x.sum() < 50.0:
+                x = x * 2.0
+                if x.mean() > 8.0:
+                    return x + 100.0
+            x = x + 1.0
+        return x
+
+    with dygraph.guard():
+        ins = [np.full((4,), v, "f4") for v in (1.0, 30.0, 60.0)]
+        eager = [np.asarray(f(dygraph.to_variable(v))._value) for v in ins]
+        _, tl = djit.TracedLayer.trace(f, [dygraph.to_variable(ins[0])])
+        for v, e in zip(ins, eager):
+            np.testing.assert_allclose(
+                np.asarray(tl(dygraph.to_variable(v))[0]._value), e,
+                rtol=1e-5)
+
+
+def test_return_in_both_arms_inside_loop():
+    def f(x):
+        for i in range(4):
+            x = x + 1.0
+            if x.mean() > 3.0:
+                if x.sum() > 20.0:
+                    return x * 10.0
+                else:
+                    return x * -1.0
+        return x
+
+    with dygraph.guard():
+        ins = [np.full((4,), v, "f4") for v in (0.0, 3.0, 9.0)]
+        eager = [np.asarray(f(dygraph.to_variable(v))._value) for v in ins]
+        _, tl = djit.TracedLayer.trace(f, [dygraph.to_variable(ins[0])])
+        for v, e in zip(ins, eager):
+            np.testing.assert_allclose(
+                np.asarray(tl(dygraph.to_variable(v))[0]._value), e,
+                rtol=1e-5)
