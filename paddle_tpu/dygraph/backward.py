@@ -37,6 +37,7 @@ def _node_backward_recorded(node, fwd_float, grads):
     for i in node.float_out_idx:
         t = node.out_tensors[i]
         g = grads.get(id(t))
+        had_grad = g is not None
         if g is None:
             g = Tensor(jnp.zeros_like(t._value), stop_gradient=True)
         elif not isinstance(g, Tensor):
@@ -47,6 +48,14 @@ def _node_backward_recorded(node, fwd_float, grads):
             # (same coercion the non-recorded path applies)
             g = eager.apply_jax(
                 lambda v, dt=t._value.dtype: v.astype(dt), g)
+        if had_grad and t.__dict__.get("_grad_hooks"):
+            # hooks fire on the recorded path too (but never on a
+            # fabricated zero grad); the hooked value becomes BOTH the
+            # cotangent and this tensor's reported gradient
+            g = Tensor(jnp.asarray(t._apply_grad_hooks(g._value),
+                                   dtype=t._value.dtype),
+                       stop_gradient=True)
+            grads[id(t)] = g
         cot_tensors.append(g)
 
     n_in = len(node.in_tensors)
@@ -145,8 +154,19 @@ def run_backward(roots: List[Tensor], seeds: Optional[List] = None,
             for i in node.float_out_idx:
                 t = node.out_tensors[i]
                 g = grads.get(id(t))
-                cots.append(jnp.zeros_like(t._value) if g is None else
-                            jnp.asarray(g, dtype=t._value.dtype))
+                if g is None:
+                    cots.append(jnp.zeros_like(t._value))
+                    continue
+                g = jnp.asarray(g, dtype=t._value.dtype)
+                if t.__dict__.get("_grad_hooks"):
+                    # reference VarBase hooks: fire when this tensor's
+                    # gradient is computed (never on a fabricated zero);
+                    # the hooked value is BOTH the upstream cotangent and
+                    # this tensor's reported gradient
+                    g = jnp.asarray(t._apply_grad_hooks(g),
+                                    dtype=t._value.dtype)
+                    grads[id(t)] = g
+                cots.append(g)
 
             primals = [t._value for t in node.in_tensors]
             _, vjp_fn = jax.vjp(fwd_float, *primals)
@@ -173,6 +193,15 @@ def run_backward(roots: List[Tensor], seeds: Optional[List] = None,
     if executed != len(nodes):
         # disconnected remainder (e.g. some root unreachable); still correct
         pass
+
+    # leaf hooks fire once the leaf's gradient is final — through EVERY
+    # engine entry (backward() and paddle.grad alike), and the hooked
+    # value is what the grads dict reports
+    for tid, t in keep.items():
+        if t.grad_node is None and t.__dict__.get("_grad_hooks"):
+            g = grads.get(tid)
+            if g is not None:
+                grads[tid] = t._apply_grad_hooks(g)
 
     if accumulate_leaf:
         for tid, t in keep.items():

@@ -209,6 +209,36 @@ class Tensor:
             raise TypeError("iteration over a 0-d tensor")
         return (self[i] for i in range(int(self._value.shape[0])))
 
+    def register_hook(self, hook):
+        """Gradient hook (reference imperative/hooks.h VarBase hooks):
+        called with this tensor's gradient when backward computes it; a
+        returned tensor/array REPLACES the gradient.  Returns a handle
+        whose ``remove()`` detaches the hook."""
+        if self.stop_gradient:
+            raise RuntimeError(
+                "cannot register a gradient hook on a tensor with "
+                "stop_gradient=True")
+        hooks = self.__dict__.setdefault("_grad_hooks", [])
+        hooks.append(hook)
+
+        class _Handle:
+            def remove(_self):
+                if hook in hooks:
+                    hooks.remove(hook)
+
+        return _Handle()
+
+    def _apply_grad_hooks(self, g):
+        """Run registered hooks over raw grad value ``g`` (jax array).
+        Iterates a snapshot so a one-shot hook removing itself cannot
+        skip its neighbor."""
+        for h in tuple(self.__dict__.get("_grad_hooks", ())):
+            out = h(Tensor(g))
+            if out is not None:
+                g = out._value if isinstance(out, Tensor) else \
+                    jnp.asarray(out)
+        return g
+
     # -- common methods -----------------------------------------------------
     def astype(self, dtype):
         from .eager import apply_jax

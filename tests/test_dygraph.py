@@ -214,3 +214,100 @@ class TestDropoutRNG:
         g = x.grad.numpy()
         o = out.numpy()
         np.testing.assert_allclose((g > 0), (o > 0))
+
+
+class TestGradHooks:
+    """Tensor.register_hook (reference imperative/hooks.h): fires when
+    the grad is computed, may replace it, removable."""
+
+    def test_hook_scales_leaf_grad(self):
+        from paddle_tpu import dygraph
+
+        with dygraph.guard():
+            x = dygraph.to_variable(np.array([1.0, 2.0], "f4"))
+            x.stop_gradient = False
+            x.register_hook(lambda g: g * 2.0)
+            (x * 3.0).sum().backward()
+            np.testing.assert_allclose(np.asarray(x.grad._value),
+                                       [6.0, 6.0])
+
+    def test_hook_on_intermediate_and_remove(self):
+        from paddle_tpu import dygraph
+
+        with dygraph.guard():
+            x = dygraph.to_variable(np.array([1.0, 2.0], "f4"))
+            x.stop_gradient = False
+            h = x * 2.0          # intermediate
+            seen = []
+            handle = h.register_hook(lambda g: seen.append(1) or g * 10.0)
+            (h * 1.0).sum().backward()
+            assert seen == [1]
+            np.testing.assert_allclose(np.asarray(x.grad._value),
+                                       [20.0, 20.0])  # 2 * 10
+
+            x2 = dygraph.to_variable(np.array([1.0], "f4"))
+            x2.stop_gradient = False
+            h2 = x2 * 2.0
+            handle2 = h2.register_hook(lambda g: g * 10.0)
+            handle2.remove()
+            (h2 * 1.0).sum().backward()
+            np.testing.assert_allclose(np.asarray(x2.grad._value), [2.0])
+
+    def test_hook_on_stopped_tensor_is_loud(self):
+        from paddle_tpu import dygraph
+
+        with dygraph.guard():
+            x = dygraph.to_variable(np.array([1.0], "f4"))  # stop_gradient
+            with pytest.raises(RuntimeError, match="stop_gradient"):
+                x.register_hook(lambda g: g)
+
+    def test_hooks_fire_through_paddle_grad(self):
+        from paddle_tpu import dygraph
+        from paddle_tpu.dygraph import grad as pgrad
+
+        with dygraph.guard():
+            x = dygraph.to_variable(np.array([1.0, 2.0], "f4"))
+            x.stop_gradient = False
+            x.register_hook(lambda g: g * 2.0)
+            h = x * 2.0
+            h.register_hook(lambda g: g * 10.0)
+            out = (h * 1.0).sum()
+            gs = pgrad([out], [h, x])
+            # h's reported grad is its HOOKED value; x's grad saw the
+            # hooked cotangent AND its own leaf hook: 2*10*2 = 40
+            np.testing.assert_allclose(np.asarray(gs[0]._value),
+                                       [10.0, 10.0])
+            np.testing.assert_allclose(np.asarray(gs[1]._value),
+                                       [40.0, 40.0])
+
+    def test_hooks_fire_under_create_graph(self):
+        from paddle_tpu import dygraph
+        from paddle_tpu.dygraph import grad as pgrad
+
+        with dygraph.guard():
+            x = dygraph.to_variable(np.array([3.0], "f4"))
+            x.stop_gradient = False
+            h = x * x
+            h.register_hook(lambda g: g * 10.0)
+            out = (h * 1.0).sum()
+            (gx,) = pgrad([out], [x], create_graph=True)
+            np.testing.assert_allclose(np.asarray(gx._value), [60.0])
+
+    def test_one_shot_hook_does_not_skip_neighbor(self):
+        from paddle_tpu import dygraph
+
+        with dygraph.guard():
+            x = dygraph.to_variable(np.array([1.0], "f4"))
+            x.stop_gradient = False
+            calls = []
+            handle_box = []
+
+            def one_shot(g):
+                calls.append("a")
+                handle_box[0].remove()
+                return g
+
+            handle_box.append(x.register_hook(one_shot))
+            x.register_hook(lambda g: calls.append("b") or g)
+            (x * 1.0).sum().backward()
+            assert calls == ["a", "b"], calls
