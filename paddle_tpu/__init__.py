@@ -64,6 +64,7 @@ from .framework import (  # noqa: F401
     set_device,
 )
 
+from . import autograd  # noqa: F401
 from . import distribution  # noqa: F401
 from . import inference  # noqa: F401
 from . import jit  # noqa: F401

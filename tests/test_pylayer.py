@@ -1,0 +1,142 @@
+"""paddle.autograd.PyLayer (reference python/paddle/autograd/
+py_layer.py): custom forward/backward, ctx state, composition with the
+tape, hooks, and paddle.grad."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import dygraph
+from paddle_tpu.autograd import PyLayer
+
+
+class Exp(PyLayer):
+    @staticmethod
+    def forward(ctx, x):
+        from paddle_tpu import tensor as T
+
+        y = T.exp(x)
+        ctx.save_for_backward(y)
+        return y
+
+    @staticmethod
+    def backward(ctx, dy):
+        (y,) = ctx.saved_tensor()
+        return dy * y
+
+
+class ScaleByAttr(PyLayer):
+    @staticmethod
+    def forward(ctx, x, k):  # k is a plain python float
+        ctx.k = k
+        return x * k
+
+    @staticmethod
+    def backward(ctx, dy):
+        return dy * ctx.k
+
+
+class TwoInTwoOut(PyLayer):
+    @staticmethod
+    def forward(ctx, a, b):
+        return a + b, a * b
+
+    @staticmethod
+    def backward(ctx, da, db):
+        # d(a+b)/da=1, d(ab)/da=b — but backward sees only cotangents;
+        # use a deliberately custom rule to prove IT is what runs
+        return da * 2.0, db * 3.0
+
+
+def test_exp_forward_backward_matches_analytic():
+    with dygraph.guard():
+        x = dygraph.to_variable(np.array([0.0, 1.0], "f4"))
+        x.stop_gradient = False
+        y = Exp.apply(x)
+        np.testing.assert_allclose(np.asarray(y._value),
+                                   np.exp([0.0, 1.0]), rtol=1e-6)
+        y.sum().backward()
+        np.testing.assert_allclose(np.asarray(x.grad._value),
+                                   np.exp([0.0, 1.0]), rtol=1e-6)
+
+
+def test_nontensor_arg_and_ctx_attr():
+    with dygraph.guard():
+        x = dygraph.to_variable(np.array([2.0], "f4"))
+        x.stop_gradient = False
+        y = ScaleByAttr.apply(x, 5.0)
+        np.testing.assert_allclose(np.asarray(y._value), [10.0])
+        y.sum().backward()
+        np.testing.assert_allclose(np.asarray(x.grad._value), [5.0])
+
+
+def test_custom_backward_rule_is_used():
+    with dygraph.guard():
+        a = dygraph.to_variable(np.array([1.0], "f4"))
+        b = dygraph.to_variable(np.array([4.0], "f4"))
+        a.stop_gradient = False
+        b.stop_gradient = False
+        s, p = TwoInTwoOut.apply(a, b)
+        (s * 1.0 + p * 1.0).sum().backward()
+        # custom rule: da = cot_s*2 = 2, db = cot_p*3 = 3
+        np.testing.assert_allclose(np.asarray(a.grad._value), [2.0])
+        np.testing.assert_allclose(np.asarray(b.grad._value), [3.0])
+
+
+def test_composes_with_surrounding_tape_and_grad_api():
+    with dygraph.guard():
+        x = dygraph.to_variable(np.array([1.0], "f4"))
+        x.stop_gradient = False
+        h = x * 3.0
+        y = Exp.apply(h) * 2.0
+        (gx,) = dygraph.grad([y.sum()], [x])
+        np.testing.assert_allclose(np.asarray(gx._value),
+                                   [2.0 * 3.0 * np.exp(3.0)], rtol=1e-5)
+
+
+def test_autograd_backward_with_explicit_cotangent():
+    from paddle_tpu.autograd import backward
+
+    with dygraph.guard():
+        x = dygraph.to_variable(np.array([1.0, 2.0], "f4"))
+        x.stop_gradient = False
+        y = x * x
+        backward([y], grad_tensors=[dygraph.to_variable(
+            np.array([1.0, 10.0], "f4"))])
+        np.testing.assert_allclose(np.asarray(x.grad._value),
+                                   [2.0, 40.0])
+
+
+class GatherRows(PyLayer):
+    """Integer index input: its cotangent slot must be float0, and the
+    user backward returns None for it."""
+
+    @staticmethod
+    def forward(ctx, x, idx):
+        ctx.save_for_backward(idx)
+        from paddle_tpu import tensor as T
+
+        return T.gather(x, idx)
+
+    @staticmethod
+    def backward(ctx, dy):
+        (idx,) = ctx.saved_tensor()
+        from paddle_tpu import tensor as T
+        import paddle_tpu as pt
+
+        z = pt.to_tensor(np.zeros((4, 2), "f4"))
+        return T.scatter(z, idx, dy), None
+
+
+def test_integer_tensor_input():
+    with dygraph.guard():
+        x = dygraph.to_variable(np.arange(8, dtype="f4").reshape(4, 2))
+        x.stop_gradient = False
+        idx = dygraph.to_variable(np.array([2, 0], "i4"))
+        y = GatherRows.apply(x, idx)
+        np.testing.assert_allclose(np.asarray(y._value),
+                                   [[4.0, 5.0], [0.0, 1.0]])
+        y.sum().backward()
+        expect = np.zeros((4, 2), "f4")
+        expect[2] = 1.0
+        expect[0] = 1.0
+        np.testing.assert_allclose(np.asarray(x.grad._value), expect)

@@ -42,6 +42,9 @@ MODULES = [
     "paddle_tpu.profiler",
     "paddle_tpu.text",
     "paddle_tpu.text.decode",
+    "paddle_tpu.autograd",
+    "paddle_tpu.slim",
+    "paddle_tpu.monitor",
 ]
 
 
