@@ -66,6 +66,8 @@ from .framework import (  # noqa: F401
 
 from . import autograd  # noqa: F401
 from . import distribution  # noqa: F401
+from . import utils  # noqa: F401
+from . import version  # noqa: F401
 from . import inference  # noqa: F401
 from . import jit  # noqa: F401
 from . import monitor  # noqa: F401
@@ -76,4 +78,4 @@ from . import text  # noqa: F401
 from .serialization import load, save  # noqa: F401
 from .framework.flags import get_flags, set_flags  # noqa: F401
 
-__version__ = "0.3.0"  # rounds track the continuous build
+__version__ = version.full_version  # single source: version.py

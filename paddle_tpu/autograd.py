@@ -16,6 +16,8 @@ from typing import Any, List
 import jax
 import jax.numpy as jnp
 
+__all__ = ["PyLayer", "PyLayerContext", "backward"]
+
 
 class PyLayerContext:
     """Reference py_layer.py PyLayerContext: carries state from forward
@@ -69,6 +71,13 @@ class PyLayer:
         if not tensor_pos:
             raise ValueError(
                 f"{cls.__name__}.apply needs at least one Tensor input")
+        kw_tensors = [k for k, v in kwargs.items()
+                      if isinstance(v, Tensor)]
+        if kw_tensors:
+            raise ValueError(
+                f"{cls.__name__}.apply: Tensor arguments must be "
+                f"positional (keyword tensor(s) {kw_tensors} would be "
+                f"silently treated as non-differentiable constants)")
         const_args = {i: a for i, a in enumerate(args)
                       if not isinstance(a, Tensor)}
         n_args = len(args)
@@ -173,4 +182,9 @@ def backward(tensors, grad_tensors=None, retain_graph=False):
     if grad_tensors is not None:
         seeds = list(grad_tensors) if isinstance(
             grad_tensors, (list, tuple)) else [grad_tensors]
+        if len(seeds) != len(tensors):
+            raise ValueError(
+                f"backward: grad_tensors has {len(seeds)} entries for "
+                f"{len(tensors)} tensors (a shorter list would silently "
+                f"zero the cotangents of the extra tensors)")
     run_backward(tensors, seeds=seeds, retain_graph=retain_graph)

@@ -14,6 +14,9 @@ from __future__ import annotations
 import threading
 from typing import Dict, List, Tuple
 
+__all__ = ["StatRegistry", "stat_add", "stat_get", "stat_reset",
+           "export_stats"]
+
 
 class _Stat:
     __slots__ = ("name", "_value", "_lock")

@@ -1,0 +1,71 @@
+"""`paddle.utils` parity (reference python/paddle/utils/): small
+developer helpers — unique_name, deprecated decorator, try_import,
+and the download entry (which raises here: the TPU build runs in
+zero-egress environments; point datasets at local files instead)."""
+from __future__ import annotations
+
+import functools
+import importlib
+import warnings
+
+from ..framework import unique_name  # noqa: F401
+
+
+def deprecated(update_to: str = "", since: str = "", reason: str = ""):
+    """Reference utils/deprecated.py: warn once per call site."""
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            msg = f"API {fn.__module__}.{fn.__name__} is deprecated"
+            if since:
+                msg += f" since {since}"
+            if update_to:
+                msg += f"; use {update_to} instead"
+            if reason:
+                msg += f" ({reason})"
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+
+        return wrapper
+
+    return deco
+
+
+def try_import(module_name: str, err_msg: str = None):
+    """Reference utils/lazy_import.py try_import."""
+    try:
+        return importlib.import_module(module_name)
+    except ImportError as e:
+        raise ImportError(
+            err_msg or f"required optional module {module_name!r} is not "
+                       f"installed") from e
+
+
+def run_check():
+    """Reference paddle.utils.run_check: verify the install can run a
+    small program on the available device."""
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    from paddle_tpu.framework.program import Program, program_guard
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = layers.data("x", [4])
+        y = layers.fc(x, 2)
+    exe = pt.Executor(pt.framework.place._default_place())
+    scope = pt.framework.Scope()
+    exe.run(startup, scope=scope)
+    out = exe.run(main, feed={"x": np.ones((2, 4), "float32")},
+                  fetch_list=[y], scope=scope)
+    assert np.asarray(out[0]).shape == (2, 2)
+    print("paddle_tpu is installed successfully!")
+
+
+def download(url, module_name=None, save_name=None, **kw):
+    raise RuntimeError(
+        "paddle_tpu.utils.download is unavailable: this build targets "
+        "zero-egress TPU environments; place the file locally and point "
+        "the dataset at it")

@@ -1,0 +1,19 @@
+"""`paddle.version` parity (reference python/paddle/version.py, build-time
+generated there; static here)."""
+full_version = "0.3.0"
+major = "0"
+minor = "3"
+patch = "0"
+rc = "0"
+istaged = True
+commit = "tpu-native"
+with_mkl = "OFF"  # XLA is the single backend
+
+
+def show():
+    print(f"full_version: {full_version}")
+    print(f"commit: {commit}")
+
+
+def mkl():
+    return with_mkl

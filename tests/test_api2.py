@@ -316,3 +316,45 @@ class TestOptimizer2:
             opt.clear_grad()
             dy_losses.append(float(l.numpy()))
         np.testing.assert_allclose(static_losses, dy_losses, rtol=1e-4, atol=1e-6)
+
+
+class TestUtilsVersion:
+    """paddle.utils / paddle.version parity (reference python/paddle/
+    utils/, version.py)."""
+
+    def test_run_check(self, capsys):
+        import paddle_tpu as pt
+
+        pt.utils.run_check()
+        assert "successfully" in capsys.readouterr().out
+
+    def test_deprecated_warns_and_forwards(self):
+        import warnings
+
+        import paddle_tpu as pt
+
+        @pt.utils.deprecated(update_to="new_fn", since="2.0")
+        def old_fn(a):
+            return a + 1
+
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            assert old_fn(1) == 2
+            assert any("deprecated" in str(x.message) for x in w)
+
+    def test_try_import_and_download_guard(self):
+        import pytest
+
+        import paddle_tpu as pt
+
+        assert pt.utils.try_import("math").sqrt(4) == 2.0
+        with pytest.raises(ImportError):
+            pt.utils.try_import("definitely_not_a_module_xyz")
+        with pytest.raises(RuntimeError, match="zero-egress"):
+            pt.utils.download("http://example.com/x")
+
+    def test_version(self):
+        import paddle_tpu as pt
+
+        assert pt.version.full_version == pt.__version__
+        assert pt.version.mkl() == "OFF"

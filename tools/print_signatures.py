@@ -45,6 +45,8 @@ MODULES = [
     "paddle_tpu.autograd",
     "paddle_tpu.slim",
     "paddle_tpu.monitor",
+    "paddle_tpu.utils",
+    "paddle_tpu.version",
 ]
 
 
