@@ -1,0 +1,48 @@
+#!/usr/bin/env bash
+# Run the moment the axon TPU pool recovers: captures every artifact the
+# round needs from the real chip, in priority order, each step logged.
+# Usage: bash artifacts/on_chip_recovery.sh
+set -u
+cd "$(dirname "$0")/.."
+LOG=artifacts/recovery_$(date +%H%M%S)
+mkdir -p "$LOG"
+
+echo "== 1. preflight =="
+timeout 120 python -c "import jax; print(jax.devices())" \
+    > "$LOG/preflight.log" 2>&1 || { echo "chip still down"; exit 1; }
+cat "$LOG/preflight.log"
+
+echo "== 2. flagship bench (ResNet-50 + BERT + pipeline) =="
+timeout 1800 python bench.py | tee "$LOG/bench.json"
+
+echo "== 3. flash attention A/B =="
+timeout 1800 python artifacts/flash_ab.py | tee "$LOG/flash_ab.log"
+
+echo "== 4. ResNet profile capture =="
+timeout 900 python - <<'EOF' 2>&1 | tee "$LOG/profile.log"
+import numpy as np, jax
+import paddle_tpu as pt
+from paddle_tpu.amp.static_amp import decorate
+from paddle_tpu.framework.place import _default_place
+from paddle_tpu.framework.program import program_guard
+from paddle_tpu.vision.static_models import resnet50_train_program
+
+main_p, startup, _, loss, opt = resnet50_train_program(lr=0.1, momentum=0.9)
+main_p.random_seed = 1
+with program_guard(main_p, startup):
+    decorate(opt, use_bf16=True).minimize(loss)
+exe = pt.Executor(_default_place())
+scope = pt.framework.Scope()
+exe.run(startup, scope=scope)
+rng = np.random.RandomState(0)
+feed = {"image": jax.device_put(rng.randn(128,3,224,224).astype("float32")),
+        "label": jax.device_put(rng.randint(0,1000,(128,1)).astype("int32"))}
+out = exe.run_steps(main_p, feed=feed, fetch_list=[loss], scope=scope, steps=10)
+np.asarray(out[0])  # compile
+with jax.profiler.trace("artifacts/resnet50_profile_r5"):
+    out = exe.run_steps(main_p, feed=feed, fetch_list=[loss], scope=scope, steps=10)
+    np.asarray(out[0])
+print("profile captured to artifacts/resnet50_profile_r5")
+EOF
+
+echo "== done; artifacts in $LOG =="

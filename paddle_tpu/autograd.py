@@ -74,10 +74,15 @@ class PyLayer:
         kw_tensors = [k for k, v in kwargs.items()
                       if isinstance(v, Tensor)]
         if kw_tensors:
-            raise ValueError(
-                f"{cls.__name__}.apply: Tensor arguments must be "
-                f"positional (keyword tensor(s) {kw_tensors} would be "
-                f"silently treated as non-differentiable constants)")
+            # reference PyLayer semantics: keyword tensors are legal but
+            # NON-DIFFERENTIABLE — say so loudly instead of silently
+            import warnings
+
+            warnings.warn(
+                f"{cls.__name__}.apply: keyword tensor(s) {kw_tensors} "
+                f"are treated as non-differentiable constants (pass "
+                f"positionally to get gradients)", RuntimeWarning,
+                stacklevel=2)
         const_args = {i: a for i, a in enumerate(args)
                       if not isinstance(a, Tensor)}
         n_args = len(args)

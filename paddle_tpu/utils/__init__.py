@@ -12,19 +12,32 @@ from ..framework import unique_name  # noqa: F401
 
 
 def deprecated(update_to: str = "", since: str = "", reason: str = ""):
-    """Reference utils/deprecated.py: warn once per call site."""
+    """Reference utils/deprecated.py: warn once per call site, and make
+    the warning VISIBLE (DeprecationWarning is filtered by default
+    outside __main__ since py3.7 — the reference forces visibility for
+    the same reason)."""
 
     def deco(fn):
+        warned_sites = set()
+
         @functools.wraps(fn)
         def wrapper(*args, **kwargs):
-            msg = f"API {fn.__module__}.{fn.__name__} is deprecated"
-            if since:
-                msg += f" since {since}"
-            if update_to:
-                msg += f"; use {update_to} instead"
-            if reason:
-                msg += f" ({reason})"
-            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            import sys
+
+            frame = sys._getframe(1)
+            site = (frame.f_code.co_filename, frame.f_lineno)
+            if site not in warned_sites:
+                warned_sites.add(site)
+                msg = f"API {fn.__module__}.{fn.__name__} is deprecated"
+                if since:
+                    msg += f" since {since}"
+                if update_to:
+                    msg += f"; use {update_to} instead"
+                if reason:
+                    msg += f" ({reason})"
+                with warnings.catch_warnings():
+                    warnings.simplefilter("always", DeprecationWarning)
+                    warnings.warn(msg, DeprecationWarning, stacklevel=2)
             return fn(*args, **kwargs)
 
         return wrapper
@@ -60,7 +73,10 @@ def run_check():
     exe.run(startup, scope=scope)
     out = exe.run(main, feed={"x": np.ones((2, 4), "float32")},
                   fetch_list=[y], scope=scope)
-    assert np.asarray(out[0]).shape == (2, 2)
+    if np.asarray(out[0]).shape != (2, 2):
+        raise RuntimeError(  # explicit: survives python -O
+            f"run_check produced shape {np.asarray(out[0]).shape}, "
+            f"expected (2, 2) — the install is broken")
     print("paddle_tpu is installed successfully!")
 
 
