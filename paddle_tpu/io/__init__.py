@@ -449,32 +449,51 @@ class DataLoader:
                 for p in procs:
                     p.start()
                 started = True
-            except (pickle.PicklingError, TypeError, AttributeError) as e:
-                # spawn pickles (dataset, collate_fn, worker_init_fn)
-                # by value; closures / local classes don't pickle —
-                # degrade to the thread pool rather than erroring the
-                # epoch.  Loudly: threads are GIL-bound and skip
-                # worker_init_fn / get_worker_info semantics.
+            except BaseException as e:
+                for p in procs:  # reap whatever partially started
+                    if p.is_alive():
+                        p.terminate()
                 import warnings
 
-                warnings.warn(
-                    f"DataLoader: dataset/collate_fn/worker_init_fn "
-                    f"not picklable for spawned workers ({e!r}); "
-                    f"falling back to a thread pool (GIL-bound, no "
-                    f"worker_init_fn / get_worker_info). Move the "
-                    f"dataset class to module scope for real worker "
-                    f"processes.", RuntimeWarning, stacklevel=3)
-                for p in procs:
-                    if p.is_alive():
-                        p.terminate()
-            except BaseException:
-                # non-pickling failures (resource limits, …) are real
-                # errors: reap and propagate rather than silently
-                # changing the execution model
-                for p in procs:
-                    if p.is_alive():
-                        p.terminate()
-                raise
+                if isinstance(e, (pickle.PicklingError, TypeError,
+                                  AttributeError)):
+                    # spawn pickles (dataset, collate_fn,
+                    # worker_init_fn) by value; closures / local
+                    # classes don't pickle — degrade to the thread pool
+                    # rather than erroring the epoch.  Loudly: threads
+                    # are GIL-bound and skip worker_init_fn /
+                    # get_worker_info semantics.
+                    warnings.warn(
+                        f"DataLoader: dataset/collate_fn/worker_init_fn "
+                        f"not picklable for spawned workers ({e!r}); "
+                        f"falling back to a thread pool (GIL-bound, no "
+                        f"worker_init_fn / get_worker_info). Move the "
+                        f"dataset class to module scope for real "
+                        f"worker processes.", RuntimeWarning,
+                        stacklevel=3)
+                elif isinstance(e, RuntimeError) and \
+                        "bootstrapping" in str(e):
+                    # We are a SPAWNED CHILD re-importing an unguarded
+                    # __main__ (a script that iterates a num_workers>0
+                    # loader at module top level).  Fork tolerated such
+                    # scripts, so keep them working: this child serves
+                    # its copy of the top-level loop on threads.  The
+                    # script's top level re-executes once per worker —
+                    # the inherent python-spawn semantic for unguarded
+                    # scripts; the warning tells the user how to avoid
+                    # it.
+                    warnings.warn(
+                        "DataLoader: this process is a spawned worker "
+                        "re-running an UNGUARDED script top level; "
+                        "serving its loader on threads.  Wrap the "
+                        "script's entry point in `if __name__ == "
+                        "'__main__':` to avoid re-executing top-level "
+                        "code once per worker.", RuntimeWarning,
+                        stacklevel=3)
+                else:
+                    # real errors (resource limits, …): propagate
+                    # rather than silently changing the execution model
+                    raise
             finally:
                 if saved_jp is None:
                     os.environ.pop("JAX_PLATFORMS", None)

@@ -206,3 +206,44 @@ def test_workers_are_spawned_not_forked():
         _FORK_MARKER[0] = 0
     seen = {int(v) for b in batches for v in np.asarray(b).ravel()}
     assert seen == {0}, f"workers saw parent memory (forked): {seen}"
+
+
+def test_loader_module_is_importable_as_main(tmp_path):
+    """A script iterating a num_workers>0 loader at top level WITHOUT an
+    `if __name__ == "__main__"` guard must complete (fork tolerated
+    this; spawn children fall back to threads while importing __main__
+    instead of crashing the bootstrap)."""
+    import subprocess
+    import sys
+    import textwrap
+
+    repo_root = str(__import__("pathlib").Path(__file__).resolve().parents[1])
+    ds_mod = tmp_path / "ds_mod.py"
+    ds_mod.write_text(textwrap.dedent("""
+        import numpy as np
+        from paddle_tpu.io import Dataset
+
+        class Sq(Dataset):
+            def __len__(self): return 12
+            def __getitem__(self, i): return np.array([i], "f4")
+    """))
+    script = tmp_path / "unguarded.py"
+    script.write_text(textwrap.dedent(f"""
+        import sys
+        sys.path.insert(0, {repo_root!r})
+        sys.path.insert(0, {str(tmp_path)!r})
+        import jax; jax.config.update('jax_platforms', 'cpu')
+        from paddle_tpu.io import DataLoader
+        from ds_mod import Sq
+        n = sum(1 for _ in DataLoader(Sq(), batch_size=4, num_workers=2,
+                                      use_buffer_reader=False))
+        assert n == 3, n
+        print("OK", n)
+    """))
+    env = dict(__import__("os").environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run([sys.executable, str(script)], env=env,
+                       capture_output=True, text=True, timeout=240,
+                       cwd=repo_root)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "OK 3" in r.stdout, r.stdout
