@@ -415,6 +415,24 @@ class DataLoader:
             yield from self._thread_batches()
             return
 
+        if getattr(mp.current_process(), "_inheriting", False):
+            # POSITIVE spawn-bootstrap check: we are a spawned child
+            # still importing an UNGUARDED __main__ (a script that
+            # iterates a num_workers>0 loader at module top level).
+            # Fork tolerated such scripts; serve this child's copy of
+            # the top-level loop on threads instead of tripping
+            # python's bootstrap error.
+            import warnings
+
+            warnings.warn(
+                "DataLoader: this process is a spawned worker re-running "
+                "an unguarded script top level; serving its loader on "
+                "threads.  Wrap the script's entry point in `if __name__ "
+                "== '__main__':` to avoid re-executing top-level code "
+                "once per worker.", RuntimeWarning, stacklevel=3)
+            yield from self._thread_batches()
+            return
+
         n_workers = self.num_workers
         task_q = ctx.Queue()
         # one window constant governs BOTH the result-queue capacity and
@@ -470,25 +488,6 @@ class DataLoader:
                         f"worker_init_fn / get_worker_info). Move the "
                         f"dataset class to module scope for real "
                         f"worker processes.", RuntimeWarning,
-                        stacklevel=3)
-                elif isinstance(e, RuntimeError) and \
-                        "bootstrapping" in str(e):
-                    # We are a SPAWNED CHILD re-importing an unguarded
-                    # __main__ (a script that iterates a num_workers>0
-                    # loader at module top level).  Fork tolerated such
-                    # scripts, so keep them working: this child serves
-                    # its copy of the top-level loop on threads.  The
-                    # script's top level re-executes once per worker —
-                    # the inherent python-spawn semantic for unguarded
-                    # scripts; the warning tells the user how to avoid
-                    # it.
-                    warnings.warn(
-                        "DataLoader: this process is a spawned worker "
-                        "re-running an UNGUARDED script top level; "
-                        "serving its loader on threads.  Wrap the "
-                        "script's entry point in `if __name__ == "
-                        "'__main__':` to avoid re-executing top-level "
-                        "code once per worker.", RuntimeWarning,
                         stacklevel=3)
                 else:
                     # real errors (resource limits, …): propagate

@@ -1,6 +1,8 @@
 """`paddle.nn` equivalent (reference python/paddle/nn/__init__.py)."""
 from ..dygraph.layers import Layer  # noqa: F401
 from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+from . import utils  # noqa: F401
 from .clip import (  # noqa: F401
     ClipGradByGlobalNorm,
     ClipGradByNorm,

@@ -1,0 +1,143 @@
+"""`paddle.nn.utils` (reference python/paddle/nn/utils/):
+weight_norm / remove_weight_norm / spectral_norm reparameterizations
+over dygraph Layers, plus parameter<->vector helpers.
+
+TPU-native note: the reparameterized weight is recomputed in the
+forward pre-hook from its factors, so under `to_static`/jit the
+recompute traces into the program and XLA fuses it — same effect as
+the reference's dedicated norm ops with no extra kernels.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["weight_norm", "remove_weight_norm", "spectral_norm",
+           "parameters_to_vector", "vector_to_parameters"]
+
+
+def _norm_except(t, dim):
+    """||t|| over every axis except ``dim`` (keepdims), eager tensors."""
+    import jax.numpy as jnp
+
+    from ...dygraph.eager import apply_jax
+
+    axes = tuple(i for i in range(len(t.shape)) if i != dim)
+    return apply_jax(
+        lambda v: jnp.sqrt(jnp.sum(v * v, axis=axes, keepdims=True)
+                           + 1e-12), t)
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Reference nn/utils/weight_norm_hook.py: w = g * v / ||v||, with
+    g (per-``dim`` magnitude) and v (direction) as the trainable
+    parameters; recomputed on every forward."""
+    from ...dygraph.layers import Parameter
+
+    w = layer._parameters.get(name)
+    if w is None:
+        raise ValueError(f"layer has no parameter {name!r}")
+    if dim is None:
+        dim = -1  # whole-tensor norm convention: g is scalar-shaped
+    g0 = _norm_except(w, dim if dim >= 0 else 0)
+    v = Parameter(w._value, name=w.name + "_v", trainable=True)
+    g = Parameter(g0._value, name=w.name + "_g", trainable=True)
+    del layer._parameters[name]
+    layer._parameters[name + "_v"] = v
+    layer._parameters[name + "_g"] = g
+
+    def compute(lyr):
+        vv = lyr._parameters[name + "_v"]
+        gg = lyr._parameters[name + "_g"]
+        w_new = gg * (vv / _norm_except(vv, dim if dim >= 0 else 0))
+        object.__setattr__(lyr, name, w_new)
+
+    def pre_hook(lyr, inputs):
+        compute(lyr)
+        return None
+
+    handle = layer.register_forward_pre_hook(pre_hook)
+    layer.__dict__.setdefault("_weight_norm_state", {})[name] = (
+        handle, dim, compute)
+    compute(layer)  # usable before the first forward too
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    """Bake the current w back into a plain parameter and drop the
+    reparameterization."""
+    from ...dygraph.layers import Parameter
+
+    state = layer.__dict__.get("_weight_norm_state", {}).pop(name, None)
+    if state is None:
+        raise ValueError(f"{name!r} is not weight-normed on this layer")
+    handle, dim, compute = state
+    compute(layer)  # final value from the factors
+    w_val = getattr(layer, name)._value
+    handle.remove() if hasattr(handle, "remove") else None
+    del layer._parameters[name + "_v"]
+    del layer._parameters[name + "_g"]
+    if name in layer.__dict__:
+        del layer.__dict__[name]
+    layer._parameters[name] = Parameter(w_val, name=name, trainable=True)
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=0):
+    """Reference nn/utils/spectral_norm_hook.py: w / sigma_max(w), with
+    sigma estimated by power iteration on a persistent u buffer."""
+    import jax.numpy as jnp
+
+    from ...dygraph.eager import apply_jax
+    from ...dygraph.tensor import Tensor
+
+    w = layer._parameters.get(name)
+    if w is None:
+        raise ValueError(f"layer has no parameter {name!r}")
+    h = int(w.shape[dim])
+    rs = np.random.RandomState(0)
+    u_state = {"u": Tensor(rs.randn(h).astype("float32"))}
+
+    def pre_hook(lyr, inputs):
+        ww = lyr._parameters[name + "_orig"]
+
+        def sn(wv, uv):
+            mat = jnp.moveaxis(wv, dim, 0).reshape(h, -1)
+            u = uv
+            for _ in range(n_power_iterations):
+                v = mat.T @ u
+                v = v / (jnp.linalg.norm(v) + eps)
+                u = mat @ v
+                u = u / (jnp.linalg.norm(u) + eps)
+            sigma = u @ (mat @ v)
+            return wv / sigma, u
+
+        w_new, u_new = apply_jax(sn, ww, u_state["u"], n_out=2)
+        u_state["u"] = Tensor(
+            __import__("jax").lax.stop_gradient(u_new._value))
+        object.__setattr__(lyr, name, w_new)
+        return None
+
+    orig = w
+    del layer._parameters[name]
+    layer._parameters[name + "_orig"] = orig
+    layer.register_forward_pre_hook(pre_hook)
+    return layer
+
+
+def parameters_to_vector(parameters, name=None):
+    """Flatten + concat parameters (reference nn/utils/transform_parameters.py)."""
+    import jax.numpy as jnp
+
+    from ...dygraph.tensor import Tensor
+
+    vals = [jnp.ravel(p._value) for p in parameters]
+    return Tensor(jnp.concatenate(vals))
+
+
+def vector_to_parameters(vec, parameters):
+    ofs = 0
+    for p in parameters:
+        n = int(np.prod(p.shape))
+        p.set_value(vec._value[ofs:ofs + n].reshape(tuple(p.shape)))
+        ofs += n

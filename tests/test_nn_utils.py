@@ -1,0 +1,78 @@
+"""paddle.nn.utils (reference nn/utils/weight_norm_hook.py,
+spectral_norm_hook.py, transform_parameters.py) and
+paddle.nn.initializer 2.0 spellings."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import dygraph, nn
+
+
+def test_weight_norm_reparameterizes_and_trains():
+    with dygraph.guard():
+        lyr = nn.Linear(4, 3)
+        w0 = np.asarray(lyr.weight._value).copy()
+        nn.utils.weight_norm(lyr, name="weight", dim=0)
+        names = set(lyr._parameters)
+        assert "weight" not in names and {"weight_g",
+                                          "weight_v"} <= names
+        x = pt.to_tensor(np.ones((2, 4), "f4"))
+        y0 = np.asarray(lyr(x)._value)
+        # w = g * v/||v|| reproduces the original weight at init
+        ref = x._value @ w0
+        np.testing.assert_allclose(
+            y0, np.asarray(ref + lyr.bias._value), rtol=1e-5, atol=1e-6)
+        # gradients reach the factors
+        lyr(x).sum().backward()
+        assert lyr._parameters["weight_g"].grad is not None
+        assert lyr._parameters["weight_v"].grad is not None
+
+
+def test_remove_weight_norm_bakes_value():
+    with dygraph.guard():
+        lyr = nn.Linear(4, 3)
+        nn.utils.weight_norm(lyr)
+        x = pt.to_tensor(np.ones((2, 4), "f4"))
+        y_normed = np.asarray(lyr(x)._value)
+        nn.utils.remove_weight_norm(lyr)
+        assert "weight" in lyr._parameters
+        assert "weight_g" not in lyr._parameters
+        np.testing.assert_allclose(np.asarray(lyr(x)._value), y_normed,
+                                   rtol=1e-5)
+
+
+def test_spectral_norm_unit_top_singular_value():
+    with dygraph.guard():
+        lyr = nn.Linear(6, 5)
+        nn.utils.spectral_norm(lyr, n_power_iterations=20)
+        x = pt.to_tensor(np.eye(6, dtype="f4"))
+        lyr(x)  # trigger hook; layer.weight now normalized
+        w = np.asarray(lyr.weight._value)
+        s = np.linalg.svd(w, compute_uv=False)
+        assert abs(s.max() - 1.0) < 1e-3, s.max()
+
+
+def test_parameters_vector_roundtrip():
+    with dygraph.guard():
+        lyr = nn.Linear(3, 2)
+        vec = nn.utils.parameters_to_vector(lyr.parameters())
+        assert vec.shape == [3 * 2 + 2]
+        new = pt.to_tensor(np.arange(8, dtype="f4"))
+        nn.utils.vector_to_parameters(new, lyr.parameters())
+        np.testing.assert_allclose(
+            np.asarray(lyr.weight._value).ravel(), np.arange(6, dtype="f4"))
+        np.testing.assert_allclose(np.asarray(lyr.bias._value),
+                                   [6.0, 7.0])
+
+
+def test_nn_initializer_namespace():
+    from paddle_tpu.nn import initializer as I
+
+    for cls in (I.Constant, I.Normal, I.Uniform, I.TruncatedNormal,
+                I.XavierNormal, I.XavierUniform, I.KaimingNormal,
+                I.KaimingUniform, I.Assign):
+        assert cls is not None
+    v = I.XavierUniform().eager_value((4, 4), "float32",
+                                      __import__("jax").random.PRNGKey(0))
+    lim = np.sqrt(6.0 / 8)
+    assert float(np.abs(np.asarray(v)).max()) <= lim + 1e-6

@@ -46,6 +46,8 @@ MODULES = [
     "paddle_tpu.slim",
     "paddle_tpu.monitor",
     "paddle_tpu.utils",
+    "paddle_tpu.nn.utils",
+    "paddle_tpu.nn.initializer",
     "paddle_tpu.version",
 ]
 
