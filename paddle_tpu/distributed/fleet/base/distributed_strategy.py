@@ -10,11 +10,19 @@ from __future__ import annotations
 from ... import distributed_strategy_pb2 as pb
 
 
+def _is_repeated(field):
+    # FieldDescriptor.is_repeated exists from protobuf 5.26 (where
+    # .label is deprecated); older protobufs only have .label
+    prop = getattr(field, "is_repeated", None)
+    return prop if prop is not None else \
+        field.label == field.LABEL_REPEATED
+
+
 def _config_to_dict(msg):
     out = {}
     for field in msg.DESCRIPTOR.fields:
         v = getattr(msg, field.name)
-        if field.label == field.LABEL_REPEATED:
+        if _is_repeated(field):
             v = list(v)
         out[field.name] = v
     return out
@@ -27,7 +35,7 @@ def _dict_to_config(msg, configs: dict):
             raise ValueError(
                 f"unknown config key {k!r} for {msg.DESCRIPTOR.name}; valid: "
                 f"{sorted(msg.DESCRIPTOR.fields_by_name)}")
-        if field.label == field.LABEL_REPEATED:
+        if _is_repeated(field):
             del getattr(msg, k)[:]
             getattr(msg, k).extend(v)
         else:

@@ -274,3 +274,37 @@ def test_ptq_round_trip_close_to_fp32():
     denom = max(np.abs(ref).max(), 1e-6)
     assert np.abs(ref - got).max() / denom < 0.1, \
         np.abs(ref - got).max() / denom
+
+
+def test_qat_freeze_export_predictor_roundtrip(tmp_path):
+    """The full slim deployment loop (reference QAT flow): train with
+    the transform pass -> clone(for_test=True) freezes the scales ->
+    save_inference_model -> Predictor serves the quantized graph with
+    outputs matching the frozen eval program."""
+    from paddle_tpu.fluid.io import save_inference_model
+    from paddle_tpu.inference import Predictor
+
+    tp = QuantizationTransformPass()
+    main, startup, loss = _lenet_programs(qat_pass=tp)
+    exe = pt.Executor(CPUPlace())
+    exe.run(startup)  # global scope: save_inference_model reads it
+    rs = np.random.RandomState(7)
+    protos = rs.randn(4, 1, 8, 8).astype("f4")
+    for _ in range(10):
+        x, y = _proto_batch(rs, protos)
+        exe.run(main, feed={"img": x, "lbl": y}, fetch_list=[loss])
+
+    test_prog = main.clone(for_test=True)
+    logits = [op for op in test_prog.global_block.ops
+              if op.type == "softmax_with_cross_entropy"][0].input("Logits")[0]
+    x, _ = _proto_batch(rs, protos, n=8)
+    ref = np.asarray(exe.run(test_prog, feed={"img": x},
+                             fetch_list=[logits], use_prune=True)[0])
+
+    path = str(tmp_path / "qat_model")
+    save_inference_model(path, ["img"],
+                         [test_prog.global_block.var(logits)],
+                         exe, main_program=test_prog)
+    pred = Predictor(path)
+    got = np.asarray(pred.run({"img": x})[0])
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
