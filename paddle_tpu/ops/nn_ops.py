@@ -130,6 +130,35 @@ def _conv2d_transpose(ctx, op):
 # ---------------------------------------------------------------------------
 
 
+def _adaptive_pool_1d(x, axis, out_size, ptype):
+    """Adaptive pooling along one axis with arbitrary output size:
+    gather each cell's window (fixed max width) and reduce under a
+    validity mask.  Dtype-preserving like the divisible-size branch."""
+    ih = int(x.shape[axis])
+    starts = (np.arange(out_size) * ih) // out_size
+    ends = -(-(np.arange(1, out_size + 1) * ih) // out_size)  # ceil
+    maxw = int((ends - starts).max())
+    idx = starts[:, None] + np.arange(maxw)[None, :]     # (out, maxw)
+    valid = idx < ends[:, None]
+    idx = np.minimum(idx, ih - 1)
+    g = jnp.take(x, jnp.asarray(idx.ravel()), axis=axis)
+    new_shape = (x.shape[:axis] + (out_size, maxw)
+                 + x.shape[axis + 1:])
+    g = g.reshape(new_shape)
+    mshape = [1] * len(new_shape)
+    mshape[axis], mshape[axis + 1] = out_size, maxw
+    m = jnp.asarray(valid).reshape(mshape)
+    if ptype == "max":
+        lowest = (jnp.iinfo(g.dtype).min
+                  if jnp.issubdtype(g.dtype, jnp.integer)
+                  else jnp.asarray(-jnp.inf, g.dtype))
+        return jnp.max(jnp.where(m, g, lowest), axis=axis + 1)
+    counts = jnp.asarray(valid.sum(1)).astype(g.dtype).reshape(
+        [out_size if i == axis else 1 for i in range(len(new_shape) - 1)])
+    zero = jnp.zeros((), g.dtype)
+    return jnp.sum(jnp.where(m, g, zero), axis=axis + 1) / counts
+
+
 @register_lower("pool2d")
 def _pool2d(ctx, op):
     x = ctx.in1(op, "X")
@@ -153,7 +182,12 @@ def _pool2d(ctx, op):
             red = jnp.max if ptype == "max" else jnp.mean
             out = red(x5, axis=(3, 5))
         else:
-            raise NotImplementedError("adaptive pool with non-divisible sizes")
+            # non-divisible windows (reference AdaptivePool: cell i pools
+            # [floor(i*I/O), ceil((i+1)*I/O))): window lengths differ by
+            # at most 1, so gather a fixed max-width window per cell and
+            # mask the tail — static shapes, separable per axis
+            out = _adaptive_pool_1d(x, 2, oh, ptype)
+            out = _adaptive_pool_1d(out, 3, ow, ptype)
     else:
         pads = _conv_paddings(
             op.attr("paddings", [0, 0]),

@@ -76,3 +76,25 @@ def test_nn_initializer_namespace():
                                       __import__("jax").random.PRNGKey(0))
     lim = np.sqrt(6.0 / 8)
     assert float(np.abs(np.asarray(v)).max()) <= lim + 1e-6
+
+
+@pytest.mark.parametrize("shape", [(7, 9, 3, 4), (10, 10, 3, 3),
+                                   (5, 7, 5, 2)])
+@pytest.mark.parametrize("mode", ["max", "avg"])
+def test_adaptive_pool_non_divisible(shape, mode):
+    """Arbitrary adaptive pooling sizes (reference AdaptivePool: cell i
+    pools [floor(i*I/O), ceil((i+1)*I/O))); torch is the oracle."""
+    import torch
+
+    ih, iw, oh, ow = shape
+    rs = np.random.RandomState(0)
+    x = rs.randn(2, 3, ih, iw).astype("f4")
+    t = torch.tensor(x)
+    ref = (torch.nn.functional.adaptive_max_pool2d(t, (oh, ow))
+           if mode == "max" else
+           torch.nn.functional.adaptive_avg_pool2d(t, (oh, ow))).numpy()
+    with dygraph.guard():
+        lyr = (nn.AdaptiveMaxPool2D((oh, ow)) if mode == "max"
+               else nn.AdaptiveAvgPool2D((oh, ow)))
+        got = np.asarray(lyr(pt.to_tensor(x))._value)
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
