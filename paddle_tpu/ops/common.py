@@ -62,6 +62,18 @@ def resolve_shape_attr(shape, env_get=None):
     return [int(s) for s in shape]
 
 
+def adaptive_windows(size: int, out_size: int):
+    """Adaptive-pool window indices (reference AdaptiveStartIndex/
+    AdaptiveEndIndex: cell i covers [floor(i*S/O), ceil((i+1)*S/O))):
+    returns (idx [out, maxw] clipped, valid mask, maxw)."""
+    starts = (np.arange(out_size) * size) // out_size
+    ends = -(-(np.arange(1, out_size + 1) * size) // out_size)  # ceil
+    maxw = int((ends - starts).max())
+    idx = starts[:, None] + np.arange(maxw)[None, :]
+    valid = idx < ends[:, None]
+    return np.minimum(idx, size - 1), valid, maxw
+
+
 def as_scalar(x):
     """Ops like sgd receive learning rate as a [1] tensor."""
     return jnp.reshape(x, ()) if hasattr(x, "shape") and np.prod(x.shape) == 1 else x

@@ -52,9 +52,18 @@ def _prior_box(ctx, op):
     whs = []
     for mi, ms in enumerate(min_sizes):
         if min_max_ar_first:
-            raise NotImplementedError(
-                "prior_box min_max_aspect_ratios_order=True layout not "
-                "implemented")
+            # reference prior_box_op.h min_max_aspect_ratios_order=True:
+            # [min (ar=1), max, remaining aspect ratios] — the layout
+            # SSD-caffe checkpoints expect
+            whs.append((float(ms), float(ms)))
+            if max_sizes:
+                mx = max_sizes[mi]
+                whs.append((np.sqrt(ms * mx), np.sqrt(ms * mx)))
+            for ar in out_ars:
+                if abs(ar - 1.0) < 1e-6:
+                    continue
+                whs.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+            continue
         for ar in out_ars:
             whs.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
         if max_sizes:

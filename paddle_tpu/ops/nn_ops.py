@@ -134,13 +134,10 @@ def _adaptive_pool_1d(x, axis, out_size, ptype):
     """Adaptive pooling along one axis with arbitrary output size:
     gather each cell's window (fixed max width) and reduce under a
     validity mask.  Dtype-preserving like the divisible-size branch."""
+    from .common import adaptive_windows
+
     ih = int(x.shape[axis])
-    starts = (np.arange(out_size) * ih) // out_size
-    ends = -(-(np.arange(1, out_size + 1) * ih) // out_size)  # ceil
-    maxw = int((ends - starts).max())
-    idx = starts[:, None] + np.arange(maxw)[None, :]     # (out, maxw)
-    valid = idx < ends[:, None]
-    idx = np.minimum(idx, ih - 1)
+    idx, valid, maxw = adaptive_windows(ih, out_size)
     g = jnp.take(x, jnp.asarray(idx.ravel()), axis=axis)
     new_shape = (x.shape[:axis] + (out_size, maxw)
                  + x.shape[axis + 1:])

@@ -164,10 +164,9 @@ def _grid_sampler(ctx, op):
     mode = op.attr("mode", "bilinear") or "bilinear"
     padding_mode = op.attr("padding_mode", "zeros") or "zeros"
     align_corners = bool(op.attr("align_corners", True))
-    if padding_mode not in ("zeros", "border"):
+    if padding_mode not in ("zeros", "border", "reflection"):
         raise NotImplementedError(
-            f"grid_sampler padding_mode {padding_mode!r} (reflection) is "
-            f"not lowered yet")
+            f"grid_sampler padding_mode {padding_mode!r} is not lowered")
     n, c, h, w = x.shape
     if align_corners:
         gx = (grid[..., 0] + 1.0) * (w - 1) / 2.0
@@ -175,6 +174,25 @@ def _grid_sampler(ctx, op):
     else:
         gx = ((grid[..., 0] + 1.0) * w - 1.0) / 2.0
         gy = ((grid[..., 1] + 1.0) * h - 1.0) / 2.0
+
+    if padding_mode == "reflection":
+        # reflect coordinates (reference GridSampler reflection: over
+        # [0, S-1] with align_corners, [-0.5, S-0.5] without), then
+        # border-clamp for the actual taps
+        def _reflect(coord, size):
+            if align_corners:
+                span = size - 1
+                if span == 0:
+                    return jnp.zeros_like(coord)
+                t = jnp.mod(coord, 2.0 * span)
+                return jnp.where(t > span, 2.0 * span - t, t)
+            t = jnp.mod(coord + 0.5, 2.0 * size)
+            t = size - jnp.abs(t - size)
+            return jnp.clip(t - 0.5, 0.0, size - 1)
+
+        gx = _reflect(gx, w)
+        gy = _reflect(gy, h)
+        padding_mode = "border"
 
     if mode == "nearest":
         def gather(yy, xx):
@@ -351,6 +369,39 @@ def _pool3d(ctx, op):
     ctx.set_out(op, "Out", out)
 
 
+def _adaptive_max_with_index_2d(x, oh, ow):
+    """Non-divisible adaptive max pool with flat h*w argmax indices."""
+    from .common import adaptive_windows
+
+    n, c, h, w = x.shape
+    idx_h, valid_h, mh = adaptive_windows(h, oh)
+    idx_w, valid_w, mw = adaptive_windows(w, ow)
+    g = jnp.take(x, jnp.asarray(idx_h.ravel()), axis=2)
+    g = g.reshape(n, c, oh, mh, w)
+    g = jnp.take(g, jnp.asarray(idx_w.ravel()), axis=4)
+    g = g.reshape(n, c, oh, mh, ow, mw)
+    g = jnp.transpose(g, (0, 1, 2, 4, 3, 5))       # [N,C,OH,OW,mh,mw]
+    mask = jnp.asarray(valid_h[:, None, :, None]
+                       & valid_w[None, :, None, :])  # [OH,OW,mh,mw]
+    lowest = (jnp.iinfo(g.dtype).min
+              if jnp.issubdtype(g.dtype, jnp.integer)
+              else jnp.asarray(-jnp.inf, g.dtype))
+    gm = jnp.where(mask[None, None], g, lowest)
+    flatwin = gm.reshape(n, c, oh, ow, mh * mw)
+    out = jnp.max(flatwin, axis=-1)
+    arg = jnp.argmax(flatwin, axis=-1)             # window-local
+    rows = jnp.asarray(idx_h)[None, None, :, None, :]  # [1,1,OH,1,mh]
+    cols = jnp.asarray(idx_w)[None, None, None, :, :]  # [1,1,1,OW,mw]
+    kh, kw = arg // mw, arg % mw
+    r = jnp.take_along_axis(
+        jnp.broadcast_to(rows, (n, c, oh, ow, mh)), kh[..., None],
+        axis=-1)[..., 0]
+    cidx = jnp.take_along_axis(
+        jnp.broadcast_to(cols, (n, c, oh, ow, mw)), kw[..., None],
+        axis=-1)[..., 0]
+    return out, r * w + cidx
+
+
 @register_lower("max_pool2d_with_index")
 def _max_pool2d_with_index(ctx, op):
     """Max pool returning the flat h*w argmax per window (reference
@@ -364,13 +415,17 @@ def _max_pool2d_with_index(ctx, op):
         paddings = [0, 0]
     n, c, h, w = x.shape
     if bool(op.attr("adaptive", False)):
-        # adaptive bins (AdaptiveMaxPool2D): ksize IS the output size;
-        # divisible case maps to uniform windows, else unsupported
+        # adaptive bins (AdaptiveMaxPool2D): ksize IS the output size
         oh, ow = ksize
         if h % oh or w % ow:
-            raise NotImplementedError(
-                "adaptive max_pool2d_with_index with non-divisible "
-                f"input {h}x{w} -> output {oh}x{ow}")
+            # non-divisible: per-cell variable windows (floor/ceil
+            # bounds) via a fixed max-width 2-D gather; argmax over the
+            # masked window recovers the flat h*w index the Mask
+            # contract needs
+            out, flat = _adaptive_max_with_index_2d(x, oh, ow)
+            ctx.set_out(op, "Out", out)
+            ctx.set_out(op, "Mask", flat.astype(jnp.int32))
+            return
         ksize = [h // oh, w // ow]
         strides = [h // oh, w // ow]
         paddings = [0, 0]

@@ -170,3 +170,36 @@ def test_nms_lowerings_registered():
     for name in ("multiclass_nms", "multiclass_nms2", "matrix_nms",
                  "generate_proposals", "bipartite_match"):
         assert name in LOWERINGS
+
+
+class TestPriorBoxMinMaxOrderFirst(OpTest):
+    """min_max_aspect_ratios_order=True: [min(ar=1), max, other ars]
+    (reference prior_box_op.h — the SSD-caffe checkpoint layout)."""
+
+    op_type = "prior_box"
+
+    def setup(self):
+        feat = np.zeros((1, 8, 1, 1), "f4")
+        image = np.zeros((1, 3, 32, 32), "f4")
+        ms, mx, ar = 4.0, 8.0, 2.0
+        cx = cy = 16.0  # one cell, step 32, offset .5
+        whs = [(ms, ms),
+               (np.sqrt(ms * mx), np.sqrt(ms * mx)),
+               (ms * np.sqrt(ar), ms / np.sqrt(ar)),
+               (ms / np.sqrt(ar), ms * np.sqrt(ar))]  # flip of ar=2
+        boxes = np.zeros((1, 1, 4, 4), "f4")
+        for p, (bw, bh) in enumerate(whs):
+            boxes[0, 0, p] = [(cx - bw / 2) / 32, (cy - bh / 2) / 32,
+                              (cx + bw / 2) / 32, (cy + bh / 2) / 32]
+        var = np.tile(np.array([0.1, 0.1, 0.2, 0.2], "f4"), (1, 1, 4, 1))
+        self.inputs = {"Input": [("feat", feat)], "Image": [("img", image)]}
+        self.attrs = {"min_sizes": [ms], "max_sizes": [mx],
+                      "aspect_ratios": [1.0, ar],
+                      "variances": [0.1, 0.1, 0.2, 0.2], "flip": True,
+                      "clip": False, "offset": 0.5,
+                      "min_max_aspect_ratios_order": True}
+        self.outputs = {"Boxes": [("boxes", boxes)],
+                        "Variances": [("var", var)]}
+
+    def test_output(self):
+        self.check_output(atol=1e-5, rtol=1e-4)

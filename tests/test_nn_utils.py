@@ -98,3 +98,53 @@ def test_adaptive_pool_non_divisible(shape, mode):
                else nn.AdaptiveAvgPool2D((oh, ow)))
         got = np.asarray(lyr(pt.to_tensor(x))._value)
     np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("mode", ["bilinear", "nearest"])
+@pytest.mark.parametrize("align_corners", [True, False])
+def test_grid_sample_reflection_padding(mode, align_corners):
+    """Reflection padding (reference grid_sampler_op.cc); torch is the
+    oracle, incl. far-out-of-range coordinates."""
+    import torch
+
+    from paddle_tpu.dygraph import run_op
+    from paddle_tpu.dygraph.tensor import Tensor
+
+    rs = np.random.RandomState(0)
+    x = rs.randn(2, 3, 6, 7).astype("f4")
+    grid = (rs.rand(2, 5, 4, 2).astype("f4") * 3.0 - 1.5)
+    ref = torch.nn.functional.grid_sample(
+        torch.tensor(x), torch.tensor(grid), mode=mode,
+        padding_mode="reflection", align_corners=align_corners).numpy()
+    with dygraph.guard():
+        out = run_op("grid_sampler",
+                     {"X": Tensor(x), "Grid": Tensor(grid)},
+                     {"mode": mode, "padding_mode": "reflection",
+                      "align_corners": align_corners},
+                     out_slots=("Output",))["Output"]
+    np.testing.assert_allclose(np.asarray(out._value), ref,
+                               rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("shape", [(7, 9, 3, 4), (5, 7, 5, 2)])
+def test_adaptive_max_pool_with_index_non_divisible(shape):
+    """max_pool2d_with_index adaptive non-divisible: values AND flat
+    h*w argmax indices match torch's return_indices contract."""
+    import torch
+
+    from paddle_tpu.dygraph import run_op
+    from paddle_tpu.dygraph.tensor import Tensor
+
+    ih, iw, oh, ow = shape
+    rs = np.random.RandomState(1)
+    x = rs.randn(2, 3, ih, iw).astype("f4")
+    ref, ridx = torch.nn.functional.adaptive_max_pool2d(
+        torch.tensor(x), (oh, ow), return_indices=True)
+    with dygraph.guard():
+        res = run_op("max_pool2d_with_index", {"X": Tensor(x)},
+                     {"ksize": [oh, ow], "adaptive": True},
+                     out_slots=("Out", "Mask"))
+    np.testing.assert_allclose(np.asarray(res["Out"]._value),
+                               ref.numpy(), rtol=1e-6, atol=1e-7)
+    np.testing.assert_array_equal(np.asarray(res["Mask"]._value),
+                                  ridx.numpy())
