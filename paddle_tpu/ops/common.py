@@ -74,6 +74,74 @@ def adaptive_windows(size: int, out_size: int):
     return np.minimum(idx, size - 1), valid, maxw
 
 
+def adaptive_max_with_index(x, out_sizes):
+    """N-D non-divisible adaptive max pool with flat argmax indices.
+
+    ``x`` is [N, C, *spatial]; each output cell gathers its variable
+    floor/ceil window through a fixed max-width index table and reduces
+    under a validity mask; the masked argmax decomposes back into
+    original coordinates to give the reference Mask contract (flat
+    index into the unpadded spatial volume).  Returns (out, flat_int32).
+    """
+    import jax.numpy as jnp
+
+    spatial = len(out_sizes)
+    in_sp = [int(s) for s in x.shape[2:2 + spatial]]
+    wins = [adaptive_windows(in_sp[i], int(out_sizes[i]))
+            for i in range(spatial)]
+    g = x
+    for i in range(spatial):
+        axis = 2 + 2 * i  # dims before it already split into (o, m)
+        idx, _, maxw = wins[i]
+        g = jnp.take(g, jnp.asarray(idx.ravel()), axis=axis)
+        g = g.reshape(g.shape[:axis] + (int(out_sizes[i]), maxw)
+                      + g.shape[axis + 1:])
+    perm = ([0, 1] + [2 + 2 * i for i in range(spatial)]
+            + [3 + 2 * i for i in range(spatial)])
+    g = jnp.transpose(g, perm)  # [N, C, o..., m...]
+
+    mask = None
+    for i, (_, valid, _) in enumerate(wins):
+        shape = [1] * (2 * spatial)
+        shape[i] = valid.shape[0]
+        shape[spatial + i] = valid.shape[1]
+        m = jnp.asarray(valid).reshape(shape)
+        mask = m if mask is None else (mask & m)
+    lowest = (jnp.iinfo(g.dtype).min
+              if jnp.issubdtype(g.dtype, jnp.integer)
+              else jnp.asarray(-jnp.inf, g.dtype))
+    gm = jnp.where(mask[None, None], g, lowest)
+
+    maxws = [w[2] for w in wins]
+    m_total = int(np.prod(maxws))
+    head = gm.shape[:2 + spatial]
+    flatwin = gm.reshape(head + (m_total,))
+    out = jnp.max(flatwin, axis=-1)
+    arg = jnp.argmax(flatwin, axis=-1)  # window-local flat
+
+    flat = jnp.zeros_like(arg)
+    stride = 1
+    rem = arg
+    # decompose window-local index back-to-front; map through each
+    # axis's index table to the ORIGINAL coordinate
+    ks = []
+    for i in reversed(range(spatial)):
+        ks.append(rem % maxws[i])
+        rem = rem // maxws[i]
+    ks = list(reversed(ks))
+    for i in reversed(range(spatial)):
+        idx_tab = jnp.asarray(wins[i][0])  # [o_i, maxw_i]
+        tab = idx_tab.reshape([1, 1] + [
+            idx_tab.shape[0] if j == i else 1 for j in range(spatial)
+        ] + [idx_tab.shape[1]])
+        tab = jnp.broadcast_to(tab, head + (idx_tab.shape[1],))
+        coord = jnp.take_along_axis(tab, ks[i][..., None],
+                                    axis=-1)[..., 0]
+        flat = flat + coord * stride
+        stride *= in_sp[i]
+    return out, flat.astype(jnp.int32)
+
+
 def as_scalar(x):
     """Ops like sgd receive learning rate as a [1] tensor."""
     return jnp.reshape(x, ()) if hasattr(x, "shape") and np.prod(x.shape) == 1 else x

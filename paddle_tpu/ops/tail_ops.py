@@ -138,12 +138,17 @@ def _max_pool_with_index(ctx, op):
         paddings = [0] * spatial
     if bool(op.attr("adaptive", False)):
         # adaptive bins: ksize IS the output size (same contract as the
-        # 2-D variant in vision_ops.py); divisible case only
+        # 2-D variant in vision_ops.py)
         in_sp_a = x.shape[2:]
         if any(in_sp_a[i] % ksize[i] for i in range(spatial)):
-            raise NotImplementedError(
-                f"adaptive max_pool3d_with_index with non-divisible "
-                f"input {in_sp_a} -> output {tuple(ksize)}")
+            # non-divisible windows: shared fixed-width gather + masked
+            # argmax (ops/common.py adaptive_max_with_index)
+            from .common import adaptive_max_with_index
+
+            out, flat = adaptive_max_with_index(x, tuple(ksize))
+            ctx.set_out(op, "Out", out)
+            ctx.set_out(op, "Mask", flat)
+            return
         strides = [in_sp_a[i] // ksize[i] for i in range(spatial)]
         ksize = list(strides)
         paddings = [0] * spatial

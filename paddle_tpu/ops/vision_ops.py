@@ -369,39 +369,6 @@ def _pool3d(ctx, op):
     ctx.set_out(op, "Out", out)
 
 
-def _adaptive_max_with_index_2d(x, oh, ow):
-    """Non-divisible adaptive max pool with flat h*w argmax indices."""
-    from .common import adaptive_windows
-
-    n, c, h, w = x.shape
-    idx_h, valid_h, mh = adaptive_windows(h, oh)
-    idx_w, valid_w, mw = adaptive_windows(w, ow)
-    g = jnp.take(x, jnp.asarray(idx_h.ravel()), axis=2)
-    g = g.reshape(n, c, oh, mh, w)
-    g = jnp.take(g, jnp.asarray(idx_w.ravel()), axis=4)
-    g = g.reshape(n, c, oh, mh, ow, mw)
-    g = jnp.transpose(g, (0, 1, 2, 4, 3, 5))       # [N,C,OH,OW,mh,mw]
-    mask = jnp.asarray(valid_h[:, None, :, None]
-                       & valid_w[None, :, None, :])  # [OH,OW,mh,mw]
-    lowest = (jnp.iinfo(g.dtype).min
-              if jnp.issubdtype(g.dtype, jnp.integer)
-              else jnp.asarray(-jnp.inf, g.dtype))
-    gm = jnp.where(mask[None, None], g, lowest)
-    flatwin = gm.reshape(n, c, oh, ow, mh * mw)
-    out = jnp.max(flatwin, axis=-1)
-    arg = jnp.argmax(flatwin, axis=-1)             # window-local
-    rows = jnp.asarray(idx_h)[None, None, :, None, :]  # [1,1,OH,1,mh]
-    cols = jnp.asarray(idx_w)[None, None, None, :, :]  # [1,1,1,OW,mw]
-    kh, kw = arg // mw, arg % mw
-    r = jnp.take_along_axis(
-        jnp.broadcast_to(rows, (n, c, oh, ow, mh)), kh[..., None],
-        axis=-1)[..., 0]
-    cidx = jnp.take_along_axis(
-        jnp.broadcast_to(cols, (n, c, oh, ow, mw)), kw[..., None],
-        axis=-1)[..., 0]
-    return out, r * w + cidx
-
-
 @register_lower("max_pool2d_with_index")
 def _max_pool2d_with_index(ctx, op):
     """Max pool returning the flat h*w argmax per window (reference
@@ -419,12 +386,14 @@ def _max_pool2d_with_index(ctx, op):
         oh, ow = ksize
         if h % oh or w % ow:
             # non-divisible: per-cell variable windows (floor/ceil
-            # bounds) via a fixed max-width 2-D gather; argmax over the
+            # bounds) via a fixed max-width gather; argmax over the
             # masked window recovers the flat h*w index the Mask
             # contract needs
-            out, flat = _adaptive_max_with_index_2d(x, oh, ow)
+            from .common import adaptive_max_with_index
+
+            out, flat = adaptive_max_with_index(x, (oh, ow))
             ctx.set_out(op, "Out", out)
-            ctx.set_out(op, "Mask", flat.astype(jnp.int32))
+            ctx.set_out(op, "Mask", flat)
             return
         ksize = [h // oh, w // ow]
         strides = [h // oh, w // ow]

@@ -148,3 +148,23 @@ def test_adaptive_max_pool_with_index_non_divisible(shape):
                                ref.numpy(), rtol=1e-6, atol=1e-7)
     np.testing.assert_array_equal(np.asarray(res["Mask"]._value),
                                   ridx.numpy())
+
+
+def test_adaptive_max_pool3d_with_index_non_divisible():
+    import torch
+
+    from paddle_tpu.dygraph import run_op
+    from paddle_tpu.dygraph.tensor import Tensor
+
+    rs = np.random.RandomState(2)
+    x = rs.randn(2, 2, 5, 7, 9).astype("f4")
+    ref, ridx = torch.nn.functional.adaptive_max_pool3d(
+        torch.tensor(x), (2, 3, 4), return_indices=True)
+    with dygraph.guard():
+        res = run_op("max_pool3d_with_index", {"X": Tensor(x)},
+                     {"ksize": [2, 3, 4], "adaptive": True},
+                     out_slots=("Out", "Mask"))
+    np.testing.assert_allclose(np.asarray(res["Out"]._value),
+                               ref.numpy(), rtol=1e-6, atol=1e-7)
+    np.testing.assert_array_equal(np.asarray(res["Mask"]._value),
+                                  ridx.numpy())
