@@ -127,24 +127,41 @@ def _correlation(ctx, op):
     max_disp = int(op.attr("max_displacement", 1))
     stride1 = int(op.attr("stride1", 1))
     stride2 = int(op.attr("stride2", 1))
-    if ks != 1:
-        raise NotImplementedError("correlation kernel_size > 1")
+    if ks % 2 == 0:
+        raise NotImplementedError("correlation kernel_size must be odd")
+    kr = (ks - 1) // 2
     n, c, h, w = x1.shape
-    x1p = jnp.pad(x1, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
-    x2p = jnp.pad(x2, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    # over-pad by the kernel radius so centered windows at every
+    # sampled position (and every displacement) stay in bounds
+    pw = pad + kr
+    x1p = jnp.pad(x1, ((0, 0), (0, 0), (pw, pw), (pw, pw)))
+    x2p = jnp.pad(x2, ((0, 0), (0, 0), (pw, pw), (pw, pw)))
     # reference grid: radius = max_disp // stride2, displacements are
     # multiples of stride2 (correlation_op InferShape)
     radius = max_disp // stride2
     disps = [i * stride2 for i in range(-radius, radius + 1)]
     outs = []
     hp, wp = h + 2 * pad, w + 2 * pad
-    oh = -(-(hp - 2 * max_disp) // stride1)  # ceil div (reference)
-    ow = -(-(wp - 2 * max_disp) // stride1)
-    base_y = max_disp + stride1 * jnp.arange(oh)
-    base_x = max_disp + stride1 * jnp.arange(ow)
-    a = x1p[:, :, base_y[:, None], base_x[None, :]]
+    # reference geometry (correlation_op.cc CorrelationOutputSize):
+    # border_radius = max_displacement + kernel_radius bounds both the
+    # output size and the sample centers
+    border = max_disp + kr
+    oh = -(-(hp - 2 * border) // stride1)  # ceil div
+    ow = -(-(wp - 2 * border) // stride1)
+    # in top-left-corner coordinates of the k-window box filter, the
+    # sampled centers land back at border + stride1*i (pad frame)
+    base_y = border + stride1 * jnp.arange(oh)
+    base_x = border + stride1 * jnp.arange(ow)
     for dy in disps:
         for dx in disps:
-            bpatch = x2p[:, :, (base_y + dy)[:, None], (base_x + dx)[None, :]]
-            outs.append(jnp.mean(a * bpatch, axis=1))
+            # roll-shift: wraparound rows/cols sit outside every
+            # accessed window (centers stop border short of the edge
+            # and |d| <= max_disp <= border), so they are never read
+            x2s = jnp.roll(x2p, (-dy, -dx), axis=(2, 3))
+            prod = jnp.mean(x1p * x2s, axis=1)  # channel mean [N,Hp,Wp]
+            if ks > 1:
+                prod = jax.lax.reduce_window(
+                    prod, 0.0, jax.lax.add, (1, ks, ks), (1, 1, 1),
+                    "VALID") / float(ks * ks)
+            outs.append(prod[:, base_y[:, None], base_x[None, :]])
     ctx.set_out(op, "Output", jnp.stack(outs, axis=1))

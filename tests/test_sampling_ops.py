@@ -84,3 +84,44 @@ def test_sample_logits_gathers_true_label_first():
     want = logits[np.arange(B), lbl[:, 0]] + np.log(C)
     np.testing.assert_allclose(sampled[:, 0], want, rtol=1e-5)
     np.testing.assert_array_equal(samples[:, 0], lbl[:, 0])
+
+
+def test_correlation_kernel3_matches_numpy_oracle():
+    """kernel_size=3: windowed channel-mean products; direct numpy
+    reference (FlowNet-C correlation, correlation_op.cu)."""
+    rs = np.random.RandomState(2)
+    C, H, W = 3, 6, 7
+    x1 = rs.randn(1, C, H, W).astype("f4")
+    x2 = rs.randn(1, C, H, W).astype("f4")
+    pad, ks, md = 2, 3, 2
+    (out,) = _run(
+        "correlation",
+        [("Input1", "x1", x1), ("Input2", "x2", x2)],
+        [("Output", "out")],
+        {"pad_size": pad, "kernel_size": ks, "max_displacement": md,
+         "stride1": 1, "stride2": 1})
+
+    # reference geometry: border_radius = max_displacement + kernel
+    # radius bounds output size and centers (correlation_op.cc)
+    kr = (ks - 1) // 2
+    border = md + kr
+    hp, wp = H + 2 * pad, W + 2 * pad
+    x1p = np.zeros((C, hp, wp), "f4")
+    x2p = np.zeros_like(x1p)
+    x1p[:, pad:pad + H, pad:pad + W] = x1[0]
+    x2p[:, pad:pad + H, pad:pad + W] = x2[0]
+    oh, ow = hp - 2 * border, wp - 2 * border
+    assert out.shape == (1, (2 * md + 1) ** 2, oh, ow), out.shape
+    ref = np.zeros(((2 * md + 1) ** 2, oh, ow), "f4")
+    di = 0
+    for dy in range(-md, md + 1):
+        for dx in range(-md, md + 1):
+            for i in range(oh):
+                for j in range(ow):
+                    cy, cx = border + i, border + j
+                    a = x1p[:, cy - kr:cy + kr + 1, cx - kr:cx + kr + 1]
+                    b = x2p[:, cy + dy - kr:cy + dy + kr + 1,
+                            cx + dx - kr:cx + dx + kr + 1]
+                    ref[di, i, j] = (a * b).mean()
+            di += 1
+    np.testing.assert_allclose(out[0], ref, rtol=1e-5, atol=1e-6)
