@@ -14,28 +14,53 @@ from ..framework.lowering import register_lower
 from .common import op_seed_key
 
 
-def _sampler_prob(idx, sampler, n_classes):
+def _sampler_prob(idx, sampler, n_classes, custom_probs=None):
     """P(class) under the sampler — ONE home for the Zipfian formula
-    (reference sampler.cc LogUniformSampler::Probability)."""
+    (reference sampler.cc LogUniformSampler::Probability; CustomSampler
+    reads the user distribution)."""
+    if sampler == 2:
+        return custom_probs[jnp.asarray(idx).astype(jnp.int32)]
     if sampler == 0:
         return jnp.full(jnp.shape(idx), 1.0 / n_classes)
     return (jnp.log((idx + 2.0) / (idx + 1.0))) / np.log(n_classes + 1.0)
 
 
 def _draw_samples(ctx, op, n_samples, n_classes):
+    """-> (samples, sample_probs, custom_probs-or-None).  The custom
+    distribution is fetched + normalized HERE, once, for every caller
+    (nce, sample_logits) — the sampling draw and the probability
+    corrections must read the same normalized values."""
     sampler = int(op.attr("sampler", 0))
     k = op_seed_key(ctx, op)
+    custom_probs = None
     if sampler == 0:  # uniform
         s = jax.random.randint(k, (n_samples,), 0, n_classes)
     elif sampler == 1:  # log-uniform (Zipfian), reference math
         u = jax.random.uniform(k, (n_samples,))
         s = (jnp.exp(u * np.log(n_classes + 1.0)) - 1.0).astype(jnp.int32)
         s = jnp.clip(s, 0, n_classes - 1)
+    elif sampler == 2:
+        # custom distribution (reference CustomSampler builds an alias
+        # table from CustomDistProbs/Alias/AliasProbs; categorical over
+        # the same probs is the TPU-native equivalent — identical
+        # distribution, no table plumbing)
+        custom_probs = ctx.in1(op, "CustomDistProbs")
+        if custom_probs is None:
+            raise ValueError(
+                f"{op.type} sampler=2 (custom_dist) needs the "
+                f"CustomDistProbs input (per-class sampling "
+                f"probabilities)")
+        custom_probs = custom_probs.reshape(-1).astype(jnp.float32)
+        # normalize: categorical would silently normalize raw counts,
+        # desynchronizing the draw from the reported probabilities
+        custom_probs = custom_probs / jnp.sum(custom_probs)
+        s = jax.random.categorical(
+            k, jnp.log(jnp.maximum(custom_probs, 1e-30)), shape=(n_samples,))
+        s = s.astype(jnp.int32)
     else:
-        raise NotImplementedError(
-            "nce custom_dist sampler (2) needs CustomDist* inputs; use "
-            "uniform (0) or log-uniform (1)")
-    return s, _sampler_prob(s, sampler, n_classes)
+        raise NotImplementedError(f"{op.type} sampler {sampler} is unknown")
+    return (s, _sampler_prob(s, sampler, n_classes,
+                             custom_probs=custom_probs), custom_probs)
 
 
 @register_lower("nce")
@@ -52,7 +77,8 @@ def _nce(ctx, op):
     bsz = x.shape[0]
     t = label.shape[1] if label.ndim > 1 else 1
     lbl = label.reshape(bsz, t)
-    samples, sample_prob = _draw_samples(ctx, op, n_neg, n_classes)
+    samples, sample_prob, custom_probs = _draw_samples(
+        ctx, op, n_neg, n_classes)
 
     true_logit = jnp.einsum("bd,btd->bt", x, w[lbl])
     if b is not None:
@@ -62,7 +88,8 @@ def _nce(ctx, op):
         noise_logit = noise_logit + b[samples]
 
     sampler = int(op.attr("sampler", 0))
-    p_true = _sampler_prob(lbl, sampler, n_classes)
+    p_true = _sampler_prob(lbl, sampler, n_classes,
+                           custom_probs=custom_probs)
     # NCE: sigmoid cross-entropy against logit - log(k * P_noise);
     # softplus keeps large logits finite (log1p(exp(x)) overflows)
     k = float(n_neg)
@@ -89,7 +116,7 @@ def _sample_logits(ctx, op):
     c = logits.shape[1]
     bsz = logits.shape[0]
     t = label.shape[1]
-    samples, prob = _draw_samples(ctx, op, n_samples, c)
+    samples, prob, custom_probs = _draw_samples(ctx, op, n_samples, c)
     all_idx = jnp.concatenate(
         [label.astype(jnp.int32),
          jnp.broadcast_to(samples[None].astype(jnp.int32),
@@ -103,7 +130,8 @@ def _sample_logits(ctx, op):
     # sampler distribution as the drawn negatives)
     sampler = int(op.attr("sampler", 0))
     logq = jnp.concatenate(
-        [jnp.log(_sampler_prob(label.astype(jnp.float32), sampler, c)),
+        [jnp.log(_sampler_prob(label.astype(jnp.float32), sampler, c,
+                               custom_probs=custom_probs)),
          jnp.broadcast_to(jnp.log(prob)[None], (bsz, n_samples))], axis=1)
     ctx.set_out(op, "SampledLogits", picked - logq)
     ctx.set_out(op, "SampledLabels",
@@ -160,8 +188,15 @@ def _correlation(ctx, op):
             x2s = jnp.roll(x2p, (-dy, -dx), axis=(2, 3))
             prod = jnp.mean(x1p * x2s, axis=1)  # channel mean [N,Hp,Wp]
             if ks > 1:
-                prod = jax.lax.reduce_window(
-                    prod, 0.0, jax.lax.add, (1, ks, ks), (1, 1, 1),
-                    "VALID") / float(ks * ks)
-            outs.append(prod[:, base_y[:, None], base_x[None, :]])
+                # restrict to the accessed band, then stride the window
+                # reduce — corners land exactly on the sample centers
+                # (no wasted rows/cols when stride1 > 1)
+                lim_y = border + stride1 * (oh - 1) + 2 * kr + 1
+                lim_x = border + stride1 * (ow - 1) + 2 * kr + 1
+                band = prod[:, border:lim_y, border:lim_x]
+                outs.append(jax.lax.reduce_window(
+                    band, 0.0, jax.lax.add, (1, ks, ks),
+                    (1, stride1, stride1), "VALID") / float(ks * ks))
+            else:
+                outs.append(prod[:, base_y[:, None], base_x[None, :]])
     ctx.set_out(op, "Output", jnp.stack(outs, axis=1))

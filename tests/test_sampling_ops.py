@@ -125,3 +125,66 @@ def test_correlation_kernel3_matches_numpy_oracle():
                     ref[di, i, j] = (a * b).mean()
             di += 1
     np.testing.assert_allclose(out[0], ref, rtol=1e-5, atol=1e-6)
+
+
+def test_nce_custom_dist_sampler():
+    """sampler=2 draws from CustomDistProbs (reference CustomSampler):
+    classes with zero probability must never be sampled, and the
+    reported sample probabilities must read the user distribution."""
+    rs = np.random.RandomState(3)
+    B, D, C, K = 4, 6, 10, 50
+    x = rs.randn(B, D).astype("f4")
+    lbl = rs.randint(0, 3, (B, 1)).astype("i8")
+    w = rs.randn(C, D).astype("f4") * 0.1
+    b = np.zeros(C, "f4")
+    probs = np.zeros(C, "f4")
+    probs[:3] = [0.5, 0.3, 0.2]  # classes 3..9 never drawn
+    cost, slog, slab = _run(
+        "nce",
+        [("Input", "x", x), ("Label", "lbl", lbl), ("Weight", "w", w),
+         ("Bias", "b", b), ("CustomDistProbs", "cd", probs)],
+        [("Cost", "cost"), ("SampleLogits", "slog"),
+         ("SampleLabels", "slab")],
+        {"num_total_classes": C, "num_neg_samples": K, "sampler": 2})
+    assert cost.shape == (B, 1) and np.isfinite(cost).all()
+    sampled = slab[:, 1:]  # negatives
+    assert sampled.max() <= 2, sampled.max()
+
+
+def test_correlation_kernel3_stride2():
+    """stride1=2 with k=3: banded strided reduce must hit the same
+    centers as the naive oracle."""
+    rs = np.random.RandomState(4)
+    C, H, W = 2, 8, 9
+    x1 = rs.randn(1, C, H, W).astype("f4")
+    x2 = rs.randn(1, C, H, W).astype("f4")
+    pad, ks, md, s1 = 2, 3, 2, 2
+    (out,) = _run(
+        "correlation",
+        [("Input1", "x1", x1), ("Input2", "x2", x2)],
+        [("Output", "out")],
+        {"pad_size": pad, "kernel_size": ks, "max_displacement": md,
+         "stride1": s1, "stride2": 1})
+    kr = (ks - 1) // 2
+    border = md + kr
+    hp, wp = H + 2 * pad, W + 2 * pad
+    x1p = np.zeros((C, hp, wp), "f4")
+    x2p = np.zeros_like(x1p)
+    x1p[:, pad:pad + H, pad:pad + W] = x1[0]
+    x2p[:, pad:pad + H, pad:pad + W] = x2[0]
+    oh = -(-(hp - 2 * border) // s1)
+    ow = -(-(wp - 2 * border) // s1)
+    assert out.shape == (1, (2 * md + 1) ** 2, oh, ow), out.shape
+    di = 0
+    for dy in range(-md, md + 1):
+        for dx in range(-md, md + 1):
+            for i in range(oh):
+                for j in range(ow):
+                    cy, cx = border + s1 * i, border + s1 * j
+                    a = x1p[:, cy - kr:cy + kr + 1, cx - kr:cx + kr + 1]
+                    b = x2p[:, cy + dy - kr:cy + dy + kr + 1,
+                            cx + dx - kr:cx + dx + kr + 1]
+                    np.testing.assert_allclose(
+                        out[0, di, i, j], (a * b).mean(),
+                        rtol=1e-5, atol=1e-6)
+            di += 1
