@@ -205,6 +205,22 @@ def _send_v2(ctx, op):
     edge (src = recv's peer, dst = send's peer).  The send just parks
     its operand for the matching recv in program order."""
     x = ctx.in1(op, "X")
+    if op.type == "partial_send":
+        # reference partial_send_op.cc: transmit the id-th of num equal
+        # flat chunks (pipeline tensor-fusion traffic shaping); same
+        # enforcements as the reference, loudly
+        num = int(op.attr("num", 1) or 1)
+        pid = int(op.attr("id", 0) or 0)
+        flat = x.reshape(-1)
+        if flat.shape[0] % num:
+            raise ValueError(
+                f"partial_send: numel {flat.shape[0]} is not divisible "
+                f"by num={num} (elements would be silently dropped)")
+        if not 0 <= pid < num:
+            raise ValueError(
+                f"partial_send: id={pid} out of range for num={num}")
+        chunk = flat.shape[0] // num
+        x = jax.lax.dynamic_slice_in_dim(flat, pid * chunk, chunk, 0)
     pend = getattr(ctx, "_pending_sends", None)
     if pend is None:
         pend = ctx._pending_sends = {}
@@ -234,6 +250,21 @@ def _recv_v2(ctx, op):
     ax = _axis(ctx, op)
     out = x if ax is None else lax.ppermute(
         x, ax if not isinstance(ax, tuple) else ax[0], [(src, dst)])
+    if op.type == "partial_recv":
+        # reference partial_recv_op.cc: the received chunk lands at
+        # offset id*chunk of the FULL-size Out buffer (other slots 0)
+        num = int(op.attr("num", 1) or 1)
+        pid = int(op.attr("id", 0) or 0)
+        if not 0 <= pid < num:
+            raise ValueError(
+                f"partial_recv: id={pid} out of range for num={num}")
+        chunk = out.reshape(-1).shape[0]
+        full = jnp.zeros((chunk * num,), out.dtype)
+        full = jax.lax.dynamic_update_slice_in_dim(
+            full, out.reshape(-1), pid * chunk, 0)
+        shape = [int(s) for s in (op.attr("out_shape", []) or [])]
+        out = full.reshape(shape) if shape and all(
+            s > 0 for s in shape) else full
     ctx.set_out(op, "Out", out)
 
 

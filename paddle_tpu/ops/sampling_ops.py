@@ -22,7 +22,8 @@ def _sampler_prob(idx, sampler, n_classes, custom_probs=None):
         return custom_probs[jnp.asarray(idx).astype(jnp.int32)]
     if sampler == 0:
         return jnp.full(jnp.shape(idx), 1.0 / n_classes)
-    return (jnp.log((idx + 2.0) / (idx + 1.0))) / np.log(n_classes + 1.0)
+    idxf = jnp.asarray(idx).astype(jnp.float32)
+    return (jnp.log((idxf + 2.0) / (idxf + 1.0))) / np.log(n_classes + 1.0)
 
 
 def _draw_samples(ctx, op, n_samples, n_classes):
@@ -130,7 +131,7 @@ def _sample_logits(ctx, op):
     # sampler distribution as the drawn negatives)
     sampler = int(op.attr("sampler", 0))
     logq = jnp.concatenate(
-        [jnp.log(_sampler_prob(label.astype(jnp.float32), sampler, c,
+        [jnp.log(_sampler_prob(label, sampler, c,
                                custom_probs=custom_probs)),
          jnp.broadcast_to(jnp.log(prob)[None], (bsz, n_samples))], axis=1)
     ctx.set_out(op, "SampledLogits", picked - logq)

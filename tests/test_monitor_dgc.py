@@ -196,3 +196,27 @@ class TestSendRecvPair:
         a = np.arange(8, dtype="f4").reshape(2, 4)
         got = exe.run(main, feed={"x": a}, fetch_list=[out])[0]
         np.testing.assert_allclose(np.asarray(got), a)
+
+    def test_partial_send_recv_chunk(self):
+        """partial_send transmits the id-th of num flat chunks
+        (reference partial_send_op.cc); single-device identity path."""
+        main, startup = Program(), Program()
+        with program_guard(main, startup):
+            x = layers.data("x", [8])
+            out = main.global_block.create_var(
+                name="precv_out", shape=[-1], dtype="float32")
+            main.global_block.append_op(
+                "partial_send", {"X": [x.name]}, {},
+                {"ring_id": 5, "peer": 1, "num": 2, "id": 1})
+            main.global_block.append_op(
+                "partial_recv", {}, {"Out": [out.name]},
+                {"ring_id": 5, "peer": 0, "num": 2, "id": 1})
+        exe = pt.Executor(pt.CPUPlace())
+        a = np.arange(16, dtype="f4").reshape(2, 8)
+        got = np.asarray(exe.run(main, feed={"x": a},
+                                 fetch_list=[out])[0])
+        # reference contract: chunk id lands at its offset in the
+        # FULL-size buffer, other slots zero
+        want = np.zeros(16, "f4")
+        want[8:] = a.ravel()[8:]
+        np.testing.assert_allclose(got, want)
