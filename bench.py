@@ -203,6 +203,93 @@ def bench_resnet_pipeline(pt, jax):
     return PIPE_BATCH * PIPE_CHUNK * PIPE_CALLS / dt
 
 
+SERVE_CLIENTS = 32
+SERVE_REQS = 256
+SERVE_FEAT = 64
+SERVE_SEQ_BUCKETS = (8, 16, 32, 64)
+SERVE_BATCH_BUCKETS = (1, 2, 4, 8, 16)
+
+
+def bench_serving(pt, jax):
+    """Serving-layer throughput: rows(images)/sec for SERVE_REQS
+    variable-length requests pushed by SERVE_CLIENTS concurrent clients
+    through serving.Server's dynamic micro-batcher, vs the same request
+    stream run one-at-a-time through the bare Predictor.  Both paths are
+    measured steady-state (every shape warmed first), so the ratio is
+    the pure batching win, not compile-storm avoidance (the tests pin
+    that separately)."""
+    import shutil
+    import tempfile
+    import threading
+
+    from paddle_tpu import layers, serving
+    from paddle_tpu.fluid import io as fluid_io
+    from paddle_tpu.framework import unique_name
+    from paddle_tpu.framework.place import _default_place
+    from paddle_tpu.framework.program import Program, program_guard
+    from paddle_tpu.framework.scope import _switch_scope
+    from paddle_tpu.inference import Config, create_predictor
+
+    d = tempfile.mkdtemp(prefix="serving_bench_")
+    try:
+        main, startup = Program(), Program()
+        main.random_seed = 11
+        with unique_name.guard(), program_guard(main, startup):
+            x = layers.data("x", [-1, SERVE_FEAT])  # [-1, -1, feat]
+            h = layers.fc(x, 256, num_flatten_dims=2, act="relu",
+                          bias_attr=False)
+            out = layers.reduce_sum(h, dim=1)
+        sc = pt.framework.Scope()
+        exe = pt.Executor(_default_place())
+        exe.run(startup, scope=sc)
+        old = _switch_scope(sc)
+        try:
+            fluid_io.save_inference_model(d, ["x"], [out], exe, main)
+        finally:
+            _switch_scope(old)
+
+        rs = np.random.RandomState(0)
+        # lengths drawn from the bucket grid keep the sequential path's
+        # warmup to a handful of executables (this bench times steady
+        # state, not compilation)
+        reqs = [rs.randn(1 + rs.randint(4),
+                         int(rs.choice(SERVE_SEQ_BUCKETS)),
+                         SERVE_FEAT).astype("f4")
+                for _ in range(SERVE_REQS)]
+        rows = sum(r.shape[0] for r in reqs)
+
+        pred = create_predictor(Config(d))
+        for r in reqs:
+            pred.run({"x": r})  # warm every raw shape
+        t0 = time.perf_counter()
+        for r in reqs:
+            np.asarray(pred.run({"x": r})[0])
+        seq_rps = rows / (time.perf_counter() - t0)
+
+        srv = serving.Server(d, serving.ServingConfig(
+            batch_sizes=SERVE_BATCH_BUCKETS, seq_lens=SERVE_SEQ_BUCKETS,
+            batch_window_ms=2.0, max_queue=SERVE_REQS + SERVE_CLIENTS))
+        srv.start()  # AOT-warms every bucket
+
+        def client(chunk):
+            for r in chunk:
+                np.asarray(srv.infer({"x": r})[0])
+
+        threads = [threading.Thread(target=client,
+                                    args=(reqs[i::SERVE_CLIENTS],))
+                   for i in range(SERVE_CLIENTS)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        srv_rps = rows / (time.perf_counter() - t0)
+        srv.stop(drain=True)
+        return srv_rps, seq_rps
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
 def preflight_device(attempts=2, timeout=240):
     """Bounded-time device-init probe in a SUBPROCESS, with one retry.
 
@@ -254,7 +341,7 @@ def main():
 
     # Each flagship is isolated: one failure records its diagnostic and
     # the rest still report (partial results beat a zeroed round).
-    ips = tps = pipe_ips = None
+    ips = tps = pipe_ips = serve = None
     try:
         ips = bench_resnet(pt, jax)
     except Exception as e:
@@ -267,6 +354,10 @@ def main():
         pipe_ips = bench_resnet_pipeline(pt, jax)
     except Exception as e:
         errors["resnet50_pipeline"] = f"{type(e).__name__}: {e}"[:500]
+    try:
+        serve = bench_serving(pt, jax)
+    except Exception as e:
+        errors["serving"] = f"{type(e).__name__}: {e}"[:500]
 
     ratios = []
     if ips is not None:
@@ -285,6 +376,11 @@ def main():
         if ips:
             result["resnet50_pipeline_fraction_of_synthetic"] = round(
                 pipe_ips / ips, 3)
+    if serve is not None:
+        srv_rps, seq_rps = serve
+        result["serving_batched_images_per_sec"] = round(srv_rps, 1)
+        result["serving_sequential_images_per_sec"] = round(seq_rps, 1)
+        result["serving_batching_speedup"] = round(srv_rps / seq_rps, 3)
     # the single driver number is the MIN of the two FLAGSHIP ratios
     # (docstring contract); it zeroes only when a flagship itself
     # failed — a failure in the auxiliary pipeline bench is reported in

@@ -26,6 +26,7 @@ from . import io  # noqa: F401
 from . import metric  # noqa: F401
 from . import vision  # noqa: F401
 from . import hapi  # noqa: F401
+from . import serving  # noqa: F401
 from .hapi import Model  # noqa: F401
 from .hapi.model import InputSpec  # noqa: F401
 from .hapi import callbacks  # noqa: F401

@@ -352,7 +352,15 @@ class DGCMetaOptimizer(MetaOptimizerBase):
     Pair with a plain SGD inner optimizer: the momentum correction
     lives INSIDE the dgc op's U accumulator (the reference's
     DGCMomentumOptimizer collapses both for the same reason — applying
-    an outer momentum too would double it)."""
+    an outer momentum too would double it).
+
+    Known simplification: the sparsity ratio is CONSTANT — only
+    ``dgc_configs["sparsity"][0]`` is honored.  The reference ramps
+    sparsity over ``rampup_step`` period steps (dgc_optimizer.py walks
+    the sparsity list as warmup progresses); until that period-sparsity
+    ramp lands here, pre-rampup steps pass dense grads through
+    untouched (see the ``dgc`` lowering's early-return contract) and
+    post-rampup steps jump straight to the final ratio."""
 
     def _can_apply(self):
         return self.user_strategy.dgc

@@ -4,11 +4,18 @@ RoleMaker's gloo bootstrap in the reference exchanges endpoints through
 this KV; here jax.distributed's coordination service is the primary
 rendezvous, but the KV server survives as transport for custom cluster
 glue (and is exercised by the test suite over real localhost HTTP).
+
+It also doubles as the serving layer's observability port: ``routes``
+maps a path (e.g. ``/stats``, ``/health``) to a zero-arg callable whose
+return value is served as JSON — GETs on a registered route never touch
+the KV store.
 """
 from __future__ import annotations
 
+import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import urlsplit
 
 
 class KVHandler(BaseHTTPRequestHandler):
@@ -19,6 +26,25 @@ class KVHandler(BaseHTTPRequestHandler):
         return self.path.lstrip("/")
 
     def do_GET(self):
+        # route match ignores the query string (scrapers send
+        # /stats?format=... and cache-busting /health?ts=...)
+        route = self.server.routes.get(urlsplit(self.path).path)
+        if route is not None:
+            try:
+                payload = route()
+                body = payload if isinstance(payload, bytes) \
+                    else json.dumps(payload).encode()
+                code = 200
+            except Exception as e:  # surface handler bugs as 500s
+                body = json.dumps({"error": f"{type(e).__name__}: {e}"}
+                                  ).encode()
+                code = 500
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
         with self.server.kv_lock:
             val = self.server.kv.get(self._key())
         if val is None:
@@ -48,18 +74,23 @@ class KVHandler(BaseHTTPRequestHandler):
 
 
 class KVHTTPServer(ThreadingHTTPServer):
-    def __init__(self, port, handler=KVHandler):
+    def __init__(self, port, handler=KVHandler, routes=None):
         super().__init__(("", port), handler)
         self.kv = {}
         self.kv_lock = threading.Lock()
+        self.routes = dict(routes or {})
 
 
 class KVServer:
     """Reference KVServer: start/stop a background KV HTTP server."""
 
-    def __init__(self, port, size=None):
-        self.http_server = KVHTTPServer(port, KVHandler)
+    def __init__(self, port, size=None, routes=None):
+        self.http_server = KVHTTPServer(port, KVHandler, routes=routes)
         self.listen_thread = None
+
+    def add_route(self, path: str, fn) -> None:
+        """Register ``path`` to serve ``fn()`` as JSON on GET."""
+        self.http_server.routes[path] = fn
 
     @property
     def port(self):

@@ -340,8 +340,9 @@ def build_pipeline_fn(program, mesh, feed_names, state_mut, state_const,
     import jax
     import jax.numpy as jnp
     from jax import lax
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from ..framework.jax_compat import shard_map
 
     from ..framework.lowering import (PSEUDO_OPS, LoweringContext,
                                       get_lowering)

@@ -268,6 +268,69 @@ class Executor:
         return list(fetches)
 
     # ------------------------------------------------------------------
+    def warmup(
+        self,
+        program: Optional[Program] = None,
+        feed_specs: Optional[Sequence[Dict]] = None,
+        fetch_list: Optional[Sequence] = None,
+        scope: Optional[Scope] = None,
+    ) -> int:
+        """AOT-compile one executable per feed spec (the serving layer's
+        warm start; reference AnalysisPredictor warms by running once —
+        here every shape bucket is warmed BEFORE traffic arrives).
+
+        ``feed_specs`` is an iterable of feed descriptions: each one a
+        dict mapping feed name -> ``(shape, dtype)`` (or a concrete
+        array used as-is).  Every spec is run once on zero-filled feeds
+        through the normal compile-cache path, so later ``run`` calls
+        with the same shapes are pure cache hits.  All scope variables
+        the warmup runs wrote — including the RNG key — are restored
+        afterwards: warmup is state-neutral.  Returns the number of
+        executables freshly compiled (0 if every spec was already
+        cached).
+        """
+        program = program if program is not None else default_main_program()
+        scope = scope if scope is not None else global_scope()
+        if fetch_list is None:
+            names = getattr(program, "_fetch_names", None)
+            if not names:
+                raise ValueError(
+                    "warmup needs fetch_list= (or a program that records "
+                    "its fetch contract, e.g. via load_inference_model)")
+            fetch_list = [program.global_block.var(n) for n in names]
+        n0 = len(self._cache)
+        # device arrays must be COPIED, not just re-referenced: the jitted
+        # step donates the state tuple (donate_argnums), so the warmup run
+        # deletes the live buffers and a shallow snapshot would restore
+        # dead arrays.  The whole scope CHAIN is snapshotted — state read
+        # through a parent scope is donated all the same.
+        snapshots = []
+        s = scope
+        while s is not None:
+            snapshots.append((s, {
+                k: (v.copy() if _is_jax_array(v) else v)
+                for k, v in s._vars.items()
+            }))
+            s = s._parent
+        try:
+            for spec in (feed_specs or []):
+                feed = {}
+                for name, sd in spec.items():
+                    if isinstance(sd, np.ndarray) or _is_jax_array(sd):
+                        feed[name] = sd
+                    else:
+                        shape, dtype = sd
+                        feed[name] = np.zeros(
+                            tuple(int(s) for s in shape), dtype)
+                self.run(program, feed=feed, fetch_list=fetch_list,
+                         scope=scope)
+        finally:
+            for s, snap in snapshots:
+                s._vars.clear()
+                s._vars.update(snap)
+        return len(self._cache) - n0
+
+    # ------------------------------------------------------------------
     def run_steps(
         self,
         program: Optional[Program] = None,
@@ -711,8 +774,9 @@ class Executor:
         """
         import jax
         from jax import lax
-        from jax import shard_map
         from jax.sharding import PartitionSpec as P
+
+        from .jax_compat import shard_map
 
         axis_names = tuple(mesh.axis_names)
         dp_axis = "dp" if "dp" in axis_names else axis_names[0]

@@ -1,0 +1,47 @@
+"""Version-compatibility shims over the installed jax.
+
+The TPU-native code targets the modern ``jax.shard_map`` entry point
+(with its ``check_vma`` flag); jax 0.4.x ships the same machinery as
+``jax.experimental.shard_map.shard_map`` with the flag named
+``check_rep``.  Importing through this module keeps every SPMD call
+site version-agnostic — without it, the whole distributed test tier
+dies on ``ImportError: cannot import name 'shard_map'`` under older
+jax.
+"""
+from __future__ import annotations
+
+import inspect
+
+import jax
+
+_impl = getattr(jax, "shard_map", None)
+if not callable(_impl):  # jax <= 0.4.x (or a module-shaped placeholder)
+    from jax.experimental.shard_map import shard_map as _impl
+
+# probe the flag spelling ONCE — a per-call try/except would swallow
+# unrelated TypeErrors (bad in_specs, ...) and re-raise a misleading
+# "unexpected keyword" instead of the real diagnostic
+try:
+    _params = inspect.signature(_impl).parameters
+except (TypeError, ValueError):  # C-level / exotic callable
+    _params = {}
+_CHECK_FLAG = ("check_vma" if "check_vma" in _params
+               else "check_rep" if "check_rep" in _params
+               else None)
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma=True):
+    kw = {_CHECK_FLAG: check_vma} if _CHECK_FLAG else {}
+    return _impl(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                 **kw)
+
+
+def axis_size(axis_name):
+    """``lax.axis_size`` (newer jax); older jax constant-folds
+    ``psum(1, axis)`` to the same static int inside shard_map."""
+    from jax import lax
+
+    fn = getattr(lax, "axis_size", None)
+    if fn is not None:
+        return fn(axis_name)
+    return lax.psum(1, axis_name)

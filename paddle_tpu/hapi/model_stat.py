@@ -90,8 +90,9 @@ def program_flops(program, detail=False):
     return total
 
 
-_DTYPE_BYTES = {"float32": 4, "float64": 4, "int32": 4, "int64": 4,
-                "float16": 2, "bfloat16": 2, "uint8": 1, "int8": 1,
+_DTYPE_BYTES = {"float32": 4, "float64": 8, "int32": 4, "int64": 8,
+                "uint32": 4, "uint64": 8, "float16": 2, "bfloat16": 2,
+                "int16": 2, "uint16": 2, "uint8": 1, "int8": 1,
                 "bool": 1}
 
 

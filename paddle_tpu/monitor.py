@@ -15,7 +15,7 @@ import threading
 from typing import Dict, List, Tuple
 
 __all__ = ["StatRegistry", "stat_add", "stat_get", "stat_reset",
-           "export_stats"]
+           "stat_set", "stat_max", "export_stats"]
 
 
 class _Stat:
@@ -29,6 +29,18 @@ class _Stat:
     def add(self, increment: int = 1) -> None:
         with self._lock:
             self._value += int(increment)
+
+    def set(self, value: int) -> None:
+        """Gauge semantics (queue depth, last-batch size, ...)."""
+        with self._lock:
+            self._value = int(value)
+
+    def max_update(self, value: int) -> None:
+        """High-water-mark semantics: keep the max ever seen."""
+        value = int(value)
+        with self._lock:
+            if value > self._value:
+                self._value = value
 
     def reset(self) -> None:
         with self._lock:
@@ -66,6 +78,12 @@ class StatRegistry:
     def add(self, name: str, increment: int = 1) -> None:
         self.stat(name).add(increment)
 
+    def set(self, name: str, value: int) -> None:
+        self.stat(name).set(value)
+
+    def max_update(self, name: str, value: int) -> None:
+        self.stat(name).max_update(value)
+
     def get(self, name: str) -> int:
         return self.stat(name).get()
 
@@ -88,6 +106,16 @@ class StatRegistry:
 def stat_add(name: str, increment: int = 1) -> None:
     """Reference STAT_ADD macro."""
     StatRegistry.instance().add(name, increment)
+
+
+def stat_set(name: str, value: int) -> None:
+    """Gauge write (queue depth, occupancy high-water marks use stat_max)."""
+    StatRegistry.instance().set(name, value)
+
+
+def stat_max(name: str, value: int) -> None:
+    """Keep the maximum ever observed for ``name``."""
+    StatRegistry.instance().max_update(name, value)
 
 
 def stat_get(name: str) -> int:

@@ -16,29 +16,41 @@ __all__ = ["weight_norm", "remove_weight_norm", "spectral_norm",
 
 
 def _norm_except(t, dim):
-    """||t|| over every axis except ``dim`` (keepdims), eager tensors."""
+    """||t|| over every axis except ``dim`` (keepdims); ``dim=None``
+    means the whole-tensor norm (scalar, reference norm_except_dim with
+    dim=-1), eager tensors."""
     import jax.numpy as jnp
 
     from ...dygraph.eager import apply_jax
 
     axes = tuple(i for i in range(len(t.shape)) if i != dim)
+    keep = dim is not None
     return apply_jax(
-        lambda v: jnp.sqrt(jnp.sum(v * v, axis=axes, keepdims=True)
+        lambda v: jnp.sqrt(jnp.sum(v * v, axis=axes, keepdims=keep)
                            + 1e-12), t)
+
+
+def _wn_dim(t, dim):
+    """Reference norm_except_dim convention: dim in (None, -1) selects
+    the whole-tensor norm (g is scalar); other negative dims count from
+    the back (dim % ndim)."""
+    if dim is None or dim == -1:
+        return None
+    return dim % len(t.shape) if dim < 0 else dim
 
 
 def weight_norm(layer, name="weight", dim=0):
     """Reference nn/utils/weight_norm_hook.py: w = g * v / ||v||, with
     g (per-``dim`` magnitude) and v (direction) as the trainable
-    parameters; recomputed on every forward."""
+    parameters; recomputed on every forward.  ``dim in (None, -1)``
+    normalizes the whole tensor (scalar g)."""
     from ...dygraph.layers import Parameter
 
     w = layer._parameters.get(name)
     if w is None:
         raise ValueError(f"layer has no parameter {name!r}")
-    if dim is None:
-        dim = -1  # whole-tensor norm convention: g is scalar-shaped
-    g0 = _norm_except(w, dim if dim >= 0 else 0)
+    dim = _wn_dim(w, dim)
+    g0 = _norm_except(w, dim)
     v = Parameter(w._value, name=w.name + "_v", trainable=True)
     g = Parameter(g0._value, name=w.name + "_g", trainable=True)
     del layer._parameters[name]
@@ -48,7 +60,7 @@ def weight_norm(layer, name="weight", dim=0):
     def compute(lyr):
         vv = lyr._parameters[name + "_v"]
         gg = lyr._parameters[name + "_g"]
-        w_new = gg * (vv / _norm_except(vv, dim if dim >= 0 else 0))
+        w_new = gg * (vv / _norm_except(vv, dim))
         object.__setattr__(lyr, name, w_new)
 
     def pre_hook(lyr, inputs):
@@ -85,8 +97,16 @@ def remove_weight_norm(layer, name="weight"):
 def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
                   dim=0):
     """Reference nn/utils/spectral_norm_hook.py: w / sigma_max(w), with
-    sigma estimated by power iteration on a persistent u buffer."""
+    sigma estimated by power iteration on a persistent u buffer.
+
+    The power-iteration vectors are DETACHED (stop_gradient) before
+    sigma = u^T W v, so gradients flow only through W — the reference
+    treats u/v as constants per step.  u is registered as a persistent
+    layer buffer (``{name}_u``), so it rides state_dict and survives
+    save/load instead of restarting the iteration from scratch.
+    """
     import jax.numpy as jnp
+    from jax import lax
 
     from ...dygraph.eager import apply_jax
     from ...dygraph.tensor import Tensor
@@ -96,7 +116,9 @@ def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
         raise ValueError(f"layer has no parameter {name!r}")
     h = int(w.shape[dim])
     rs = np.random.RandomState(0)
-    u_state = {"u": Tensor(rs.randn(h).astype("float32"))}
+    layer.register_buffer(name + "_u",
+                          Tensor(rs.randn(h).astype("float32")),
+                          persistable=True)
 
     def pre_hook(lyr, inputs):
         ww = lyr._parameters[name + "_orig"]
@@ -104,17 +126,27 @@ def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
         def sn(wv, uv):
             mat = jnp.moveaxis(wv, dim, 0).reshape(h, -1)
             u = uv
+            # the iteration runs on the detached weight: u/v are plain
+            # estimates, not part of the differentiated graph
+            mat_c = lax.stop_gradient(mat)
             for _ in range(n_power_iterations):
-                v = mat.T @ u
+                v = mat_c.T @ u
                 v = v / (jnp.linalg.norm(v) + eps)
-                u = mat @ v
+                u = mat_c @ v
                 u = u / (jnp.linalg.norm(u) + eps)
+            if n_power_iterations == 0:
+                # no update: sigma from the stored u and its derived v
+                v = mat_c.T @ u
+                v = v / (jnp.linalg.norm(v) + eps)
+            u = lax.stop_gradient(u)
+            v = lax.stop_gradient(v)
             sigma = u @ (mat @ v)
             return wv / sigma, u
 
-        w_new, u_new = apply_jax(sn, ww, u_state["u"], n_out=2)
-        u_state["u"] = Tensor(
-            __import__("jax").lax.stop_gradient(u_new._value))
+        w_new, u_new = apply_jax(sn, ww, lyr._buffers[name + "_u"],
+                                 n_out=2)
+        lyr._buffers[name + "_u"] = Tensor(
+            lax.stop_gradient(u_new._value))
         object.__setattr__(lyr, name, w_new)
         return None
 

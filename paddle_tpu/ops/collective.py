@@ -278,12 +278,17 @@ def _dgc(ctx, op):
         mask = |v| among the top-k   (k = ratio * numel, static)
         encoded = v * mask;  v' = v*(1-mask);  u' = u*(1-mask)
 
-    Pre-rampup steps (CurrentStep < rampup_begin_step) pass the dense
-    grad through untouched.  TPU-native note: the reference ships k
-    (value,index) pairs over NCCL; XLA collectives are dense, so the
-    masked-dense tensor rides the normal psum — convergence semantics
-    (what DGC is for) are identical, and the top-k stays a static-shape
-    lax.top_k the MXU pipeline can schedule."""
+    Pre-rampup steps (CurrentStep < rampup_begin_step) are a pure
+    early-return (reference dgc_op.h): the dense grad passes through
+    and U/V are left UNCHANGED — accumulating "warmup momentum" into U
+    during passthrough would double-apply those gradients the moment
+    compression engages (once via the dense grads already consumed by
+    the optimizer, once via the accumulated U flushing into V).
+    TPU-native note: the reference ships k (value,index) pairs over
+    NCCL; XLA collectives are dense, so the masked-dense tensor rides
+    the normal psum — convergence semantics (what DGC is for) are
+    identical, and the top-k stays a static-shape lax.top_k the MXU
+    pipeline can schedule."""
     g = ctx.in1(op, "Grad")
     u = ctx.in1(op, "U")
     v = ctx.in1(op, "V")
@@ -302,9 +307,8 @@ def _dgc(ctx, op):
         else jnp.asarray(True)
     encoded = jnp.where(engaged, v_new * mask, g)
     keep = 1.0 - mask
-    ctx.set_out(op, "U_out", jnp.where(engaged, u_new * keep, u_new))
-    ctx.set_out(op, "V_out", jnp.where(engaged, v_new * keep,
-                                       jnp.zeros_like(v_new)))
+    ctx.set_out(op, "U_out", jnp.where(engaged, u_new * keep, u))
+    ctx.set_out(op, "V_out", jnp.where(engaged, v_new * keep, v))
     ctx.set_out(op, "EncodeGrad", encoded)
     ctx.set_out(op, "Grad_out", encoded)
     if ctx.out_name(op, "GatherBuff"):

@@ -23,14 +23,26 @@ _state = {"running": False, "dir": None, "t0": None}
 class RecordEvent:
     """Scoped host-side annotation (reference platform/profiler.cc:53).
 
-    Usable as a context manager or via explicit begin()/end().  Shows up
-    as a named span on the profiler timeline when a capture is active;
-    costs ~nothing when no capture is running.
+    Usable as a context manager, via explicit begin()/end(), or as a
+    function decorator (``@RecordEvent("serving/batch")`` wraps every
+    call of the function in its own span).  Shows up as a named span on
+    the profiler timeline when a capture is active; costs ~nothing when
+    no capture is running.
     """
 
     def __init__(self, name: str):
         self.name = name
         self._ann = None
+
+    def __call__(self, fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            with RecordEvent(self.name):
+                return fn(*args, **kwargs)
+
+        return wrapped
 
     def begin(self):
         import jax

@@ -1,0 +1,30 @@
+"""paddle_tpu.serving — dynamic-batching TPU inference serving.
+
+Role parity: Paddle Serving / the reference's server-side inference
+deployment story, rebuilt TPU-native over the compile-once Predictor:
+
+- shape buckets (buckets.py) pin the executable universe so the
+  Executor compile cache never storms under variable-length traffic;
+- a dynamic micro-batcher (batcher.py) coalesces concurrent requests
+  into padded bucket batches with bounded-queue backpressure and
+  per-request deadlines;
+- ``Server`` (server.py) AOT-warms every bucket at start, serves
+  ``/stats`` + ``/health`` over the fleet KV HTTP server, and drains
+  gracefully on stop.
+"""
+from .batcher import Batcher, InferenceRequest  # noqa: F401
+from .buckets import (  # noqa: F401
+    BucketSpec,
+    DeadlineExceededError,
+    QueueFullError,
+    RequestTooLargeError,
+    ServerClosedError,
+    ServingError,
+)
+from .server import Server, ServingConfig  # noqa: F401
+
+__all__ = [
+    "Batcher", "BucketSpec", "DeadlineExceededError", "InferenceRequest",
+    "QueueFullError", "RequestTooLargeError", "Server", "ServerClosedError",
+    "ServingConfig", "ServingError",
+]

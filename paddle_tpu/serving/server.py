@@ -1,0 +1,191 @@
+"""`serving.Server`: dynamic-batching inference server over a Predictor.
+
+Role parity: the reference splits AnalysisPredictor (compile + run)
+from Paddle Serving (batching, health, metrics); this module is that
+serving layer rebuilt TPU-native on three pieces that already exist —
+the compile-once ``inference.Predictor``, the ``Executor`` compile
+cache (now pre-warmed per shape bucket via ``Executor.warmup``), and
+``monitor.StatRegistry`` for runtime counters.
+
+Lifecycle::
+
+    srv = serving.Server(model_dir, serving.ServingConfig(
+        batch_sizes=(1, 2, 4, 8), seq_lens=(16, 32), http_port=0))
+    srv.start()                  # AOT-warms every bucket, then serves
+    outs = srv.infer({"x": x})   # thread-safe, blocks for the result
+    srv.stop(drain=True)         # refuse new work, finish the queue
+
+``http_port`` exposes GET ``/stats`` (counter snapshot) and
+``/health`` (liveness + queue depth) through the fleet KV HTTP server.
+"""
+from __future__ import annotations
+
+import logging
+import time
+from typing import Dict, Optional, Sequence
+
+from ..monitor import stat_add, stat_get
+from .batcher import _UNSET, Batcher, InferenceRequest
+from .buckets import BucketSpec, bucket_feed_specs, feed_plans
+
+logger = logging.getLogger(__name__)
+
+
+class ServingConfig:
+    """Knobs for the serving layer (reference Paddle Serving's
+    server-config proto, collapsed to what the TPU path needs)."""
+
+    def __init__(self,
+                 batch_sizes: Sequence[int] = (1, 2, 4, 8),
+                 seq_lens: Sequence[int] = None,
+                 max_queue: int = 128,
+                 batch_window_ms: float = 5.0,
+                 default_deadline_ms: Optional[float] = None,
+                 pad_value=0,
+                 http_port: Optional[int] = None):
+        self.bucket_spec = BucketSpec(batch_sizes, seq_lens)
+        self.max_queue = int(max_queue)
+        self.batch_window_ms = float(batch_window_ms)
+        self.default_deadline_ms = default_deadline_ms
+        self.pad_value = pad_value
+        self.http_port = http_port  # None: no HTTP; 0: ephemeral port
+
+
+class Server:
+    """Batches concurrent ``infer`` calls through one Predictor."""
+
+    def __init__(self, model, config: Optional[ServingConfig] = None):
+        from ..inference import Config as InferConfig
+        from ..inference import Predictor
+
+        if isinstance(model, Predictor):
+            predictor = model
+        elif isinstance(model, (InferConfig, str)):
+            predictor = Predictor(model)
+        else:
+            raise TypeError(
+                f"model must be a Predictor, inference.Config, or model "
+                f"dir path, got {type(model).__name__}")
+        self._predictor = predictor
+        self._config = config or ServingConfig()
+        self._plans = feed_plans(predictor._program,
+                                 predictor.get_input_names())
+        self._batcher = Batcher(
+            self._run_batch, self._plans, self._config.bucket_spec,
+            max_queue=self._config.max_queue,
+            batch_window_ms=self._config.batch_window_ms,
+            default_deadline_ms=self._config.default_deadline_ms,
+            pad_value=self._config.pad_value)
+        self._kv = None
+        self._t_start = None
+        self._started = False
+
+    # -- execution -------------------------------------------------------
+    def _run_batch(self, feeds):
+        # single-threaded by construction (the batcher's one consumer):
+        # the Predictor/Executor pair is not re-entrant
+        return self._predictor.run(feeds)
+
+    # -- lifecycle -------------------------------------------------------
+    def warmup(self) -> int:
+        """AOT-compile every bucket's executable; returns fresh-compile
+        count.  Serving traffic after warmup only ever cache-hits."""
+        specs, open_ended = bucket_feed_specs(
+            self._plans, self._config.bucket_spec)
+        if open_ended:
+            logger.warning(
+                "serving warmup skipped: the model has dynamic inner "
+                "dims but no seq_lens are configured (exact-shape mode "
+                "compiles per distinct shape, on demand)")
+            return 0
+        n = self._predictor._exe.warmup(
+            self._predictor._program, specs,
+            fetch_list=self._predictor._fetch_targets,
+            scope=self._predictor._scope)
+        stat_add("serving_warmup_compiles", n)
+        return n
+
+    def start(self, warmup: bool = True) -> "Server":
+        if self._started:
+            return self
+        if warmup:
+            self.warmup()
+        self._batcher.start()
+        if self._config.http_port is not None:
+            from ..distributed.fleet.utils.http_server import KVServer
+
+            self._kv = KVServer(self._config.http_port,
+                                routes={"/stats": self.stats,
+                                        "/health": self.health})
+            self._kv.start()
+        self._t_start = time.monotonic()
+        self._started = True
+        return self
+
+    def stop(self, drain: bool = True):
+        self._batcher.stop(drain=drain)
+        if self._kv is not None:
+            self._kv.stop()
+            self._kv = None
+        self._started = False
+
+    def __enter__(self) -> "Server":
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop(drain=exc[0] is None)  # error exit: don't drain
+        return False
+
+    # -- request path ----------------------------------------------------
+    def infer(self, feeds: Dict, deadline_ms=_UNSET):
+        """Blocking inference; safe to call from many threads.  Returns
+        the fetch list with exactly the caller's BATCH rows (batch
+        padding is invisible; a fetch that retains a dynamic inner dim
+        comes back padded to its seq bucket — reduce or mask in-model,
+        or slice client-side with the request's true length).  Raises
+        QueueFullError / DeadlineExceededError / RequestTooLargeError
+        per the backpressure contract."""
+        return self._batcher.infer(feeds, deadline_ms=deadline_ms)
+
+    def submit(self, feeds: Dict, deadline_ms=_UNSET) -> InferenceRequest:
+        """Async variant: returns a future-like InferenceRequest."""
+        return self._batcher.submit(feeds, deadline_ms=deadline_ms)
+
+    # -- observability ---------------------------------------------------
+    @property
+    def http_port(self) -> Optional[int]:
+        return self._kv.port if self._kv is not None else None
+
+    def stats(self) -> Dict[str, float]:
+        """Snapshot of the serving/executor counters plus derived
+        averages (served over GET /stats)."""
+        from ..monitor import export_stats
+
+        out = {n: v for n, v in export_stats()
+               if n.startswith("serving_") or n.startswith("executor_")}
+        completed = out.get("serving_completed", 0)
+        if completed:
+            out["serving_latency_ms_avg"] = round(
+                out.get("serving_latency_us_total", 0) / completed / 1e3,
+                3)
+        batches = out.get("serving_batches", 0)
+        if batches:
+            out["serving_batch_occupancy_avg"] = round(
+                out.get("serving_batched_requests", 0) / batches, 3)
+            rows = out.get("serving_batched_rows", 0)
+            out["serving_padding_fraction"] = round(
+                out.get("serving_padded_rows", 0)
+                / max(rows + out.get("serving_padded_rows", 0), 1), 3)
+        return out
+
+    def health(self) -> Dict:
+        depth = self._batcher.queue_depth
+        return {
+            "status": "ok" if self._started else "stopped",
+            "queue_depth": depth,
+            "queue_capacity": self._config.max_queue,
+            "uptime_s": round(time.monotonic() - self._t_start, 3)
+            if self._t_start is not None else 0.0,
+            "buckets": self._config.bucket_spec.n_buckets(),
+            "compiles": stat_get("executor_compile"),
+        }

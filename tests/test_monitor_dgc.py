@@ -130,6 +130,70 @@ class TestDGC:
                 w_hist[t] - w_hist[t + 1], enc_oracle[t],
                 rtol=1e-4, atol=1e-5)
 
+    def test_dgc_pre_rampup_is_pure_passthrough(self):
+        """Before rampup_begin_step the op is an early return (reference
+        dgc_op.h): dense grad through, U/V untouched — so the first
+        ENGAGED step must match an oracle whose accumulators start from
+        zero.  The old behavior (warmup momentum accumulated into U
+        during passthrough) double-applies those gradients at
+        engagement."""
+        from paddle_tpu.distributed import fleet
+
+        main, startup = Program(), Program()
+        main.random_seed = 11
+        from paddle_tpu.framework import unique_name
+        from paddle_tpu.initializer import ConstantInitializer
+        from paddle_tpu.param_attr import ParamAttr
+
+        with unique_name.guard(), program_guard(main, startup):
+            x = layers.data("x", [6])
+            y = layers.data("y", [1])
+            pred = layers.fc(x, 1, param_attr=ParamAttr(
+                initializer=ConstantInitializer(0.0)), bias_attr=False)
+            loss = layers.mean(layers.square_error_cost(pred, y))
+            strat = fleet.DistributedStrategy()
+            strat.dgc = True
+            # the step counter increments BEFORE the dgc op, so run t
+            # sees step=t+1: rampup_begin_step=3 -> runs 0,1 pass
+            # through, run 2 onward engages
+            strat.dgc_configs = {"sparsity": [0.5],
+                                 "rampup_begin_step": 3}
+            fleet.init(is_collective=True, strategy=strat)
+            fleet.distributed_optimizer(SGDOptimizer(learning_rate=1.0))
+            fleet.minimize(loss)
+
+        rng = np.random.RandomState(4)
+        X = rng.randn(8, 6).astype("f4")
+        Y = np.zeros((8, 1), "f4")
+        scope = pt.framework.Scope()
+        exe = pt.Executor(pt.CPUPlace())
+        exe.run(startup, scope=scope)
+
+        w_name = [p.name for p in main.all_parameters()][0]
+        w_hist = [np.asarray(scope.find_var(w_name).get_tensor()).copy()]
+        g_seq = []
+        for _ in range(4):
+            w = w_hist[-1]
+            g_seq.append((2.0 / X.shape[0]) * X.T @ (X @ w - Y))
+            exe.run(main, feed={"x": X, "y": Y}, fetch_list=[loss],
+                    scope=scope)
+            w_hist.append(
+                np.asarray(scope.find_var(w_name).get_tensor()).copy())
+
+        # passthrough runs 0..1: the DENSE grad reached the optimizer
+        for t in (0, 1):
+            np.testing.assert_allclose(
+                w_hist[t] - w_hist[t + 1], g_seq[t],
+                rtol=1e-4, atol=1e-5, err_msg=f"passthrough step {t}")
+        # engaged runs 2..3: oracle accumulators start from ZERO (no
+        # warmup momentum leaked out of the passthrough phase)
+        enc = _dgc_oracle(g_seq[2:], m=0.9, ratio=0.5,
+                          shape=g_seq[0].shape)
+        for i, t in enumerate((2, 3)):
+            np.testing.assert_allclose(
+                w_hist[t] - w_hist[t + 1], enc[i],
+                rtol=1e-4, atol=1e-5, err_msg=f"engaged step {t}")
+
     def test_dgc_trains(self):
         from paddle_tpu.distributed import fleet
 

@@ -41,6 +41,43 @@ def test_remove_weight_norm_bakes_value():
                                    rtol=1e-5)
 
 
+def test_weight_norm_dim_none_is_whole_tensor_norm():
+    """dim in (None, -1): one scalar g over the whole tensor (reference
+    norm_except_dim with dim=-1); forward still reproduces the original
+    weight at init."""
+    for dim in (None, -1):
+        with dygraph.guard():
+            lyr = nn.Linear(4, 3)
+            w0 = np.asarray(lyr.weight._value).copy()
+            nn.utils.weight_norm(lyr, name="weight", dim=dim)
+            g = lyr._parameters["weight_g"]
+            assert int(np.prod(g.shape)) == 1, g.shape
+            np.testing.assert_allclose(
+                float(np.asarray(g._value).reshape(())),
+                np.sqrt((w0 * w0).sum() + 1e-12), rtol=1e-6)
+            x = pt.to_tensor(np.ones((2, 4), "f4"))
+            y = np.asarray(lyr(x)._value)
+            ref = np.ones((2, 4), "f4") @ w0 + np.asarray(lyr.bias._value)
+            np.testing.assert_allclose(y, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_weight_norm_negative_dim_counts_from_back():
+    """dim=-2 on a rank-2 weight == dim 0 (dim % ndim), NOT whole-tensor."""
+    with dygraph.guard():
+        lyr = nn.Linear(4, 3)
+        w0 = np.asarray(lyr.weight._value).copy()
+        nn.utils.weight_norm(lyr, name="weight", dim=-2)
+        g = np.asarray(lyr._parameters["weight_g"]._value)
+        assert g.shape == (4, 1), g.shape  # per-dim-0 magnitudes
+        np.testing.assert_allclose(
+            g, np.sqrt((w0 * w0).sum(axis=1, keepdims=True) + 1e-12),
+            rtol=1e-6)
+        x = pt.to_tensor(np.ones((2, 4), "f4"))
+        ref = np.ones((2, 4), "f4") @ w0 + np.asarray(lyr.bias._value)
+        np.testing.assert_allclose(np.asarray(lyr(x)._value), ref,
+                                   rtol=1e-5, atol=1e-6)
+
+
 def test_spectral_norm_unit_top_singular_value():
     with dygraph.guard():
         lyr = nn.Linear(6, 5)
@@ -50,6 +87,56 @@ def test_spectral_norm_unit_top_singular_value():
         w = np.asarray(lyr.weight._value)
         s = np.linalg.svd(w, compute_uv=False)
         assert abs(s.max() - 1.0) < 1e-3, s.max()
+
+
+def test_spectral_norm_grad_treats_uv_as_constants():
+    """The power-iteration vectors are detached: for L = sum(W/sigma),
+    dL/dW must equal 1/sigma - (sum(W)/sigma^2) * u v^T with u, v the
+    post-iteration constants (reference spectral_norm_hook semantics)."""
+    with dygraph.guard():
+        lyr = nn.Linear(6, 5, bias_attr=False)
+        W = np.asarray(lyr.weight._value).copy()
+        nn.utils.spectral_norm(lyr, n_power_iterations=1)
+        x = pt.to_tensor(np.eye(6, dtype="f4"))
+        lyr(x).sum().backward()
+        got = np.asarray(lyr._parameters["weight_orig"].grad._value)
+
+        # numpy oracle with the SAME u0 (seeded buffer init) and one
+        # power iteration, u/v held constant in the differentiation
+        eps = 1e-12
+        u = np.random.RandomState(0).randn(6).astype("f4")
+        v = W.T @ u
+        v = v / (np.linalg.norm(v) + eps)
+        u = W @ v
+        u = u / (np.linalg.norm(u) + eps)
+        sigma = u @ W @ v
+        want = np.full_like(W, 1.0 / sigma) \
+            - (W.sum() / sigma**2) * np.outer(u, v)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_spectral_norm_u_is_persistent_buffer():
+    """u rides state_dict (reference registers it as a buffer), so the
+    power-iteration state survives save/load instead of restarting."""
+    with dygraph.guard():
+        lyr = nn.Linear(6, 5)
+        nn.utils.spectral_norm(lyr)
+        x = pt.to_tensor(np.eye(6, dtype="f4"))
+        for _ in range(5):
+            lyr(x)  # advance the power iteration
+        sd = lyr.state_dict()
+        assert "weight_u" in sd
+        u_trained = np.asarray(sd["weight_u"]._value).copy()
+
+        lyr2 = nn.Linear(6, 5)
+        nn.utils.spectral_norm(lyr2)
+        missing, unexpected = lyr2.set_state_dict(sd)
+        assert not missing and not unexpected, (missing, unexpected)
+        np.testing.assert_allclose(
+            np.asarray(lyr2._buffers["weight_u"]._value), u_trained)
+        np.testing.assert_allclose(np.asarray(lyr2(x)._value),
+                                   np.asarray(lyr(x)._value),
+                                   rtol=1e-6)
 
 
 def test_parameters_vector_roundtrip():

@@ -1,0 +1,316 @@
+"""paddle_tpu.serving: dynamic-batching inference server.
+
+Pins the ISSUE-1 acceptance contract: ≥32 concurrent variable-length
+clients get results numerically equal to direct Predictor.run; the
+executor compiles at most one executable per configured shape bucket
+(no compile storm); at least one batch coalesces multiple requests;
+deadline-expired requests error instead of blocking the queue; a full
+queue rejects with explicit backpressure; shutdown drains gracefully.
+"""
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers, serving
+from paddle_tpu.fluid import io as fluid_io
+from paddle_tpu.framework import unique_name
+from paddle_tpu.framework.program import Program, program_guard
+from paddle_tpu.framework.scope import _switch_scope
+from paddle_tpu.monitor import stat_get, stat_reset
+
+N_CLIENTS = 32
+BATCH_SIZES = (1, 2, 4, 8)
+SEQ_LENS = (8, 16)
+N_BUCKETS = len(BATCH_SIZES) * len(SEQ_LENS)
+
+
+@pytest.fixture(scope="module")
+def model_dir(tmp_path_factory):
+    """A padding-invariant variable-length model: relu(x@W) summed over
+    the seq dim — padded rows/positions contribute exactly zero, so
+    bucket padding must be invisible in the results."""
+    d = str(tmp_path_factory.mktemp("serving") / "model")
+    main, startup = Program(), Program()
+    main.random_seed = 7
+    with unique_name.guard(), program_guard(main, startup):
+        x = layers.data("x", [-1, 4])  # declared [-1, -1, 4]
+        h = layers.fc(x, 8, num_flatten_dims=2, act="relu",
+                      bias_attr=False)
+        out = layers.reduce_sum(h, dim=1)
+    sc = pt.framework.Scope()
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup, scope=sc)
+    old = _switch_scope(sc)
+    try:
+        fluid_io.save_inference_model(d, ["x"], [out], exe, main)
+    finally:
+        _switch_scope(old)
+    return d
+
+
+def _requests(n=N_CLIENTS, seed=0):
+    rs = np.random.RandomState(seed)
+    return [rs.randn(1 + rs.randint(4), 1 + rs.randint(SEQ_LENS[-1]),
+                     4).astype("f4") for _ in range(n)]
+
+
+def _server(model_dir, **overrides):
+    kw = dict(batch_sizes=BATCH_SIZES, seq_lens=SEQ_LENS,
+              batch_window_ms=30.0, max_queue=64)
+    kw.update(overrides)
+    return serving.Server(model_dir, serving.ServingConfig(**kw))
+
+
+class TestBuckets:
+    def test_bucket_selection_and_bounds(self):
+        spec = serving.BucketSpec((1, 2, 4, 8), (8, 16))
+        assert spec.batch_bucket(3) == 4
+        assert spec.batch_bucket(8) == 8
+        assert spec.seq_bucket(1) == 8
+        assert spec.seq_bucket(9) == 16
+        assert spec.n_buckets() == 8
+        with pytest.raises(serving.RequestTooLargeError):
+            spec.batch_bucket(9)
+        with pytest.raises(serving.RequestTooLargeError):
+            spec.seq_bucket(17)
+
+    def test_exact_shape_mode_passthrough(self):
+        spec = serving.BucketSpec((1, 4), None)
+        assert spec.seq_bucket(13) == 13  # no inner padding configured
+
+
+class TestServing:
+    def test_concurrent_parity_bounded_compiles_and_coalescing(
+            self, model_dir):
+        """The acceptance-criteria test: 32 concurrent mixed-length
+        clients, parity with direct Predictor.run, compile count ≤
+        bucket count, and real multi-request batches."""
+        from paddle_tpu.inference import Config, create_predictor
+
+        reqs = _requests()
+        # sequential oracle FIRST (its per-shape compiles must not be
+        # attributed to the serving path)
+        ref_pred = create_predictor(Config(model_dir))
+        refs = [np.asarray(ref_pred.run({"x": r})[0]) for r in reqs]
+
+        srv = _server(model_dir)
+        stat_reset()
+        srv.start()  # AOT warmup compiles every bucket up front
+        warm = stat_get("executor_compile")
+        assert 0 < warm <= N_BUCKETS, warm
+        assert stat_get("serving_warmup_compiles") == warm
+
+        results = [None] * len(reqs)
+        errors = [None] * len(reqs)
+
+        def client(i):
+            try:
+                results[i] = srv.infer({"x": reqs[i]})
+            except Exception as e:  # noqa: BLE001 — assert below
+                errors[i] = e
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(len(reqs))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        srv.stop(drain=True)
+
+        assert not any(errors), [e for e in errors if e]
+        for got, ref in zip(results, refs):
+            np.testing.assert_allclose(np.asarray(got[0]), ref,
+                                       rtol=1e-5, atol=1e-6)
+        # no compile storm: warmup covered every shape traffic produced
+        assert stat_get("executor_compile") <= N_BUCKETS
+        assert len(srv._predictor._exe._cache) <= N_BUCKETS
+        # the batcher actually coalesced concurrent requests
+        assert stat_get("serving_max_batch_occupancy") > 1
+        assert stat_get("serving_batches") < N_CLIENTS
+        assert stat_get("serving_completed") == N_CLIENTS
+
+    def test_deadline_expiry_does_not_block_queue(self, model_dir):
+        srv = _server(model_dir).start()
+        try:
+            with pytest.raises(serving.DeadlineExceededError):
+                srv.infer({"x": _requests(1)[0]}, deadline_ms=0.0)
+            assert stat_get("serving_deadline_exceeded") >= 1
+            # the queue is alive: a normal request still completes
+            out = srv.infer({"x": np.ones((2, 3, 4), "f4")})
+            assert np.asarray(out[0]).shape == (2, 8)
+        finally:
+            srv.stop()
+
+    def test_deadline_lapsing_during_window_is_reaped_at_dequeue(
+            self, model_dir):
+        """A request whose deadline expires WHILE the batcher waits out
+        the coalescing window must error, not execute: an async client
+        that only calls result() later would otherwise get data for a
+        request it contractually abandoned (and the chip does the
+        work)."""
+        srv = _server(model_dir, batch_window_ms=300.0).start()
+        try:
+            req = srv.submit({"x": np.ones((1, 3, 4), "f4")},
+                             deadline_ms=30.0)
+            time.sleep(0.5)  # well past the window: dequeue happened
+            with pytest.raises(serving.DeadlineExceededError):
+                req.result()
+            assert stat_get("serving_deadline_exceeded") >= 1
+        finally:
+            srv.stop()
+
+    def test_queue_full_backpressure(self, model_dir):
+        srv = _server(model_dir, max_queue=3).start()
+        try:
+            srv._batcher.pause()  # hold the consumer: queue must fill
+            pending = [srv.submit({"x": np.ones((1, 3, 4), "f4")})
+                       for _ in range(3)]
+            with pytest.raises(serving.QueueFullError):
+                srv.submit({"x": np.ones((1, 3, 4), "f4")})
+            assert stat_get("serving_rejected_queue_full") >= 1
+            srv._batcher.resume()
+            for req in pending:  # backlog drains once resumed
+                assert np.asarray(req.result()[0]).shape == (1, 8)
+        finally:
+            srv.stop()
+
+    def test_graceful_drain_and_closed_rejection(self, model_dir):
+        srv = _server(model_dir).start()
+        pending = [srv.submit({"x": np.ones((1, 5, 4), "f4")})
+                   for _ in range(4)]
+        srv.stop(drain=True)  # finishes queued work before returning
+        for req in pending:
+            assert np.asarray(req.result()[0]).shape == (1, 8)
+        with pytest.raises(serving.ServerClosedError):
+            srv.submit({"x": np.ones((1, 5, 4), "f4")})
+
+    def test_server_restarts_after_stop(self, model_dir):
+        """stop() is not terminal: a restarted server serves again
+        (the batcher clears its closing flag on start)."""
+        srv = _server(model_dir).start()
+        srv.infer({"x": np.ones((1, 3, 4), "f4")})
+        srv.stop(drain=True)
+        srv.start()
+        try:
+            out = srv.infer({"x": np.ones((2, 3, 4), "f4")})
+            assert np.asarray(out[0]).shape == (2, 8)
+        finally:
+            srv.stop()
+
+    def test_request_too_large_and_contract_violations(self, model_dir):
+        srv = _server(model_dir).start()
+        try:
+            with pytest.raises(serving.RequestTooLargeError):
+                srv.infer({"x": np.ones((9, 3, 4), "f4")})  # batch > 8
+            with pytest.raises(serving.RequestTooLargeError):
+                srv.infer({"x": np.ones((1, 17, 4), "f4")})  # seq > 16
+            with pytest.raises(ValueError):
+                srv.infer({"x": np.ones((1, 3, 5), "f4")})  # fixed dim
+            with pytest.raises(KeyError):
+                srv.infer({"not_x": np.ones((1, 3, 4), "f4")})
+        finally:
+            srv.stop()
+
+    def test_stats_and_health_http_endpoints(self, model_dir):
+        srv = _server(model_dir, http_port=0).start()
+        try:
+            srv.infer({"x": np.ones((2, 3, 4), "f4")})
+            port = srv.http_port
+            stats = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/stats", timeout=10).read())
+            health = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/health", timeout=10).read())
+            assert stats["serving_completed"] >= 1
+            assert "serving_latency_ms_avg" in stats
+            assert health["status"] == "ok"
+            assert health["queue_depth"] == 0
+            assert health["buckets"] == N_BUCKETS
+        finally:
+            srv.stop()
+
+
+class TestWarmup:
+    def test_executor_warmup_is_state_neutral_and_counts(self, model_dir):
+        """Executor.warmup compiles each spec once, later runs are pure
+        cache hits, and the scope (incl. RNG) is byte-identical after."""
+        from paddle_tpu.inference import Config, create_predictor
+
+        pred = create_predictor(Config(model_dir))
+        exe, scope, prog = pred._exe, pred._scope, pred._program
+        before = {n: np.asarray(scope.get_var(n)).copy()
+                  for n in scope.local_var_names()
+                  if scope.get_var(n) is not None
+                  and not callable(scope.get_var(n))}
+        specs = [{"x": ((b, s, 4), "float32")}
+                 for b in (1, 2) for s in (8, 16)]
+        n = exe.warmup(prog, specs, fetch_list=pred._fetch_targets,
+                       scope=pred._scope)
+        assert n == 4
+        # idempotent: same specs are all cache hits
+        assert exe.warmup(prog, specs, fetch_list=pred._fetch_targets,
+                          scope=pred._scope) == 0
+        after = {n_: np.asarray(scope.get_var(n_))
+                 for n_ in before}
+        for k in before:
+            np.testing.assert_array_equal(before[k], after[k])
+        # a live run with a warmed shape does not compile
+        stat_reset()
+        pred.run({"x": np.zeros((2, 16, 4), "f4")})
+        assert stat_get("executor_compile") == 0
+        assert stat_get("executor_cache_hit") == 1
+
+    def test_warmup_requires_fetch_contract(self):
+        exe = pt.Executor(pt.CPUPlace())
+        with pytest.raises(ValueError, match="fetch"):
+            exe.warmup(Program(), [{"x": ((1, 4), "float32")}])
+
+    def test_warmup_survives_donated_training_state(self):
+        """A training program's jitted step DONATES its state buffers;
+        warmup must deep-copy the snapshot or the restore resurrects
+        deleted arrays and the scope is corrupted."""
+        from paddle_tpu.optimizer import SGDOptimizer
+
+        main, startup = Program(), Program()
+        main.random_seed = 5
+        with unique_name.guard(), program_guard(main, startup):
+            x = layers.data("x", [4])
+            y = layers.data("y", [1])
+            loss = layers.mean(layers.square_error_cost(
+                layers.fc(x, 1, bias_attr=False), y))
+            SGDOptimizer(learning_rate=0.1).minimize(loss)
+        sc = pt.framework.Scope()
+        exe = pt.Executor(pt.CPUPlace())
+        exe.run(startup, scope=sc)
+        feed = {"x": np.ones((2, 4), "f4"), "y": np.zeros((2, 1), "f4")}
+        exe.run(main, feed=feed, fetch_list=[loss], scope=sc)
+
+        w = main.all_parameters()[0].name
+        before = np.asarray(sc.find_var(w).get_tensor()).copy()
+        assert exe.warmup(
+            main, [{"x": ((8, 4), "float32"), "y": ((8, 1), "float32")}],
+            fetch_list=[loss], scope=sc) == 1
+        np.testing.assert_array_equal(
+            np.asarray(sc.find_var(w).get_tensor()), before)
+        # the scope is alive: training continues after warmup
+        out = exe.run(main, feed=feed, fetch_list=[loss], scope=sc)
+        assert np.isfinite(np.asarray(out[0])).all()
+
+
+class TestMonitorGauges:
+    def test_stat_set_and_stat_max(self):
+        from paddle_tpu.monitor import stat_max, stat_set
+
+        stat_reset("g_depth")
+        stat_set("g_depth", 7)
+        assert stat_get("g_depth") == 7
+        stat_set("g_depth", 3)
+        assert stat_get("g_depth") == 3
+        stat_reset("g_hwm")
+        stat_max("g_hwm", 5)
+        stat_max("g_hwm", 2)
+        assert stat_get("g_hwm") == 5
