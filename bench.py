@@ -290,6 +290,39 @@ def bench_serving(pt, jax):
         shutil.rmtree(d, ignore_errors=True)
 
 
+FUSION_NRANKS = 4
+
+
+def bench_allreduce_fusion(pt):
+    """Comm-op count pre/post the fused-allreduce graph pass
+    (framework/passes.py) on the ResNet-50 train program transpiled for
+    FUSION_NRANKS-way data parallelism.  Host-side graph work only — no
+    device time — so the bench trajectory records the collective count
+    the pass achieves, not just throughput."""
+    from paddle_tpu.framework import passes as passes_mod
+    from paddle_tpu.framework.program import program_guard
+    from paddle_tpu.distributed.fleet.collective_transpiler import (
+        GradAllReduce)
+    from paddle_tpu.vision.static_models import resnet50_train_program
+
+    main_p, startup, _, loss, opt = resnet50_train_program(
+        lr=0.1, momentum=0.9)
+    with program_guard(main_p, startup):
+        opt.minimize(loss)
+    GradAllReduce(FUSION_NRANKS, fuse_all_reduce=True).transpile(
+        main_p, loss_grad_name=loss.name + "@GRAD")
+
+    def n_allreduce(p):
+        return sum(1 for op in p.global_block.ops
+                   if op.type == "c_allreduce_sum")
+
+    pre = n_allreduce(main_p)
+    fused = passes_mod.FuseAllReducePass()
+    work = main_p.clone()
+    fused.apply(work, passes_mod.PassContext())
+    return pre, n_allreduce(work)
+
+
 def preflight_device(attempts=2, timeout=240):
     """Bounded-time device-init probe in a SUBPROCESS, with one retry.
 
@@ -342,6 +375,12 @@ def main():
     # Each flagship is isolated: one failure records its diagnostic and
     # the rest still report (partial results beat a zeroed round).
     ips = tps = pipe_ips = serve = None
+    try:
+        pre, post = bench_allreduce_fusion(pt)
+        result["allreduce_ops_per_step"] = {"pre_fusion": pre,
+                                            "post_fusion": post}
+    except Exception as e:
+        errors["allreduce_fusion"] = f"{type(e).__name__}: {e}"[:500]
     try:
         ips = bench_resnet(pt, jax)
     except Exception as e:

@@ -123,6 +123,26 @@ class DistributedStrategy:
     def nccl_comm_num(self, v):
         self._proto.nccl_comm_num = int(v)
 
+    @property
+    def fuse_grad_size_in_MB(self):
+        """Bucket cap for fused gradient allreduce (default 32 MB);
+        consumed by framework/passes.py FuseAllReducePass via the
+        collective transpiler's op markers."""
+        return self._proto.fuse_grad_size_in_MB
+
+    @fuse_grad_size_in_MB.setter
+    def fuse_grad_size_in_MB(self, v):
+        iv = int(v)
+        if iv != v or iv <= 0:
+            # the proto field is int32 MB: silently truncating 0.5 -> 0
+            # (-> the 32MB default) would ignore the user's cap; sub-MB
+            # caps go through GradAllReduce(fuse_grad_size_in_MB=...)
+            raise ValueError(
+                f"fuse_grad_size_in_MB must be a positive whole number of "
+                f"MB, got {v!r}; for sub-MB bucket caps construct "
+                f"GradAllReduce(fuse_grad_size_in_MB=...) directly")
+        self._proto.fuse_grad_size_in_MB = iv
+
     def __repr__(self):
         on = [f.name for f in self._proto.DESCRIPTOR.fields
               if f.type == f.TYPE_BOOL and getattr(self._proto, f.name)]

@@ -9,7 +9,7 @@ graph-level one performs the collective transpile.
 from __future__ import annotations
 
 from ...framework.program import GRAD_SUFFIX
-from .collective_transpiler import GradAllReduce, LocalSGD
+from .collective_transpiler import GradAllReduce, LocalSGD, _last_writer_map
 
 
 class MetaOptimizerBase:
@@ -578,8 +578,9 @@ class ShardingMetaOptimizer(MetaOptimizerBase):
             grad_to_param[g.name if hasattr(g, "name") else g] = (
                 p.name if hasattr(p, "name") else p)
 
+        last_writer = _last_writer_map(block.ops)
         new_ops = []
-        for op in block.ops:
+        for i, op in enumerate(block.ops):
             new_ops.append(op)
             if loss_grad_name in op.output_arg_names() \
                     and op.type == "fill_constant":
@@ -590,8 +591,7 @@ class ShardingMetaOptimizer(MetaOptimizerBase):
                      "bias_after_scale": True}))
             for g in op.output_arg_names():
                 pname = grad_to_param.get(g)
-                if pname is None or not GradAllReduce._is_last_def(
-                        block, op, g):
+                if pname is None or last_writer.get(g) != i:
                     continue
                 comm_in = g
                 if fp16:
@@ -762,8 +762,13 @@ class GraphExecutionMetaOptimizer(MetaOptimizerBase):
         ops, params_grads = self.inner_opt.minimize(
             loss, startup_program, parameter_list, no_grad_set)
         prog = loss.block.program
+        strat = self.user_strategy
         GradAllReduce(
             self._nranks(),
+            fuse_all_reduce=bool(strat.fuse_all_reduce_ops)
+            if strat is not None else True,
+            fuse_grad_size_in_MB=(strat.fuse_grad_size_in_MB or 32)
+            if strat is not None else 32,
             fp16=bool(getattr(prog, "_fp16_allreduce", False)),
         ).transpile(prog, params_grads,
                     loss_grad_name=loss.name + GRAD_SUFFIX)

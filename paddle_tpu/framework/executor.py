@@ -147,18 +147,33 @@ def _sub_external_reads(program, block_idx: int) -> List[str]:
     return ext
 
 
-def _prune_ops(program, fetch_names):
+# ops whose effect is not visible through their outputs (p2p send/recv
+# pairs match POSITIONALLY per ring, so dropping either end corrupts the
+# pairing; barrier is a rendezvous; print emits a host debug callback) —
+# the pass-pipeline DCE must never slice them away
+SIDE_EFFECT_OPS = {"send_v2", "partial_send", "recv_v2", "partial_recv",
+                   "barrier", "print"}
+
+
+def _prune_ops(program, fetch_names, keep_side_effect_ops=False):
     """Backward slice: keep only ops whose outputs (transitively) feed the
     fetch list (reference framework/prune.h / Executor.run(use_prune)).
     An eval fetch on a training program thus skips backward+optimizer ops
-    instead of silently advancing the parameters."""
+    instead of silently advancing the parameters.
+
+    ``keep_side_effect_ops`` (the pass-pipeline DCE caller) additionally
+    keeps ops with no outputs and the SIDE_EFFECT_OPS unconditionally."""
     block = program.global_block
     needed = set(fetch_names)
     keep = []
     for op in reversed(block.ops):
         if op.type in PSEUDO_OPS:
             continue
-        if set(op.output_arg_names()) & needed:
+        keep_this = bool(set(op.output_arg_names()) & needed)
+        if keep_side_effect_ops and (
+                op.type in SIDE_EFFECT_OPS or not op.output_arg_names()):
+            keep_this = True
+        if keep_this:
             keep.append(op)
             needed.update(op.input_arg_names())
             needed.update(_ctrl_attr_reads(program, op))
@@ -195,6 +210,9 @@ class Executor:
         self._analysis_cache: Dict[tuple, tuple] = {}
         # (program fingerprint, fetch names) -> pruned op list
         self._prune_cache: Dict[tuple, list] = {}
+        # (program fingerprint, pass config, fetch/feed names, scope) ->
+        # pass-rewritten program (or the original when no pass applied)
+        self._pass_cache: Dict[tuple, Program] = {}
         self._mesh = mesh  # explicit mesh wins over the global parallel env
 
     def _active_mesh(self):
@@ -418,6 +436,12 @@ class Executor:
         import jax
 
         from . import flags
+        from ..monitor import stat_add
+
+        # graph-pass pipeline (framework/passes.py): fused gradient
+        # allreduce + cast/dead-op cleanup, applied to a cached clone so
+        # the caller's program is never mutated
+        program = self._apply_graph_passes(program, fetch_names, feed, scope)
 
         ops = None
         if use_prune and fetch_names:
@@ -426,6 +450,8 @@ class Executor:
             if ops is None:
                 ops = _prune_ops(program, fetch_names)
                 self._prune_cache[pkey] = ops
+            else:
+                stat_add("executor_prune_cache_hit")
         nan_scan = bool(flags.flag("check_nan_inf"))
 
         # state the program will read from the scope (the full op walk is
@@ -435,6 +461,7 @@ class Executor:
         cached = self._analysis_cache.get(akey)
         if cached is not None and all(scope.has_var(n) for n in cached[0]):
             state_in, state_out = cached
+            stat_add("executor_analysis_cache_hit")
         else:
             state_in, state_out = self._analyze_state(program, set(feed),
                                                       scope, ops=ops)
@@ -464,8 +491,6 @@ class Executor:
             # with affects_lowering=True joins automatically
             flags.lowering_key(),
         )
-        from ..monitor import stat_add
-
         entry = self._cache.get(key)
         if entry is None:
             stat_add("executor_compile")
@@ -503,10 +528,12 @@ class Executor:
         if entry.uses_rng:
             scope.set_var(RNG_VAR, new_rng)
         if entry.nan_scan:
-            flags = np.asarray(fetches[-1]).astype(bool)
+            # NOT named `flags`: that would shadow the framework.flags
+            # module imported at the top of this scope
+            nan_flags = np.asarray(fetches[-1]).astype(bool)
             fetches = fetches[:-1]
             if entry.nan_ops:
-                ok = flags.reshape(-1, len(entry.nan_ops)).all(axis=0)
+                ok = nan_flags.reshape(-1, len(entry.nan_ops)).all(axis=0)
                 if not ok.all():
                     i = int(np.argmin(ok))
                     op_type, site = entry.nan_ops[i]
@@ -515,6 +542,36 @@ class Executor:
                         f"{site}) produced NaN/Inf (op #{i} of the compiled "
                         f"block)")
         return fetches
+
+    # ------------------------------------------------------------------
+    def _apply_graph_passes(self, program, fetch_names, feed, scope):
+        """Run the framework.passes pipeline over ``program`` before
+        lowering (reference build-strategy graph passes).  The result —
+        a rewritten clone, or the original object when no pass changed
+        anything — is cached per (fingerprint, pass config, fetch/feed
+        names, scope serial); FLAGS_fuse_passes (affects_lowering=True)
+        gates the whole pipeline AND re-keys the compile cache."""
+        from . import flags
+
+        if not flags.flag("fuse_passes"):
+            return program
+        if getattr(program, "_pipeline", None) is not None:
+            return program  # the pipeline executor owns its own rewrite
+        from . import passes as passes_mod
+        from ..monitor import stat_add
+
+        pipeline = passes_mod.default_pipeline()
+        key = (program.fingerprint(), pipeline.config_key(), fetch_names,
+               frozenset(feed), scope.serial)
+        cached = self._pass_cache.get(key)
+        if cached is not None:
+            stat_add("executor_pass_cache_hit")
+            return cached
+        ctx = passes_mod.PassContext(fetch_names=fetch_names,
+                                     feed_names=tuple(feed), scope=scope)
+        out = pipeline.apply(program, ctx)
+        self._pass_cache[key] = out
+        return out
 
     # ------------------------------------------------------------------
     def _run_host_ops(self, program, scope, fetch_names, return_numpy):
@@ -835,6 +892,16 @@ class Executor:
             if op.type == "c_shard_slice":
                 varying.update(op.output_arg_names())
                 continue
+            if op.type == "uncoalesce_tensor":
+                # split-back of a fused (already allreduced) gradient
+                # buffer: the outputs inherit the BUFFER's variance, even
+                # though the grad names were varying before fusion
+                if any(n in varying for n in op.input_arg_names()):
+                    varying.update(op.output_arg_names())
+                else:
+                    for n in op.output_arg_names():
+                        varying.discard(n)
+                continue
             if any(n in varying for n in op.input_arg_names()):
                 varying.update(op.output_arg_names())
 
@@ -971,7 +1038,12 @@ class Executor:
         return fn, globalize
 
     def close(self):
+        # clear EVERY per-program cache: long-lived serving processes
+        # otherwise leak analysis/prune/pass entries for dead programs
         self._cache.clear()
+        self._analysis_cache.clear()
+        self._prune_cache.clear()
+        self._pass_cache.clear()
 
 
 def _is_jax_array(x) -> bool:

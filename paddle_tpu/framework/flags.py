@@ -102,3 +102,9 @@ define_flag("flash_attention", "auto",
             "fused attention kernel engagement: 'auto' (flash only when "
             "the score tensor would threaten HBM), 'always', 'never'",
             affects_lowering=True)
+define_flag("fuse_passes", True,
+            "enable the graph-pass pipeline (framework/passes.py): fused "
+            "bucketed gradient allreduce, redundant-cast elimination, "
+            "dead-op elimination — applied before lowering; "
+            "affects_lowering so flipping it re-keys the compile cache",
+            affects_lowering=True)

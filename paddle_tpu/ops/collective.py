@@ -315,6 +315,32 @@ def _dgc(ctx, op):
         ctx.set_out(op, "GatherBuff", encoded)
 
 
+@register_lower("uncoalesce_tensor")
+def _uncoalesce_tensor(ctx, op):
+    """Split a fused 1-D buffer back into its member tensors: sections
+    give the flat lengths, dims/ranks encode each member's shape
+    (attr lists are flat ints, so shapes ride as dims chunked by rank).
+    Inverse of `coalesce_tensor` (ops/misc.py); the pair is emitted by
+    framework/passes.py FuseAllReducePass around each bucketed
+    gradient allreduce (reference fuse_all_reduce_op_pass +
+    coalesce_tensor_op.cc, in a functional non-aliasing form)."""
+    fused = ctx.get(op.inputs["Input"][0])
+    sections = [int(s) for s in (op.attr("sections", []) or [])]
+    dims = [int(d) for d in (op.attr("dims", []) or [])]
+    ranks = [int(r) for r in (op.attr("ranks", []) or [])]
+    outs = op.outputs.get("Output", [])
+    if not (len(outs) == len(sections) == len(ranks)):
+        raise ValueError(
+            f"uncoalesce_tensor: {len(outs)} outputs vs "
+            f"{len(sections)} sections / {len(ranks)} ranks")
+    off = di = 0
+    for name, n, r in zip(outs, sections, ranks):
+        shape = tuple(dims[di:di + r])
+        di += r
+        ctx.set(name, fused[off:off + n].reshape(shape))
+        off += n
+
+
 @register_lower("c_shard_slice")
 def _c_shard_slice(ctx, op):
     """ZeRO-1 helper (sharding meta-optimizer): this rank's dim-0 shard of

@@ -1,0 +1,443 @@
+"""Graph-pass pipeline tests (framework/passes.py).
+
+Reference parity: fuse_all_reduce_op_pass + coalesce_tensor_op (tensor
+fusion for data-parallel gradient allreduce), delete_cast_op_pass, and
+graph DCE.  The oracle mirrors test_dist_base.py: fused and unfused
+runs must produce identical losses AND identical parameter updates on
+the multi-device CPU mesh.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.framework import dtypes, passes
+from paddle_tpu.framework.program import Program, program_guard
+from paddle_tpu.monitor import stat_get, stat_reset
+from paddle_tpu.distributed.parallel_env import init_parallel_env, reset_mesh
+
+
+@pytest.fixture
+def mesh8():
+    mesh = init_parallel_env()
+    yield mesh
+    reset_mesh()
+
+
+def _mark(mb=32.0):
+    return {passes.FUSED_ALLREDUCE_ATTR: True, passes.FUSE_SIZE_ATTR: mb}
+
+
+def _allreduce_program(specs, mb=32.0, fp16=False):
+    """Hand-built program shaped like the transpiler output: per tensor
+    a producer, then [cast bf16] -> marked c_allreduce_sum -> [cast
+    back], all in-place, exactly what FuseAllReducePass consumes."""
+    main = Program()
+    block = main.global_block
+    for name, shape, dtype in specs:
+        block.create_var(name=name, shape=shape, dtype=dtype)
+        block.append_op("fill_constant", {}, {"Out": [name]},
+                        {"shape": list(shape), "dtype": dtype, "value": 1.0})
+        if fp16:
+            block.append_op("cast", {"X": [name]}, {"Out": [name]},
+                            {"out_dtype": dtypes.to_enum("bfloat16"),
+                             **_mark(mb)})
+        block.append_op("c_allreduce_sum", {"X": [name]}, {"Out": [name]},
+                        {"ring_id": 0, "use_calc_stream": True, **_mark(mb)})
+        if fp16:
+            block.append_op("cast", {"X": [name]}, {"Out": [name]},
+                            {"out_dtype": dtypes.to_enum(dtype), **_mark(mb)})
+    return main
+
+
+def _coalesce_ops(program):
+    return [op for op in program.global_block.ops
+            if op.type == "coalesce_tensor"]
+
+
+def _count(program, op_type):
+    return sum(1 for op in program.global_block.ops if op.type == op_type)
+
+
+def _build_fleet_net(fuse=True, mb=32, fp16=False, layers_n=4, width=64,
+                     lr=0.05):
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.framework import unique_name
+    from paddle_tpu.initializer import ConstantInitializer
+    from paddle_tpu.optimizer import MomentumOptimizer
+    from paddle_tpu.param_attr import ParamAttr
+
+    main, startup = Program(), Program()
+    main.random_seed = 1
+    with unique_name.guard(), program_guard(main, startup):
+        x = layers.data("x", [8])
+        y = layers.data("y", [1])
+        h = x
+        for i in range(layers_n):
+            h = layers.fc(h, width, act="relu", param_attr=ParamAttr(
+                initializer=ConstantInitializer(0.02 * (i + 1))),
+                bias_attr=False)
+        pred = layers.fc(h, 1, param_attr=ParamAttr(
+            initializer=ConstantInitializer(0.1)), bias_attr=False)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        strat = fleet.DistributedStrategy()
+        strat.fuse_all_reduce_ops = fuse
+        strat.fuse_grad_size_in_MB = mb
+        if fp16:
+            strat.fp16_allreduce = True
+        fleet.init(is_collective=True, strategy=strat)
+        fleet.distributed_optimizer(MomentumOptimizer(lr, 0.9))
+        fleet.minimize(loss)
+    return main, startup, loss
+
+
+def _train(main, startup, loss, X, Y, steps=4, mesh=None):
+    scope = pt.framework.Scope()
+    exe = pt.Executor(pt.CPUPlace(), mesh=mesh)
+    exe.run(startup, scope=scope)
+    losses = [float(np.asarray(
+        exe.run(main, feed={"x": X, "y": Y}, fetch_list=[loss],
+                scope=scope)[0]).item()) for _ in range(steps)]
+    params = {n: np.asarray(scope.get_var(n)).copy()
+              for n in scope.local_var_names()
+              if ".w" in n or ".b" in n}
+    return losses, params, exe
+
+
+class TestFuseAllReducePass:
+    def test_bucket_size_cap_respected(self):
+        # 16 x 64KB fp32 tensors; cap 0.25MB -> exactly 4 buckets of 4
+        specs = [(f"g{i}", [128, 128], "float32") for i in range(16)]
+        prog = _allreduce_program(specs, mb=0.25)
+        changed = passes.FuseAllReducePass().apply(prog, passes.PassContext())
+        assert changed
+        co = _coalesce_ops(prog)
+        assert len(co) == 4
+        cap = 0.25 * 1024 * 1024
+        for op in co:
+            nbytes = sum(128 * 128 * 4 for _ in op.inputs["Input"])
+            assert nbytes <= cap
+        # exactly ceil(total_bytes / cap) collectives survive
+        assert _count(prog, "c_allreduce_sum") == 4
+        assert _count(prog, "uncoalesce_tensor") == 4
+        assert stat_get("pass_fused_allreduce_buckets") == 4
+        assert stat_get("pass_allreduce_ops_before") == 16
+        assert stat_get("pass_allreduce_ops_after") == 4
+
+    def test_oversize_tensor_gets_own_bucket(self):
+        # 'big' sits BETWEEN the small grads: it must not evict the open
+        # bucket, so s1+s2 still fuse across it
+        specs = [("s1", [64, 64], "float32"),
+                 ("big", [600, 128], "float32"),   # ~0.29MB > cap
+                 ("s2", [64, 64], "float32")]
+        prog = _allreduce_program(specs, mb=0.25)
+        passes.FuseAllReducePass().apply(prog, passes.PassContext())
+        groups = [op.inputs["Input"] for op in _coalesce_ops(prog)]
+        assert ["s1", "s2"] in groups
+        # the oversize tensor stays in a singleton -> left unfused
+        assert all("big" not in g for g in groups)
+        assert _count(prog, "c_allreduce_sum") == 2
+
+    def test_mixed_dtype_never_share_bucket(self):
+        specs = [("a32", [32, 32], "float32"), ("a16", [32, 32], "bfloat16"),
+                 ("b32", [32, 32], "float32"), ("b16", [32, 32], "bfloat16")]
+        prog = _allreduce_program(specs, mb=32.0)
+        passes.FuseAllReducePass().apply(prog, passes.PassContext())
+        for op in _coalesce_ops(prog):
+            dts = {passes.dtypes.to_str(
+                prog.global_block.var(n).dtype) for n in op.inputs["Input"]}
+            assert len(dts) == 1, dts
+        assert _count(prog, "c_allreduce_sum") == 2
+
+    def test_fp16_one_cast_pair_per_bucket(self):
+        specs = [(f"g{i}", [32, 32], "float32") for i in range(6)]
+        prog = _allreduce_program(specs, mb=32.0, fp16=True)
+        assert _count(prog, "cast") == 12
+        passes.FuseAllReducePass().apply(prog, passes.PassContext())
+        # 6 per-grad pairs collapse to ONE pair around the one bucket
+        assert _count(prog, "cast") == 2
+        assert _count(prog, "c_allreduce_sum") == 1
+
+    def test_unmarked_allreduce_untouched(self):
+        main = Program()
+        block = main.global_block
+        block.create_var(name="g", shape=[4, 4], dtype="float32")
+        block.append_op("fill_constant", {}, {"Out": ["g"]},
+                        {"shape": [4, 4], "dtype": "float32", "value": 1.0})
+        block.append_op("c_allreduce_sum", {"X": ["g"]}, {"Out": ["g"]},
+                        {"ring_id": 0})
+        p = passes.FuseAllReducePass()
+        assert not p.should_apply(main, passes.PassContext())
+        assert not p.apply(main, passes.PassContext())
+        assert _count(main, "coalesce_tensor") == 0
+
+
+class TestFusedNumerics:
+    def test_coalesce_uncoalesce_roundtrip(self, mesh8):
+        """Fused collective == per-tensor collective, elementwise, on the
+        real 8-device mesh."""
+        specs = [("a", [8, 3], "float32"), ("b", [8, 5], "float32")]
+        prog = _allreduce_program(specs, mb=32.0)
+        passes.FuseAllReducePass().apply(prog, passes.PassContext())
+        scope = pt.framework.Scope()
+        exe = pt.Executor(pt.CPUPlace(), mesh=mesh8)
+        a, b = exe.run(prog, feed={}, fetch_list=["a", "b"], scope=scope)
+        # fill_constant(1.0) psum'd over 8 shards -> all 8s
+        np.testing.assert_array_equal(np.asarray(a), np.full((8, 3), 8.0))
+        np.testing.assert_array_equal(np.asarray(b), np.full((8, 5), 8.0))
+
+    def test_fused_vs_unfused_parity_fp32(self, mesh8):
+        """The acceptance oracle: fused and unfused DP training produce
+        bitwise-identical losses and parameter updates in fp32."""
+        rs = np.random.RandomState(0)
+        X = rs.randn(32, 8).astype("f4")
+        Y = rs.randn(32, 1).astype("f4")
+
+        m1, s1, l1 = _build_fleet_net(fuse=False)
+        base_losses, base_params, _ = _train(m1, s1, l1, X, Y, mesh=mesh8)
+
+        stat_reset("pass_fused_allreduce_buckets")
+        m2, s2, l2 = _build_fleet_net(fuse=True)
+        fused_losses, fused_params, _ = _train(m2, s2, l2, X, Y, mesh=mesh8)
+
+        # fusion actually engaged (observable via monitor stats)
+        assert stat_get("pass_fused_allreduce_buckets") >= 1
+        assert stat_get("pass_allreduce_ops_after") \
+            < stat_get("pass_allreduce_ops_before")
+        np.testing.assert_array_equal(base_losses, fused_losses)
+        assert base_params.keys() == fused_params.keys()
+        for n in base_params:
+            np.testing.assert_array_equal(base_params[n], fused_params[n])
+
+    def test_fused_vs_unfused_parity_fp16_allreduce(self, mesh8):
+        """bf16-allreduce strategy: per-bucket cast pair must give the
+        same result as per-grad casts (elementwise identical ops)."""
+        rs = np.random.RandomState(1)
+        X = rs.randn(32, 8).astype("f4")
+        Y = rs.randn(32, 1).astype("f4")
+
+        m1, s1, l1 = _build_fleet_net(fuse=False, fp16=True)
+        base_losses, base_params, _ = _train(m1, s1, l1, X, Y, mesh=mesh8)
+
+        m2, s2, l2 = _build_fleet_net(fuse=True, fp16=True)
+        fused_losses, fused_params, _ = _train(m2, s2, l2, X, Y, mesh=mesh8)
+
+        np.testing.assert_allclose(base_losses, fused_losses,
+                                   rtol=1e-2, atol=1e-4)
+        for n in base_params:
+            np.testing.assert_allclose(base_params[n], fused_params[n],
+                                       rtol=1e-2, atol=1e-4)
+
+    def test_user_program_never_mutated(self, mesh8):
+        """The executor rewrites a CLONE: the user's transpiled program
+        keeps its per-grad allreduces (fuse off restores it exactly)."""
+        rs = np.random.RandomState(2)
+        X = rs.randn(32, 8).astype("f4")
+        Y = rs.randn(32, 1).astype("f4")
+        m, s, l = _build_fleet_net(fuse=True)
+        fp_before = m.fingerprint()
+        n_ar = _count(m, "c_allreduce_sum")
+        _train(m, s, l, X, Y, steps=1, mesh=mesh8)
+        assert m.fingerprint() == fp_before
+        assert _count(m, "c_allreduce_sum") == n_ar
+        assert _count(m, "coalesce_tensor") == 0
+
+    def test_fuse_off_restores_prepass_program(self, mesh8):
+        m, s, l = _build_fleet_net(fuse=False)
+        assert not any(op.attr(passes.FUSED_ALLREDUCE_ATTR)
+                       for op in m.global_block.ops)
+        # nothing for the pipeline to do -> executor compiles the
+        # original object itself
+        exe = pt.Executor(pt.CPUPlace(), mesh=mesh8)
+        out = exe._apply_graph_passes(m, (l.name,), {},
+                                      pt.framework.Scope())
+        assert out is m
+
+
+class TestRedundantCastElimination:
+    def test_duplicate_cast_removed(self):
+        main = Program()
+        block = main.global_block
+        block.create_var(name="x", shape=[4], dtype="float32")
+        block.create_var(name="y", shape=[4], dtype="bfloat16")
+        block.create_var(name="z", shape=[4], dtype="bfloat16")
+        block.append_op("cast", {"X": ["x"]}, {"Out": ["y"]},
+                        {"out_dtype": dtypes.to_enum("bfloat16")})
+        # y provably bf16 already -> this cast is a no-op
+        block.append_op("cast", {"X": ["y"]}, {"Out": ["z"]},
+                        {"out_dtype": dtypes.to_enum("bfloat16")})
+        ctx = passes.PassContext(feed_names=("x",))
+        assert passes.RedundantCastEliminationPass().apply(main, ctx)
+        types = [op.type for op in block.ops]
+        assert types.count("cast") == 1
+        assert "assign" in types  # y->z value flow preserved
+
+    def test_feed_dtype_not_trusted(self):
+        """jax device-array feeds bypass _feed_spec's dtype coercion, so
+        a cast of a feed to its DECLARED dtype is not provably a no-op
+        and must survive."""
+        main = Program()
+        block = main.global_block
+        block.create_var(name="x", shape=[4], dtype="float32")
+        block.create_var(name="y", shape=[4], dtype="float32")
+        block.append_op("cast", {"X": ["x"]}, {"Out": ["y"]},
+                        {"out_dtype": dtypes.to_enum("float32")})
+        ctx = passes.PassContext(feed_names=("x",))
+        assert not passes.RedundantCastEliminationPass().apply(main, ctx)
+        assert _count(main, "cast") == 1
+
+    def test_inplace_bf16_roundtrip_kept(self):
+        """Declared-fp32 var holding bf16 bits (fp16-allreduce pattern):
+        the cast back to fp32 is NOT redundant and must survive."""
+        main = Program()
+        block = main.global_block
+        block.create_var(name="g", shape=[4], dtype="float32")
+        block.append_op("fill_constant", {}, {"Out": ["g"]},
+                        {"shape": [4], "dtype": "float32", "value": 1.0})
+        block.append_op("cast", {"X": ["g"]}, {"Out": ["g"]},
+                        {"out_dtype": dtypes.to_enum("bfloat16")})
+        block.append_op("c_allreduce_sum", {"X": ["g"]}, {"Out": ["g"]},
+                        {"ring_id": 0})
+        block.append_op("cast", {"X": ["g"]}, {"Out": ["g"]},
+                        {"out_dtype": dtypes.to_enum("float32")})
+        changed = passes.RedundantCastEliminationPass().apply(
+            main, passes.PassContext())
+        assert not changed
+        assert _count(main, "cast") == 2
+
+
+class TestDeadOpElimination:
+    def _program(self):
+        main = Program()
+        block = main.global_block
+        for n in ("a", "dead", "out"):
+            block.create_var(name=n, shape=[2], dtype="float32")
+        block.create_var(name="state", shape=[2], dtype="float32",
+                         persistable=True)
+        block.append_op("fill_constant", {}, {"Out": ["a"]},
+                        {"shape": [2], "dtype": "float32", "value": 1.0})
+        block.append_op("scale", {"X": ["a"]}, {"Out": ["out"]},
+                        {"scale": 2.0, "bias": 0.0})
+        block.append_op("scale", {"X": ["a"]}, {"Out": ["dead"]},
+                        {"scale": 3.0, "bias": 0.0})  # feeds nothing
+        block.append_op("scale", {"X": ["a"]}, {"Out": ["state"]},
+                        {"scale": 4.0, "bias": 0.0})  # persistable write
+        return main
+
+    def test_dead_op_removed_roots_kept(self):
+        main = self._program()
+        ctx = passes.PassContext(fetch_names=("out",))
+        assert passes.DeadOpEliminationPass().apply(main, ctx)
+        written = [n for op in main.global_block.ops
+                   for n in op.output_arg_names()]
+        assert "dead" not in written
+        assert "out" in written and "state" in written
+
+    def test_side_effect_ops_survive(self):
+        """send AND recv must both survive: the lowering pairs them
+        POSITIONALLY per ring, so pruning a dead-output recv while its
+        send stays pinned would mis-pair every later transfer."""
+        main = self._program()
+        block = main.global_block
+        block.create_var(name="rcv", shape=[2], dtype="float32")
+        block.append_op("send_v2", {"X": ["a"]}, {},
+                        {"ring_id": 7, "peer": 1})
+        block.append_op("recv_v2", {}, {"Out": ["rcv"]},
+                        {"ring_id": 7, "peer": 0})  # rcv feeds nothing
+        ctx = passes.PassContext(fetch_names=("out",))
+        passes.DeadOpEliminationPass().apply(main, ctx)
+        assert _count(main, "send_v2") == 1
+        assert _count(main, "recv_v2") == 1
+
+    def test_end_to_end_dead_removed(self):
+        main = self._program()
+        scope = pt.framework.Scope()
+        exe = pt.Executor(pt.CPUPlace())
+        out = exe.run(main, feed={}, fetch_list=["out"], scope=scope)
+        np.testing.assert_array_equal(np.asarray(out[0]), [2.0, 2.0])
+        np.testing.assert_array_equal(
+            np.asarray(scope.get_var("state")), [4.0, 4.0])
+
+
+class TestPassCacheAndFlags:
+    def test_pass_cache_hit_and_fingerprint_invalidation(self):
+        main = self._two_op_program()
+        scope = pt.framework.Scope()
+        exe = pt.Executor(pt.CPUPlace())
+        exe.run(main, feed={}, fetch_list=["out"], scope=scope)
+        n_entries = len(exe._pass_cache)
+        h0 = stat_get("executor_pass_cache_hit")
+        exe.run(main, feed={}, fetch_list=["out"], scope=scope)
+        assert stat_get("executor_pass_cache_hit") == h0 + 1
+        assert len(exe._pass_cache) == n_entries
+        # mutation bumps the fingerprint -> pass pipeline re-applies
+        main.global_block.append_op(
+            "scale", {"X": ["out"]}, {"Out": ["out"]},
+            {"scale": 1.0, "bias": 0.0})
+        exe.run(main, feed={}, fetch_list=["out"], scope=scope)
+        assert len(exe._pass_cache) == n_entries + 1
+
+    def test_flag_gates_pipeline_and_rekeys_compile_cache(self):
+        from paddle_tpu.framework import flags as fl
+
+        assert ("fuse_passes", True) in fl.lowering_key()
+        main = self._two_op_program()
+        scope = pt.framework.Scope()
+        exe = pt.Executor(pt.CPUPlace())
+        exe.run(main, feed={}, fetch_list=["out"], scope=scope)
+        n_compiled = len(exe._cache)
+        pt.set_flags({"FLAGS_fuse_passes": False})
+        try:
+            out = exe.run(main, feed={}, fetch_list=["out"], scope=scope)
+            # flag flip = new compile entry, not a stale cache hit
+            assert len(exe._cache) == n_compiled + 1
+            np.testing.assert_array_equal(np.asarray(out[0]), [2.0, 2.0])
+        finally:
+            pt.set_flags({"FLAGS_fuse_passes": True})
+
+    def test_close_clears_all_caches(self):
+        main = self._two_op_program()
+        scope = pt.framework.Scope()
+        exe = pt.Executor(pt.CPUPlace())
+        exe.run(main, feed={}, fetch_list=["out"], scope=scope,
+                use_prune=True)
+        assert exe._cache and exe._analysis_cache and exe._prune_cache \
+            and exe._pass_cache
+        exe.close()
+        assert not exe._cache and not exe._analysis_cache \
+            and not exe._prune_cache and not exe._pass_cache
+
+    def test_analysis_and_prune_cache_hit_stats(self):
+        main = self._two_op_program()
+        scope = pt.framework.Scope()
+        exe = pt.Executor(pt.CPUPlace())
+        exe.run(main, feed={}, fetch_list=["out"], scope=scope,
+                use_prune=True)
+        a0 = stat_get("executor_analysis_cache_hit")
+        p0 = stat_get("executor_prune_cache_hit")
+        exe.run(main, feed={}, fetch_list=["out"], scope=scope,
+                use_prune=True)
+        assert stat_get("executor_analysis_cache_hit") == a0 + 1
+        assert stat_get("executor_prune_cache_hit") == p0 + 1
+
+    def test_strategy_bucket_cap_rejects_silent_truncation(self):
+        from paddle_tpu.distributed.fleet import DistributedStrategy
+
+        s = DistributedStrategy()
+        s.fuse_grad_size_in_MB = 64
+        assert s.fuse_grad_size_in_MB == 64
+        for bad in (0.5, 0, -4):
+            with pytest.raises(ValueError):
+                s.fuse_grad_size_in_MB = bad
+
+    @staticmethod
+    def _two_op_program():
+        main = Program()
+        block = main.global_block
+        for n in ("a", "out"):
+            block.create_var(name=n, shape=[2], dtype="float32")
+        block.append_op("fill_constant", {}, {"Out": ["a"]},
+                        {"shape": [2], "dtype": "float32", "value": 1.0})
+        block.append_op("scale", {"X": ["a"]}, {"Out": ["out"]},
+                        {"scale": 2.0, "bias": 0.0})
+        return main
