@@ -372,6 +372,34 @@ def main():
 
     import paddle_tpu as pt
 
+    from paddle_tpu import observe
+
+    # FLAGS_benchmark: the Executor syncs each call before stopping its
+    # step clock, so the StepTimer histogram holds real per-step wall
+    # times (jax arrays are async; without the sync a run_steps call
+    # records dispatch latency).  The flagship throughput numbers are
+    # still measured by this harness's own outer timers.
+    pt.set_flags({"FLAGS_benchmark": True})
+
+    def step_telemetry(prefix):
+        """BENCH_* keys from the StepTimer the Executor fed during the
+        flagship's timed calls: per-step p50/p95 (ms) + MFU estimate
+        (observe/step_stats.py; FLOPs from the program IR)."""
+        s = observe.step_timer().summary()
+        hist = s.get("step_time_s", {})
+        out = {}
+        if hist.get("count"):
+            out[f"{prefix}_step_time_ms_p50"] = round(
+                hist["p50"] * 1e3, 3)
+            out[f"{prefix}_step_time_ms_p95"] = round(
+                hist["p95"] * 1e3, 3)
+        if "mfu" in s:
+            out[f"{prefix}_mfu_estimate"] = s["mfu"]
+        if "allreduce_bytes_per_step" in s:
+            out[f"{prefix}_allreduce_bytes_per_step"] = \
+                s["allreduce_bytes_per_step"]
+        return out
+
     # Each flagship is isolated: one failure records its diagnostic and
     # the rest still report (partial results beat a zeroed round).
     ips = tps = pipe_ips = serve = None
@@ -382,11 +410,15 @@ def main():
     except Exception as e:
         errors["allreduce_fusion"] = f"{type(e).__name__}: {e}"[:500]
     try:
+        observe.reset_step_stats()
         ips = bench_resnet(pt, jax)
+        result.update(step_telemetry("resnet50"))
     except Exception as e:
         errors["resnet50"] = f"{type(e).__name__}: {e}"[:500]
     try:
+        observe.reset_step_stats()
         tps = bench_bert(pt, jax)
+        result.update(step_telemetry("bert"))
     except Exception as e:
         errors["bert"] = f"{type(e).__name__}: {e}"[:500]
     try:

@@ -72,6 +72,7 @@ from . import version  # noqa: F401
 from . import inference  # noqa: F401
 from . import jit  # noqa: F401
 from . import monitor  # noqa: F401
+from . import observe  # noqa: F401
 from .hapi.model_stat import flops, summary  # noqa: F401
 from . import profiler  # noqa: F401
 from . import static  # noqa: F401

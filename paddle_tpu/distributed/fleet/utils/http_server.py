@@ -8,7 +8,11 @@ glue (and is exercised by the test suite over real localhost HTTP).
 It also doubles as the serving layer's observability port: ``routes``
 maps a path (e.g. ``/stats``, ``/health``) to a zero-arg callable whose
 return value is served as JSON — GETs on a registered route never touch
-the KV store.
+the KV store.  A route may instead return ``(bytes, content_type)`` for
+non-JSON payloads; every server registers a default ``/metrics`` route
+serving the whole counter+histogram registry in Prometheus
+text-exposition format (``paddle_tpu.observe``), so any fleet/serving
+process is scrape-able out of the box.
 """
 from __future__ import annotations
 
@@ -30,17 +34,21 @@ class KVHandler(BaseHTTPRequestHandler):
         # /stats?format=... and cache-busting /health?ts=...)
         route = self.server.routes.get(urlsplit(self.path).path)
         if route is not None:
+            ctype = "application/json"
             try:
                 payload = route()
+                if isinstance(payload, tuple):  # (body, content_type)
+                    payload, ctype = payload
                 body = payload if isinstance(payload, bytes) \
                     else json.dumps(payload).encode()
                 code = 200
             except Exception as e:  # surface handler bugs as 500s
                 body = json.dumps({"error": f"{type(e).__name__}: {e}"}
                                   ).encode()
+                ctype = "application/json"
                 code = 500
             self.send_response(code)
-            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
@@ -73,12 +81,27 @@ class KVHandler(BaseHTTPRequestHandler):
         self.end_headers()
 
 
+def _metrics_route():
+    """Default GET /metrics handler: Prometheus text exposition of the
+    whole StatRegistry + histogram registry (observe/histogram.py)."""
+    from ....observe.histogram import prometheus_text
+
+    return (prometheus_text().encode(),
+            "text/plain; version=0.0.4; charset=utf-8")
+
+
 class KVHTTPServer(ThreadingHTTPServer):
     def __init__(self, port, handler=KVHandler, routes=None):
         super().__init__(("", port), handler)
         self.kv = {}
         self.kv_lock = threading.Lock()
         self.routes = dict(routes or {})
+        # every fleet/serving HTTP port is scrape-able; pass an explicit
+        # "/metrics" route to override (or map it to None to disable —
+        # a None route falls through to the KV store)
+        self.routes.setdefault("/metrics", _metrics_route)
+        if self.routes.get("/metrics") is None:
+            del self.routes["/metrics"]
 
 
 class KVServer:

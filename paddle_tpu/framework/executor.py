@@ -99,6 +99,10 @@ class _Compiled:
     # calls its ensure_packed before assembling the state tuple
     pipeline_pack: object = None
     n_calls: int = 0
+    # step telemetry (observe/step_stats.py): static per-step FLOPs
+    # (hapi/model_stat.py accounting) and allreduce payload bytes
+    flops_per_step: float = 0.0
+    allreduce_bytes: int = 0
 
 
 def _block_written(program, block_idx: int) -> set:
@@ -153,6 +157,55 @@ def _sub_external_reads(program, block_idx: int) -> List[str]:
 # the pass-pipeline DCE must never slice them away
 SIDE_EFFECT_OPS = {"send_v2", "partial_send", "recv_v2", "partial_recv",
                    "barrier", "print"}
+
+# communication ops: each lowering gets its own tracer span with
+# payload bytes + dtype args (observe/tracer.py), and the allreduce
+# subset feeds the StepTimer's bytes/step accounting
+COLLECTIVE_OPS = {"c_allreduce_sum", "c_allreduce_max", "c_allreduce_min",
+                  "c_allreduce_prod", "allreduce", "mp_allreduce_sum",
+                  "c_broadcast", "c_allgather", "c_reducescatter",
+                  "c_reduce_sum", "c_reduce_max", "c_reduce_min",
+                  "c_scatter", "c_concat", "c_split", "c_shard_slice",
+                  "send_v2", "partial_send", "recv_v2", "partial_recv",
+                  "barrier"}
+_ALLREDUCE_OPS = {"c_allreduce_sum", "c_allreduce_max", "c_allreduce_min",
+                  "c_allreduce_prod", "allreduce", "mp_allreduce_sum"}
+
+
+def _collective_span_args(env, op):
+    """bytes/dtype args for a collective's tracer span, read off the
+    traced input value (static shapes at trace time)."""
+    names = op.input_arg_names()
+    v = env.get(names[0]) if names else None
+    if v is None or not hasattr(v, "shape") or not hasattr(v, "dtype"):
+        return {"var": names[0] if names else ""}
+    n = 1
+    for s in v.shape:
+        n *= int(s)
+    return {"bytes": n * np.dtype(v.dtype).itemsize, "dtype": str(v.dtype),
+            "var": names[0] if names else ""}
+
+
+def _program_allreduce_bytes(block, op_list) -> int:
+    """Static allreduce payload per step, from the post-pass op stream
+    (so fused buckets count once at their coalesced size)."""
+    total = 0
+    for op in op_list:
+        if op.type not in _ALLREDUCE_OPS:
+            continue
+        names = op.input_arg_names()
+        var = block._find_var_recursive(names[0]) if names else None
+        if var is None or not var.shape or any(int(s) <= 0 for s in var.shape):
+            continue
+        try:
+            itemsize = np.dtype(dtypes.to_np(var.dtype)).itemsize
+        except (KeyError, ValueError, TypeError):
+            continue
+        n = 1
+        for s in var.shape:
+            n *= int(s)
+        total += n * itemsize
+    return total
 
 
 def _prune_ops(program, fetch_names, keep_side_effect_ops=False):
@@ -282,7 +335,13 @@ class Executor:
             _acp.on_executor_run(self, program, scope, fed=bool(feed))
 
         if return_numpy:
-            return [np.asarray(v) for v in fetches]
+            from ..observe import tracer as otrace
+
+            # the host-blocking device->host transfer of the fetch list
+            # (reference Executor fetch phase); async callers pass
+            # return_numpy=False and sync on their own schedule
+            with otrace.span("executor/fetch", n=len(fetches)):
+                return [np.asarray(v) for v in fetches]
         return list(fetches)
 
     # ------------------------------------------------------------------
@@ -432,16 +491,34 @@ class Executor:
     def _dispatch(self, program, feed, feed_arrays, spec, fetch_names, scope,
                   multi_step, scan_steps, use_prune=False):
         """Shared run/run_steps tail: state analysis, compile-cache lookup,
-        RNG seeding, the executable call, and scope write-back."""
+        RNG seeding, the executable call, and scope write-back.  Every
+        phase is a tracer span (observe/tracer.py) and every call feeds
+        the StepTimer (observe/step_stats.py) — the per-run cost of both
+        is a flag check when the tracer is off."""
+        from ..observe import tracer as otrace
+
+        with otrace.span("executor/run", multi_step=bool(multi_step)):
+            return self._dispatch_impl(program, feed, feed_arrays, spec,
+                                       fetch_names, scope, multi_step,
+                                       scan_steps, use_prune)
+
+    def _dispatch_impl(self, program, feed, feed_arrays, spec, fetch_names,
+                       scope, multi_step, scan_steps, use_prune=False):
+        import time as _time
+
         import jax
 
         from . import flags
         from ..monitor import stat_add
+        from ..observe import step_stats as _step_stats
+        from ..observe import tracer as otrace
 
         # graph-pass pipeline (framework/passes.py): fused gradient
         # allreduce + cast/dead-op cleanup, applied to a cached clone so
         # the caller's program is never mutated
-        program = self._apply_graph_passes(program, fetch_names, feed, scope)
+        with otrace.span("executor/pass_pipeline"):
+            program = self._apply_graph_passes(program, fetch_names, feed,
+                                               scope)
 
         ops = None
         if use_prune and fetch_names:
@@ -463,8 +540,9 @@ class Executor:
             state_in, state_out = cached
             stat_add("executor_analysis_cache_hit")
         else:
-            state_in, state_out = self._analyze_state(program, set(feed),
-                                                      scope, ops=ops)
+            with otrace.span("executor/analysis"):
+                state_in, state_out = self._analyze_state(
+                    program, set(feed), scope, ops=ops)
             self._analysis_cache[akey] = (state_in, state_out)
         def _svspec(n):
             v = scope.get_var(n)
@@ -520,8 +598,39 @@ class Executor:
             feed_vals, mut_vals, const_vals, rng = entry.globalize(
                 feed_vals, mut_vals, const_vals, rng)
 
-        fetches, new_state, new_rng = entry.fn(feed_vals, mut_vals, const_vals, rng)
+        # jit traces lazily: the FIRST call of a fresh entry is the real
+        # trace+XLA-compile (the "executor/lowering" span and per-
+        # collective spans nest inside it); later calls are pure execute
+        first_call = entry.n_calls == 0
+        outer = otrace.span("executor/compile") if first_call \
+            else otrace.NULL_SPAN
+        t_exec0 = _time.perf_counter()
+        with outer:
+            with otrace.span("executor/execute"):
+                fetches, new_state, new_rng = entry.fn(
+                    feed_vals, mut_vals, const_vals, rng)
+                if flags.flag("benchmark"):
+                    # reference FLAGS_benchmark: sync so the recorded
+                    # time is the step, not the async dispatch
+                    jax.block_until_ready((fetches, new_state))
         entry.n_calls += 1
+
+        # step telemetry: per-step wall time -> step_time_seconds
+        # histogram; examples from the feed batch dim; FLOPs/allreduce
+        # bytes are the compile-time static accounting on the entry
+        if multi_step:
+            n_steps = scan_steps
+            if n_steps is None and feed_arrays:
+                n_steps = int(np.shape(next(iter(feed_arrays.values())))[0])
+            n_steps = int(n_steps or 1)
+        else:
+            n_steps = 1
+        batch = next((s[0] for _, s, _ in spec if s), 0)
+        _step_stats.step_timer().record_run(
+            _time.perf_counter() - t_exec0, steps=n_steps,
+            examples=int(batch) * n_steps, compiled=first_call,
+            flops_per_step=entry.flops_per_step,
+            allreduce_bytes_per_step=entry.allreduce_bytes)
 
         for n, v in zip(entry.state_out, new_state):
             scope.set_var(n, v)
@@ -677,6 +786,24 @@ class Executor:
         block = program.global_block
         op_list = [op for op in (ops if ops is not None else block.ops)
                    if op.type not in PSEUDO_OPS]
+        # static per-step accounting for the StepTimer/MFU readout; a
+        # failure here must never fail a compile
+        try:
+            from ..hapi.model_stat import program_flops
+
+            flops_per_step = float(program_flops(program))
+            # a symbolic-batch program (-1 leading dims) prices
+            # per-SAMPLE FLOPs (model_stat counts -1 as 1): scale by
+            # the concrete feed batch this executable was compiled for
+            if feed_spec and flops_per_step:
+                name0, shape0, _ = feed_spec[0]
+                var0 = block._find_var_recursive(name0)
+                if (var0 is not None and var0.shape and shape0
+                        and int(var0.shape[0]) <= 0):
+                    flops_per_step *= max(int(shape0[0]), 1)
+        except Exception:  # noqa: BLE001 — telemetry only
+            flops_per_step = 0.0
+        allreduce_bytes = _program_allreduce_bytes(block, op_list)
         out_set = set(state_out)
         state_mut = tuple(n for n in state_in if n in out_set)
         state_const = tuple(n for n in state_in if n not in out_set)
@@ -694,26 +821,43 @@ class Executor:
             fetch_names = tuple(fetch_names) + (NAN_FLAGS_VAR,)
 
         def trace_block(env, rng, axis_env=(), ring_axes=None, fold_axes=()):
+            from ..observe import tracer as otrace
+
             ctx = LoweringContext(block, env, rng_key=rng, mesh=mesh,
                                   axis_env=axis_env, ring_axes=ring_axes,
                                   fold_axes=fold_axes)
             flags = []
-            for op in op_list:
-                try:
-                    get_lowering(op.type)(ctx, op)
-                except Exception as e:
-                    site = op.callstack[-1] if op.callstack else "<unknown>"
-                    raise type(e)(
-                        f"while lowering op {op.type!r} (built at {site}): {e}"
-                    ) from e
-                if nan_scan:
-                    ok = jnp.bool_(True)
-                    for n in op.output_arg_names():
-                        v = env.get(n)
-                        if v is not None and hasattr(v, "dtype") \
-                                and jnp.issubdtype(v.dtype, jnp.floating):
-                            ok = jnp.logical_and(ok, jnp.isfinite(v).all())
-                    flags.append(ok)
+            with otrace.span("executor/lowering", ops=len(op_list)):
+                for op in op_list:
+                    try:
+                        if op.type in COLLECTIVE_OPS:
+                            # per-collective span: payload bytes + dtype
+                            # read off the traced value (host time ==
+                            # trace cost; the args are what the timeline
+                            # is really for)
+                            with otrace.span(f"collective/{op.type}",
+                                             **_collective_span_args(env,
+                                                                     op)):
+                                get_lowering(op.type)(ctx, op)
+                        else:
+                            get_lowering(op.type)(ctx, op)
+                    except Exception as e:
+                        site = op.callstack[-1] if op.callstack \
+                            else "<unknown>"
+                        raise type(e)(
+                            f"while lowering op {op.type!r} (built at "
+                            f"{site}): {e}"
+                        ) from e
+                    if nan_scan:
+                        ok = jnp.bool_(True)
+                        for n in op.output_arg_names():
+                            v = env.get(n)
+                            if v is not None and hasattr(v, "dtype") \
+                                    and jnp.issubdtype(v.dtype,
+                                                       jnp.floating):
+                                ok = jnp.logical_and(
+                                    ok, jnp.isfinite(v).all())
+                        flags.append(ok)
             if nan_scan:
                 env[NAN_FLAGS_VAR] = jnp.stack(flags) if flags else \
                     jnp.ones((0,), jnp.bool_)
@@ -762,6 +906,8 @@ class Executor:
                 fetch_names=fetch_names,
                 uses_rng=True,
                 pipeline_pack=plan,
+                flops_per_step=flops_per_step,
+                allreduce_bytes=allreduce_bytes,
             )
 
         globalize = None
@@ -813,6 +959,8 @@ class Executor:
                 (op.type, op.callstack[-1] if op.callstack else "?")
                 for op in op_list) if nan_scan else (),
             nan_scan=nan_scan,
+            flops_per_step=flops_per_step,
+            allreduce_bytes=allreduce_bytes,
         )
         return compiled
 

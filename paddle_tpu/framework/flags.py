@@ -108,3 +108,14 @@ define_flag("fuse_passes", True,
             "dead-op elimination — applied before lowering; "
             "affects_lowering so flipping it re-keys the compile cache",
             affects_lowering=True)
+define_flag("enable_tracer", False,
+            "record host-side spans (executor phases, per-pass, "
+            "per-collective, serving batch lifecycle) into the in-process "
+            "ring buffer (paddle_tpu.observe); export any time with "
+            "observe.export_chrome_trace() — independent of jax.profiler "
+            "captures (reference FLAGS_enable_rpc_profiler / DeviceTracer "
+            "role, CUPTI replaced by a pure-host ring buffer)")
+define_flag("device_peak_tflops", 275.0,
+            "per-chip peak TFLOP/s used by the MFU estimate "
+            "(observe/step_stats.py); default is TPU v4/v5e-class bf16 "
+            "peak — set to your part's number for honest utilization")

@@ -36,6 +36,35 @@ def shard_map(f, mesh, in_specs, out_specs, check_vma=True):
                  **kw)
 
 
+_CONFIG_MISSING = object()
+
+
+def config_value(name, default=_CONFIG_MISSING):
+    """Guarded ``jax.config`` accessor: config entries come and go
+    across jax versions (``jax_cpu_collectives_implementation`` does not
+    exist before the pluggable CPU-collectives work), and a bare
+    ``jax.config.<name>`` raises ``AttributeError`` on versions without
+    the entry.  Returns ``default`` when the entry is absent; with no
+    default, absence returns the (distinct, falsy-ish) sentinel
+    ``jax_compat._CONFIG_MISSING`` so callers can tell "missing" from a
+    legitimately-``None`` value."""
+    return getattr(jax.config, name, default)
+
+
+def has_config(name) -> bool:
+    return config_value(name) is not _CONFIG_MISSING
+
+
+def update_config(name, value) -> bool:
+    """``jax.config.update`` only when the entry exists on this jax;
+    returns whether the update happened (a no-op on versions without
+    the knob — the caller decides whether that is fatal)."""
+    if not has_config(name):
+        return False
+    jax.config.update(name, value)
+    return True
+
+
 def axis_size(axis_name):
     """``lax.axis_size`` (newer jax); older jax constant-folds
     ``psum(1, axis)`` to the same static int inside shard_map."""

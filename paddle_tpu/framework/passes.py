@@ -479,6 +479,7 @@ class PassPipeline:
 
     def apply(self, program, ctx: Optional[PassContext] = None):
         from ..monitor import stat_add
+        from ..observe import tracer as otrace
 
         ctx = ctx or PassContext()
         if not any(p.should_apply(program, ctx) for p in self._passes):
@@ -487,7 +488,10 @@ class PassPipeline:
         changed = False
         for p in self._passes:
             if p.should_apply(work, ctx):
-                changed = bool(p.apply(work, ctx)) or changed
+                # one tracer span per pass, nested under the Executor's
+                # executor/pass_pipeline span (observe/tracer.py)
+                with otrace.span(f"pass/{p.name}"):
+                    changed = bool(p.apply(work, ctx)) or changed
         stat_add("pass_pipeline_apply")
         return work if changed else program
 

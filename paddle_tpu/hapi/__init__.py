@@ -1,6 +1,7 @@
 """High-level API (reference python/paddle/hapi/)."""
 from . import callbacks  # noqa: F401
 from .callbacks import (  # noqa: F401
+    BenchmarkCallback,
     Callback,
     EarlyStopping,
     LRScheduler,

@@ -157,6 +157,95 @@ class EarlyStopping(Callback):
                 self.model.stop_training = True
 
 
+class BenchmarkCallback(Callback):
+    """Step-telemetry callback (the hapi face of ``paddle_tpu.observe``).
+
+    Times every train batch into the ``hapi_step_time_seconds``
+    histogram (log-bucketed; p50/p95/p99 ride ``export_stats()``,
+    ``/stats`` and ``/metrics``) and reports a throughput + MFU summary
+    at ``on_train_end``.  Works in both adapters: in static mode the
+    Executor's own StepTimer supplies the FLOPs/allreduce accounting
+    (merged into ``summary()``); in dygraph mode pass
+    ``flops_per_step=`` (e.g. from ``paddle.flops``) for an MFU number.
+    """
+
+    HIST = "hapi_step_time_seconds"
+
+    def __init__(self, batch_size=None, flops_per_step=None, log_freq=0,
+                 peak_tflops=None):
+        super().__init__()
+        self.batch_size = batch_size
+        self.flops_per_step = flops_per_step
+        self.log_freq = int(log_freq)
+        self.peak_tflops = peak_tflops
+        self.last_summary = None
+        self._t0 = None
+        self._steps = 0
+        self._time = 0.0
+
+    def on_train_begin(self, logs=None):
+        from .. import observe
+
+        observe.histogram(self.HIST).reset()
+        self._steps = 0
+        self._time = 0.0
+
+    def on_train_batch_begin(self, step, logs=None):
+        self._t0 = time.perf_counter()
+
+    def on_train_batch_end(self, step, logs=None):
+        if self._t0 is None:
+            return
+        from .. import observe
+
+        dt = time.perf_counter() - self._t0
+        observe.stat_time(self.HIST, dt)
+        self._steps += 1
+        self._time += dt
+        if self.log_freq and (step + 1) % self.log_freq == 0:
+            s = observe.histogram(self.HIST).summary()
+            print(f"[bench] step {step + 1}: "
+                  f"p50 {s.get('p50', 0) * 1e3:.2f}ms "
+                  f"p95 {s.get('p95', 0) * 1e3:.2f}ms "
+                  f"({self._steps / max(self._time, 1e-9):.1f} steps/s)")
+
+    def summary(self):
+        from .. import observe
+
+        hist = observe.histogram(self.HIST).summary()
+        out = {"steps": self._steps, "step_time_s": hist}
+        if self._steps and self._time > 0:
+            out["steps_per_sec"] = round(self._steps / self._time, 3)
+            if self.batch_size:
+                out["examples_per_sec"] = round(
+                    self.batch_size * self._steps / self._time, 3)
+            if self.flops_per_step:
+                mfu = observe.mfu_estimate(
+                    self.flops_per_step, self._time / self._steps,
+                    self.peak_tflops)
+                out["mfu"] = float(f"{mfu:.4g}")
+        if "mfu" not in out:
+            # static adapter: the Executor's StepTimer priced the
+            # program IR (hapi/model_stat.py) — reuse its MFU
+            exec_summary = observe.step_timer().summary(self.peak_tflops)
+            for k in ("mfu", "flops_per_step", "allreduce_bytes_per_step"):
+                if k in exec_summary:
+                    out[k] = exec_summary[k]
+        return out
+
+    def on_train_end(self, logs=None):
+        self.last_summary = s = self.summary()
+        if self._steps:
+            parts = [f"steps {s['steps']}",
+                     f"p50 {s['step_time_s'].get('p50', 0) * 1e3:.2f}ms",
+                     f"p95 {s['step_time_s'].get('p95', 0) * 1e3:.2f}ms"]
+            if "examples_per_sec" in s:
+                parts.append(f"{s['examples_per_sec']:.1f} ex/s")
+            if "mfu" in s:
+                parts.append(f"MFU {s['mfu']:.3f}")
+            print("[bench] " + " - ".join(parts))
+
+
 class LRScheduler(Callback):
     def __init__(self, by_step=True, by_epoch=False):
         super().__init__()

@@ -61,14 +61,44 @@ _ELEMENTWISE = {
     "sigmoid", "tanh", "gelu", "scale", "softmax", "cast", "clip",
 }
 
+_GRAD_CONV = {"conv2d_grad", "depthwise_conv2d_grad",
+              "conv2d_transpose_grad"}
+_GRAD_MATMUL = {"matmul_grad", "matmul_v2_grad", "mul_grad"}
+
+
+class _FwdSlotView:
+    """Grad ops carry the forward op's full slots in their INPUTS
+    (backward.default_grad_maker copies them); this shim re-views a
+    grad op through the forward slot names so the forward estimators
+    can price the backward work.  A matmul/conv backward is two
+    forward-sized contractions (dX and dY/dFilter), hence the 2x in
+    ``program_flops``."""
+
+    __slots__ = ("_op", "type")
+
+    def __init__(self, op):
+        self._op = op
+        self.type = op.attr("__fwd_type__", None) or op.type[:-len("_grad")]
+
+    def input(self, slot):
+        return self._op.inputs.get(slot, [])
+
+    def output(self, slot):  # fwd outputs live in the grad op's inputs
+        return self._op.inputs.get(slot, [])
+
+    def attr(self, name, default=None):
+        return self._op.attr(name, default)
+
 
 def program_flops(program, detail=False):
     """FLOPs of one execution of ``program``'s global block.
 
-    Matmuls/convs count 2*MACs (the MXU work); elementwise ops count one
-    FLOP per output element (VPU work); everything else is free (layout,
-    control, IO).  Returns total FLOPs, plus a per-op-type breakdown
-    when ``detail=True``."""
+    Matmuls/convs count 2*MACs (the MXU work) and their ``_grad``
+    siblings 2x that (dX + dW are forward-sized contractions, priced
+    through the forward slots the grad maker copies); elementwise ops
+    count one FLOP per output element (VPU work); everything else is
+    free (layout, control, IO).  Returns total FLOPs, plus a
+    per-op-type breakdown when ``detail=True``."""
     block = program.global_block
     per_type: Dict[str, int] = {}
     for op in block.ops:
@@ -76,6 +106,13 @@ def program_flops(program, detail=False):
             f = _conv_flops(block, op)
         elif op.type in ("matmul", "matmul_v2", "mul"):
             f = _matmul_flops(block, op)
+        elif op.type in _GRAD_CONV or op.type in _GRAD_MATMUL:
+            # backward = dX + dW, each a forward-sized contraction
+            est = _conv_flops if op.type in _GRAD_CONV else _matmul_flops
+            try:
+                f = 2 * est(block, _FwdSlotView(op))
+            except (IndexError, KeyError):  # hand-built grad op missing
+                f = 0                       # the forward slots: skip
         elif op.type in _ELEMENTWISE:
             outs = op.output_arg_names()
             f = _prod(_shape_of(block, outs[0])) if outs else 0

@@ -15,7 +15,7 @@ import threading
 from typing import Dict, List, Tuple
 
 __all__ = ["StatRegistry", "stat_add", "stat_get", "stat_reset",
-           "stat_set", "stat_max", "export_stats"]
+           "stat_set", "stat_max", "stat_time", "export_stats"]
 
 
 class _Stat:
@@ -127,5 +127,20 @@ def stat_reset(name: str = None) -> None:
     StatRegistry.instance().reset(name)
 
 
-def export_stats() -> List[Tuple[str, int]]:
-    return StatRegistry.instance().export()
+def stat_time(name: str, seconds: float) -> None:
+    """Latency observation — the timing sibling of STAT_ADD.  Feeds the
+    log-bucketed histogram registry (observe/histogram.py); p50/p95/p99
+    come back through ``export_stats()``/``/stats``/``/metrics``."""
+    from .observe.histogram import stat_time as _stat_time
+
+    _stat_time(name, seconds)
+
+
+def export_stats() -> List[Tuple[str, float]]:
+    """Counters plus flattened histogram summaries (``<name>_p50`` ...),
+    one sorted snapshot — counters stay ints, histogram rows are floats."""
+    out = list(StatRegistry.instance().export())
+    from .observe.histogram import histogram_summaries
+
+    out.extend(histogram_summaries())
+    return sorted(out)

@@ -1,0 +1,41 @@
+"""``paddle_tpu.observe`` — always-on in-process telemetry.
+
+The reference ships a full observability stack (CUPTI ``DeviceTracer``
+→ ``profiler.proto`` → ``tools/timeline.py``, plus ``StatRegistry``
+counters); the TPU-native port previously covered only the thin ends.
+This package is the middle:
+
+- ``tracer``     — host-side span ring buffer (``FLAGS_enable_tracer``),
+  fed by the Executor phases, graph passes, collective lowerings, the
+  serving batch lifecycle, and every ``profiler.RecordEvent``.
+- ``timeline``   — Chrome trace-event JSON export of that buffer
+  (Perfetto/chrome://tracing), plus a
+  ``python -m paddle_tpu.observe.timeline`` CLI.
+- ``histogram``  — log-bucketed ``stat_time`` latency histograms with
+  p50/p95/p99, and the Prometheus text exposition behind the fleet KV
+  HTTP server's ``/metrics`` route.
+- ``step_stats`` — ``StepTimer``: step-time distribution, examples/sec,
+  compile-vs-execute split, allreduce bytes/step, and the MFU estimate
+  (FLOPs from ``hapi/model_stat.py`` over the program IR).
+"""
+from .histogram import (Histogram, HistogramRegistry, export_histograms,
+                        histogram, prometheus_text, stat_time)
+from .step_stats import (StepTimer, mfu_estimate, reset_step_stats,
+                         step_timer)
+from .tracer import (SpanRecord, Tracer, begin, clear, disable, enable,
+                     enabled, end, get_tracer, set_span_args, snapshot,
+                     span)
+from .timeline import chrome_trace, export_chrome_trace
+
+__all__ = [
+    # tracer
+    "SpanRecord", "Tracer", "get_tracer", "enabled", "enable", "disable",
+    "span", "begin", "end", "set_span_args", "snapshot", "clear",
+    # timeline
+    "chrome_trace", "export_chrome_trace",
+    # histograms
+    "Histogram", "HistogramRegistry", "histogram", "stat_time",
+    "export_histograms", "prometheus_text",
+    # step telemetry
+    "StepTimer", "step_timer", "reset_step_stats", "mfu_estimate",
+]

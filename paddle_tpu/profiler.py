@@ -8,7 +8,12 @@ profiler.proto, capture goes through ``jax.profiler`` — the trace contains
 every XLA executable launch and on-device op, viewable in
 TensorBoard/Perfetto (replaces tools/timeline.py's chrome://tracing dump).
 ``RecordEvent`` maps to ``jax.profiler.TraceAnnotation`` so user-code
-phases appear on the host timeline alongside device ops.
+phases appear on the host timeline alongside device ops — and
+dual-feeds the always-on in-process span tracer
+(``paddle_tpu.observe``): the TraceAnnotation path lights up when an
+XLA capture is live, the ring-buffer span whenever
+``FLAGS_enable_tracer`` is set, so one annotation serves both the
+heavyweight capture and the exportable host timeline.
 """
 from __future__ import annotations
 
@@ -16,6 +21,8 @@ import contextlib
 import os
 import time
 from typing import Optional
+
+from .observe import tracer as _otracer
 
 _state = {"running": False, "dir": None, "t0": None}
 
@@ -26,13 +33,20 @@ class RecordEvent:
     Usable as a context manager, via explicit begin()/end(), or as a
     function decorator (``@RecordEvent("serving/batch")`` wraps every
     call of the function in its own span).  Shows up as a named span on
-    the profiler timeline when a capture is active; costs ~nothing when
-    no capture is running.
+    the profiler timeline when a capture is active AND in the observe
+    tracer's ring buffer when ``FLAGS_enable_tracer`` is set; costs
+    ~nothing when neither is running.
     """
 
     def __init__(self, name: str):
+        import threading
+
         self.name = name
-        self._ann = None
+        # per-THREAD LIFO of live annotations: one RecordEvent instance
+        # may be shared across threads or re-entered (explicit
+        # begin()/end() API) without corrupting the tracer's span stack
+        # or leaking a TraceAnnotation
+        self._local = threading.local()
 
     def __call__(self, fn):
         import functools
@@ -44,16 +58,27 @@ class RecordEvent:
 
         return wrapped
 
+    def _entries(self):
+        st = getattr(self._local, "entries", None)
+        if st is None:
+            st = self._local.entries = []
+        return st
+
     def begin(self):
         import jax
 
-        self._ann = jax.profiler.TraceAnnotation(self.name)
-        self._ann.__enter__()
+        # tracer begin/end are balance-safe across FLAGS_enable_tracer
+        # flips (disabled begin pushes a discard sentinel)
+        _otracer.begin(self.name)
+        ann = jax.profiler.TraceAnnotation(self.name)
+        ann.__enter__()
+        self._entries().append(ann)
 
     def end(self):
-        if self._ann is not None:
-            self._ann.__exit__(None, None, None)
-            self._ann = None
+        entries = self._entries()
+        if entries:
+            entries.pop().__exit__(None, None, None)
+        _otracer.end()
 
     def __enter__(self):
         self.begin()
@@ -78,8 +103,14 @@ def start_profiler(state: str = "All", tracer_option: str = "Default",
     out = profile_path or os.environ.get("PADDLE_TPU_PROFILE_DIR",
                                          "/tmp/paddle_tpu_profile")
     os.makedirs(out, exist_ok=True)
-    jax.profiler.start_trace(out)
     _state.update(running=True, dir=out, t0=time.perf_counter())
+    try:
+        jax.profiler.start_trace(out)
+    except Exception:
+        # a failed capture must not wedge the "already running" check
+        # for the rest of the process
+        _state.update(running=False, dir=None, t0=None)
+        raise
 
 
 def stop_profiler(sorted_key: Optional[str] = None,
@@ -92,9 +123,12 @@ def stop_profiler(sorted_key: Optional[str] = None,
 
     if not _state["running"]:
         raise RuntimeError("profiler is not running")
+    out = _state["dir"]
     jax.profiler.stop_trace()
-    _state["running"] = False
-    return _state["dir"]
+    # full reset (not just the running bit): a later start must never
+    # see this capture's dir/t0
+    _state.update(running=False, dir=None, t0=None)
+    return out
 
 
 @contextlib.contextmanager

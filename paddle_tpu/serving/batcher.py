@@ -29,6 +29,8 @@ from typing import Dict, Optional
 import numpy as np
 
 from ..monitor import stat_add, stat_max, stat_set
+from ..observe import tracer as otrace
+from ..observe.histogram import stat_time
 from ..profiler import RecordEvent
 from .buckets import (BucketSpec, DeadlineExceededError, QueueFullError,
                       ServerClosedError, ServingError, assemble,
@@ -132,26 +134,27 @@ class Batcher:
 
     # -- client side -----------------------------------------------------
     def submit(self, feeds, deadline_ms=_UNSET) -> InferenceRequest:
-        arrays, nrows, key = plan_request(feeds, self._plans, self._spec)
-        if deadline_ms is _UNSET:
-            deadline_ms = self._default_deadline_ms
-        deadline = None if deadline_ms is None \
-            else time.monotonic() + float(deadline_ms) / 1e3
-        req = InferenceRequest(arrays, nrows, key, deadline)
-        with self._cond:
-            if self._closing:
-                raise ServerClosedError("server is draining/stopped")
-            if len(self._queue) >= self._max_queue:
-                stat_add("serving_rejected_queue_full")
-                raise QueueFullError(
-                    f"request queue is at capacity ({self._max_queue}); "
-                    f"retry with backoff")
-            self._queue.append(req)
-            stat_add("serving_requests")
-            stat_set("serving_queue_depth", len(self._queue))
-            stat_max("serving_queue_depth_max", len(self._queue))
-            self._cond.notify_all()
-        return req
+        with otrace.span("serving/enqueue"):
+            arrays, nrows, key = plan_request(feeds, self._plans, self._spec)
+            if deadline_ms is _UNSET:
+                deadline_ms = self._default_deadline_ms
+            deadline = None if deadline_ms is None \
+                else time.monotonic() + float(deadline_ms) / 1e3
+            req = InferenceRequest(arrays, nrows, key, deadline)
+            with self._cond:
+                if self._closing:
+                    raise ServerClosedError("server is draining/stopped")
+                if len(self._queue) >= self._max_queue:
+                    stat_add("serving_rejected_queue_full")
+                    raise QueueFullError(
+                        f"request queue is at capacity ({self._max_queue}); "
+                        f"retry with backoff")
+                self._queue.append(req)
+                stat_add("serving_requests")
+                stat_set("serving_queue_depth", len(self._queue))
+                stat_max("serving_queue_depth_max", len(self._queue))
+                self._cond.notify_all()
+            return req
 
     def infer(self, feeds, deadline_ms=_UNSET):
         return self.submit(feeds, deadline_ms=deadline_ms).result()
@@ -261,25 +264,31 @@ class Batcher:
                     # paused or idle
                     self._cond.wait(0.05 if self._queue else None)
                 head = self._queue[0]
-                window_end = head.t_enqueue + self._window
-                while (not self._closing
-                       and self._group_rows_locked(head.key)
-                       < self._spec.max_batch):
-                    remaining = window_end - time.monotonic()
-                    if remaining <= 0:
-                        break
-                    self._cond.wait(remaining)
-                batch = self._take_group_locked(head.key)
+                # the coalescing window IS the span: its duration shows
+                # how long requests sat waiting for batch-mates
+                with otrace.span("serving/coalesce"):
+                    window_end = head.t_enqueue + self._window
+                    while (not self._closing
+                           and self._group_rows_locked(head.key)
+                           < self._spec.max_batch):
+                        remaining = window_end - time.monotonic()
+                        if remaining <= 0:
+                            break
+                        self._cond.wait(remaining)
+                    batch = self._take_group_locked(head.key)
             if batch:
                 self._execute(batch)
 
     def _execute(self, requests):
         try:  # assembly failures must not kill the consumer thread
-            feeds, total, bucket_rows = assemble(
-                requests, requests[0].key, self._spec, self._pad_value)
-            with RecordEvent(f"serving/batch_b{bucket_rows}"):
-                outs = self._runner(feeds)
-            outs = [np.asarray(o) for o in outs]
+            with otrace.span("serving/pad", requests=len(requests)):
+                feeds, total, bucket_rows = assemble(
+                    requests, requests[0].key, self._spec, self._pad_value)
+            with otrace.span("serving/execute", rows=bucket_rows,
+                             requests=len(requests)):
+                with RecordEvent(f"serving/batch_b{bucket_rows}"):
+                    outs = self._runner(feeds)
+                outs = [np.asarray(o) for o in outs]
         except Exception as e:  # noqa: BLE001 — fault isolation per batch
             for r in requests:
                 if r._complete(error=e):
@@ -301,15 +310,20 @@ class Batcher:
             return
         now = time.monotonic()
         offset = 0
-        for r in requests:
-            # copy: a view would pin the whole bucket-padded batch (and
-            # other requests' rows) for as long as the client holds it
-            sliced = [o[offset:offset + r.nrows].copy() for o in outs]
-            offset += r.nrows
-            if r._complete(result=sliced):
-                stat_add("serving_completed")
-                stat_add("serving_latency_us_total",
-                         int((now - r.t_enqueue) * 1e6))
+        with otrace.span("serving/reply", requests=len(requests)):
+            for r in requests:
+                # copy: a view would pin the whole bucket-padded batch
+                # (and other requests' rows) for as long as the client
+                # holds it
+                sliced = [o[offset:offset + r.nrows].copy() for o in outs]
+                offset += r.nrows
+                if r._complete(result=sliced):
+                    stat_add("serving_completed")
+                    stat_add("serving_latency_us_total",
+                             int((now - r.t_enqueue) * 1e6))
+                    # tail latency is THE serving metric: p50/p95/p99
+                    # ride /stats, /metrics, and export_stats()
+                    stat_time("serving_latency_seconds", now - r.t_enqueue)
         stat_add("serving_batches")
         stat_add("serving_batched_requests", len(requests))
         stat_add("serving_batched_rows", total)

@@ -15,8 +15,11 @@ Lifecycle::
     outs = srv.infer({"x": x})   # thread-safe, blocks for the result
     srv.stop(drain=True)         # refuse new work, finish the queue
 
-``http_port`` exposes GET ``/stats`` (counter snapshot) and
-``/health`` (liveness + queue depth) through the fleet KV HTTP server.
+``http_port`` exposes GET ``/stats`` (counter snapshot incl. latency
+p50/p95/p99), ``/health`` (liveness + queue depth), and ``/metrics``
+(Prometheus text exposition, registered by the fleet KV HTTP server
+itself) — point a Prometheus scraper at the port and the serving
+latency histogram + every runtime counter shows up.
 """
 from __future__ import annotations
 

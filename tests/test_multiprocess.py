@@ -26,6 +26,21 @@ from paddle_tpu.framework.program import Program, program_guard
 
 TRAINER = os.path.join(os.path.dirname(__file__), "dist_trainer.py")
 
+# capability probe (framework/jax_compat.py): jax versions without the
+# jax_cpu_collectives_implementation config have NO cross-process CPU
+# collectives — the XLA CPU client rejects multiprocess computations
+# outright ("Multiprocess computations aren't implemented on the CPU
+# backend"), so the localhost federation these tests ride cannot exist.
+# Before the guarded accessor this surfaced as an AttributeError inside
+# init_parallel_env; now it is an explicit environment skip.
+from paddle_tpu.framework.jax_compat import has_config  # noqa: E402
+
+if not has_config("jax_cpu_collectives_implementation"):
+    pytest.skip(
+        "installed jax has no CPU cross-process collectives backend "
+        "(jax_cpu_collectives_implementation config absent)",
+        allow_module_level=True)
+
 
 def _free_port():
     with socket.socket() as s:

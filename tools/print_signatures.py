@@ -45,6 +45,7 @@ MODULES = [
     "paddle_tpu.autograd",
     "paddle_tpu.slim",
     "paddle_tpu.monitor",
+    "paddle_tpu.observe",
     "paddle_tpu.framework.passes",
     "paddle_tpu.serving",
     "paddle_tpu.utils",
