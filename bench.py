@@ -290,6 +290,49 @@ def bench_serving(pt, jax):
         shutil.rmtree(d, ignore_errors=True)
 
 
+CKPT_ARRAYS = 16
+CKPT_ARRAY_ELEMS = 1 << 20  # 16 x 4MB fp32 = 64MB per checkpoint
+CKPT_SAVES = 5
+
+
+def bench_checkpoint(pt):
+    """Blocking-time-per-save of the async checkpoint manager
+    (paddle_tpu.ckpt) on a 64MB synthetic state: save() should block
+    only for the host snapshot hand-off while the writer thread does
+    serialization + fsync + manifest commit off the step loop.  Returns
+    (mean blocking ms, p50 full-write ms from the ckpt_write_seconds
+    histogram, MB per save)."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from paddle_tpu import observe
+    from paddle_tpu.ckpt import CheckpointManager
+
+    rs = np.random.RandomState(0)
+    state = {f"w{i}": rs.standard_normal(CKPT_ARRAY_ELEMS).astype("f4")
+             for i in range(CKPT_ARRAYS)}
+    mb = sum(a.nbytes for a in state.values()) / 2 ** 20
+    d = tempfile.mkdtemp(prefix="ckpt_bench_")
+    try:
+        observe.histogram("ckpt_write_seconds").reset()
+        m = CheckpointManager(d, keep_n=2, async_save=True)
+        m.save(0, state=state, wait=True)  # warm the writer thread
+        blocking = []
+        for s in range(1, CKPT_SAVES + 1):
+            t0 = time.perf_counter()
+            m.save(s, state=state)
+            blocking.append(time.perf_counter() - t0)
+            m.wait()  # measure every save (no coalescing in the bench)
+        m.close()
+        hist = observe.histogram("ckpt_write_seconds").summary()
+        return (1e3 * sum(blocking) / len(blocking),
+                1e3 * hist.get("p50", 0.0), mb)
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
 FUSION_NRANKS = 4
 
 
@@ -409,6 +452,13 @@ def main():
                                             "post_fusion": post}
     except Exception as e:
         errors["allreduce_fusion"] = f"{type(e).__name__}: {e}"[:500]
+    try:
+        blk_ms, write_ms, ckpt_mb = bench_checkpoint(pt)
+        result["ckpt_save_blocking_ms"] = round(blk_ms, 3)
+        result["ckpt_write_ms_p50"] = round(write_ms, 3)
+        result["ckpt_mb_per_save"] = round(ckpt_mb, 1)
+    except Exception as e:
+        errors["checkpoint"] = f"{type(e).__name__}: {e}"[:500]
     try:
         observe.reset_step_stats()
         ips = bench_resnet(pt, jax)

@@ -73,6 +73,7 @@ from . import inference  # noqa: F401
 from . import jit  # noqa: F401
 from . import monitor  # noqa: F401
 from . import observe  # noqa: F401
+from . import ckpt  # noqa: F401
 from .hapi.model_stat import flops, summary  # noqa: F401
 from . import profiler  # noqa: F401
 from . import static  # noqa: F401

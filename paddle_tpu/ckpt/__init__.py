@@ -1,0 +1,28 @@
+"""``paddle_tpu.ckpt`` — asynchronous, atomic checkpointing.
+
+The production checkpoint subsystem (SURVEY §5 failure-recovery row,
+beyond the reference's blocking ``save_persistables``):
+
+- :class:`CheckpointManager` — async background writes, atomic
+  tmp+manifest+rename commits, SHA-256 integrity, retention GC,
+  pending-save coalescing, per-rank sharded multi-process commit.
+- :func:`snapshot_scope` / :class:`LocalShard` — device->host state
+  extraction on the step boundary (the only blocking part of a save).
+- :class:`ResumableIterator` — data-iterator position as checkpoint
+  state, so resume continues the exact batch sequence.
+- :class:`KVBarrier` — commit barrier over the fleet KV HTTP server.
+- :func:`wait_all` — drain every live manager (``Executor.close()`` and
+  interpreter exit call this; a shutdown never abandons a queued save).
+
+``paddle_tpu.distributed.checkpoint`` (``save_sharded``/``load_sharded``),
+``paddle_tpu.incubate.checkpoint.auto_checkpoint`` and
+``hapi.callbacks.ModelCheckpoint`` are all built on this manager.
+"""
+from .data import ResumableIterator
+from .manager import CheckpointError, CheckpointManager, KVBarrier, wait_all
+from .state import LocalShard, restore_scope, snapshot_scope
+
+__all__ = [
+    "CheckpointManager", "CheckpointError", "KVBarrier", "wait_all",
+    "LocalShard", "snapshot_scope", "restore_scope", "ResumableIterator",
+]

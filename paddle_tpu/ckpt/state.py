@@ -1,0 +1,104 @@
+"""Device-state extraction for checkpoints: scope -> host arrays.
+
+The step boundary is the only moment the training state is consistent,
+so ``snapshot_scope`` runs there on the caller's thread: every scope
+variable is copied device->host (``np.asarray`` == ``jax.device_get``)
+and the resulting dict is immutable from the executor's point of view —
+the compiled step donates and replaces scope arrays, it never mutates
+them in place, so the background writer can serialize the snapshot
+while training continues.
+
+Multi-process layout: a process saves exactly what it can address.
+Fully-addressable arrays (single process, or replicated values) come
+back as plain ``np.ndarray``; a globally-sharded array (ZeRO optimizer
+state over the dp axis) comes back as a :class:`LocalShard` carrying
+this process's contiguous axis-0 block plus the global shape, so every
+rank writes only its own bytes and restore re-assembles the full value
+from the rank files (elastic: the reading world size need not match the
+writing one).
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+
+class LocalShard:
+    """This process's contiguous axis-0 block of a globally-sharded
+    array.  ``array`` is host data; ``global_shape`` is the full value's
+    shape.  Restore concatenates the rank blocks in rank order (mesh
+    devices are built process-major, so axis-0 blocks are contiguous per
+    process — see parallel_env.init_parallel_env)."""
+
+    __slots__ = ("array", "global_shape")
+
+    def __init__(self, array, global_shape):
+        self.array = np.asarray(array)
+        self.global_shape = tuple(int(d) for d in global_shape)
+
+    @property
+    def dtype(self):
+        return self.array.dtype
+
+    def __repr__(self):
+        return (f"LocalShard(block={self.array.shape}, "
+                f"global={self.global_shape})")
+
+
+def _host_value(v):
+    """One scope value -> np.ndarray | LocalShard | None (skip)."""
+    if v is None:
+        return None
+    # jax array (duck-typed; see executor._is_jax_array)
+    if hasattr(v, "sharding") and hasattr(v, "dtype"):
+        if getattr(v, "is_fully_addressable", True):
+            return np.asarray(v)
+        # multi-process global array: gather the addressable blocks
+        blocks = {}
+        for s in v.addressable_shards:
+            idx = s.index[0] if s.index else slice(None)
+            start = idx.start or 0 if isinstance(idx, slice) else 0
+            blocks[start] = s.data
+        parts = [np.asarray(blocks[k]) for k in sorted(blocks)]
+        if len(parts) == 1 and parts[0].shape == tuple(v.shape):
+            return parts[0]  # replicated across this process's devices
+        return LocalShard(np.concatenate(parts, axis=0), v.shape)
+    try:
+        arr = np.asarray(v)
+    except Exception:
+        return None
+    if arr.dtype == object:
+        return None
+    return arr
+
+
+def snapshot_scope(scope, var_names: Optional[Sequence[str]] = None
+                   ) -> Dict[str, object]:
+    """Copy the scope's state to host.  ``var_names=None`` takes every
+    local variable (parameters, optimizer slots, AMP loss-scale state,
+    the RNG key — the executor writes nothing else back)."""
+    if var_names is None:
+        var_names = [n for n in scope.local_var_names()]
+    out: Dict[str, object] = {}
+    for n in var_names:
+        hv = _host_value(scope.get_var(n) if scope.has_var(n) else None)
+        if hv is not None:
+            out[n] = hv
+    return out
+
+
+def restore_scope(scope, state: Dict[str, np.ndarray],
+                  var_names: Optional[Sequence[str]] = None) -> list:
+    """Write restored host arrays into the scope.  Values go in as
+    uncommitted np arrays: the next executor run places (and shards)
+    them per the compiled step's input specs, so a checkpoint written on
+    one topology restores onto any other."""
+    names = set(var_names) if var_names is not None else None
+    restored = []
+    for n, v in state.items():
+        if names is not None and n not in names:
+            continue
+        scope.set_var(n, v)
+        restored.append(n)
+    return restored

@@ -1,13 +1,16 @@
-"""Sharded (multi-host) checkpointing over orbax.
+"""Sharded (multi-host) checkpointing over ``paddle_tpu.ckpt``.
 
 Role parity: the reference saves per-var LoDTensor streams through
 save/load ops (save_op.cc:85) — single-host, full tensors.  TPU-native:
 scope state can be GLOBAL jax arrays sharded over a mesh (ZeRO optimizer
 shards, dp-replicated params, multi-process runs), so checkpoints go
-through orbax: every process writes exactly its shards, restore
-re-assembles onto the current mesh, and replicated arrays are written
-once.  This is the "exceed the reference" item SURVEY §5 calls for in
-the failure-recovery row.
+through the :class:`~paddle_tpu.ckpt.CheckpointManager`: every process
+writes exactly its shards (``shard_r<k>.npz``), rank 0 commits an
+atomic SHA-256 manifest after the fleet barrier, restore re-assembles
+the full values host-side and the next executor run re-distributes them
+onto the CURRENT mesh — so a checkpoint written on one topology resumes
+on any other (elastic).  This is the "exceed the reference" item SURVEY
+§5 calls for in the failure-recovery row.
 
 The single-host var_io format (fluid/io.py) remains the default for
 plain programs; use this module when state lives on a mesh.
@@ -17,54 +20,51 @@ from __future__ import annotations
 import os
 from typing import Dict, Optional, Sequence
 
-import numpy as np
+from ..ckpt import CheckpointError, CheckpointManager
+
+# one manager per directory for the process lifetime (the old orbax
+# path re-created its checkpointer object on every call)
+_MANAGERS: Dict[str, CheckpointManager] = {}
 
 
-def _checkpointer():
-    import orbax.checkpoint as ocp
-
-    return ocp.StandardCheckpointer()
-
-
-def _collect(scope, var_names: Optional[Sequence[str]]):
-    from ..framework.executor import RNG_VAR
-
-    if var_names is None:
-        var_names = [n for n in scope.local_var_names()
-                     if n != RNG_VAR and scope.get_var(n) is not None]
-    return {n: scope.get_var(n) for n in var_names}
+def _manager(dirname: str) -> CheckpointManager:
+    key = os.path.abspath(dirname)
+    m = _MANAGERS.get(key)
+    if m is None:
+        # synchronous by design: save_sharded's contract is "returns ==
+        # checkpoint durable" (callers sequence their own step loops);
+        # use CheckpointManager directly for async saves
+        m = _MANAGERS[key] = CheckpointManager(key, async_save=False)
+    return m
 
 
 def save_sharded(scope, dirname, var_names: Optional[Sequence[str]] = None):
-    """Write the scope's state as an orbax checkpoint.  Sharded arrays
-    are written distributed (each process stores its own shards); call
-    from EVERY process of a multi-process run."""
-    state = _collect(scope, var_names)
-    ckptr = _checkpointer()
-    ckptr.save(os.path.join(os.path.abspath(dirname), "state"), state,
-               force=True)
-    ckptr.wait_until_finished()
-    return sorted(state)
+    """Write the scope's state as a committed checkpoint step under
+    ``dirname``.  Sharded arrays are written distributed (each process
+    stores its own axis-0 block); call from EVERY process of a
+    multi-process run.  Returns the sorted saved variable names."""
+    m = _manager(dirname)
+    return m.save(m.next_step(), scope=scope, var_names=var_names,
+                  wait=True)
 
 
 def load_sharded(scope, dirname, var_names: Optional[Sequence[str]] = None):
-    """Restore into the scope.  Each var's target shape/dtype/sharding is
-    taken from the CURRENT scope value (run the startup program — and for
-    lazily-materialized sharded state, one step — first), so arrays come
-    back distributed exactly as the executor expects them."""
-    import jax
-
-    state = _collect(scope, var_names)
-    target = {}
-    for n, v in state.items():
-        if hasattr(v, "sharding") and hasattr(v, "dtype"):
-            target[n] = jax.ShapeDtypeStruct(v.shape, v.dtype,
-                                             sharding=v.sharding)
-        else:
-            target[n] = np.asarray(v)
-    ckptr = _checkpointer()
-    restored = ckptr.restore(os.path.join(os.path.abspath(dirname), "state"),
-                             target=target)
-    for n, v in restored.items():
-        scope.set_var(n, v)
-    return sorted(restored)
+    """Restore the newest intact checkpoint under ``dirname`` into the
+    scope.  Values land as host arrays; the next executor run places
+    and re-shards them per the compiled step's input specs (run the
+    startup program — and for lazily-materialized sharded state, one
+    step — first so the step is compiled for the right layout)."""
+    dirname = os.path.abspath(dirname)
+    if not os.path.isdir(dirname):
+        raise CheckpointError(
+            f"load_sharded: checkpoint directory {dirname!r} does not "
+            f"exist (nothing was ever saved here, or the path is wrong)")
+    m = _manager(dirname)
+    meta = m.restore(scope=scope, var_names=var_names)
+    if meta is None:
+        raise CheckpointError(
+            f"load_sharded: {dirname!r} contains no committed "
+            f"checkpoint (empty directory, or only torn .tmp saves "
+            f"from a crashed run)")
+    return list(meta["vars"]) if var_names is None else sorted(
+        n for n in meta["vars"] if n in set(var_names))

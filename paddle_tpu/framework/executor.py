@@ -1186,6 +1186,16 @@ class Executor:
         return fn, globalize
 
     def close(self):
+        # drain pending async checkpoint saves FIRST: a shutdown must
+        # never abandon a queued snapshot mid-write (the manager's
+        # atomic commit makes a torn abort recoverable, but a clean
+        # close should finish the work it accepted)
+        try:
+            from ..ckpt import wait_all as _ckpt_wait_all
+
+            _ckpt_wait_all(raise_errors=False)
+        except ImportError:  # pragma: no cover - partial installs
+            pass
         # clear EVERY per-program cache: long-lived serving processes
         # otherwise leak analysis/prune/pass entries for dead programs
         self._cache.clear()

@@ -115,6 +115,21 @@ define_flag("enable_tracer", False,
             "observe.export_chrome_trace() — independent of jax.profiler "
             "captures (reference FLAGS_enable_rpc_profiler / DeviceTracer "
             "role, CUPTI replaced by a pure-host ring buffer)")
+define_flag("ckpt_async_save", True,
+            "CheckpointManager default (paddle_tpu.ckpt): hand "
+            "serialization + shard writes to the background writer "
+            "thread so save() blocks only for the device->host snapshot")
+define_flag("ckpt_keep_n", 5,
+            "checkpoint retention default: keep the N newest committed "
+            "steps (0 = keep everything); keep_every_n_steps multiples "
+            "survive GC regardless")
+define_flag("ckpt_fsync", True,
+            "fsync shard/manifest files and directories at commit — the "
+            "atomicity guarantee against power loss; disable only for "
+            "tests/benchmarks on throwaway dirs")
+define_flag("ckpt_verify_restore", True,
+            "verify the SHA-256 of every shard against the manifest "
+            "before restoring (off: existence+size checks only)")
 define_flag("device_peak_tflops", 275.0,
             "per-chip peak TFLOP/s used by the MFU estimate "
             "(observe/step_stats.py); default is TPU v4/v5e-class bf16 "

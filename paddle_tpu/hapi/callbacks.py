@@ -107,19 +107,86 @@ class ProgBarLogger(Callback):
 
 
 class ModelCheckpoint(Callback):
-    def __init__(self, save_freq=1, save_dir=None):
+    """Epoch checkpointing through ``paddle_tpu.ckpt``: commits are
+    atomic (manifest + rename — a killed run can't leave a torn epoch
+    dir), ``keep_n`` retention-GCs old epochs, and ``async_save=True``
+    hands serialization + writes to the background writer so the train
+    loop only blocks for the host-side state capture.  ``on_train_end``
+    drains pending saves and still writes the legacy ``final`` export
+    via ``Model.save``.  ``restore_latest(model)`` reloads the newest
+    intact epoch (falling back past corrupt ones)."""
+
+    def __init__(self, save_freq=1, save_dir=None, keep_n=0,
+                 async_save=None):
         super().__init__()
         self.save_freq = save_freq
         self.save_dir = save_dir
+        self.keep_n = keep_n
+        self.async_save = async_save
+        self._manager = None
+
+    def _mgr(self):
+        if self._manager is None:
+            from ..ckpt import CheckpointManager
+
+            self._manager = CheckpointManager(
+                self.save_dir, keep_n=self.keep_n,
+                async_save=self.async_save)
+        return self._manager
+
+    def _capture(self):
+        """Host-side state dicts (the blocking part of an async save).
+        Mirrors Model.save(training=True): network params + optimizer
+        state, prefixed so one flat dict round-trips both."""
+        import numpy as np
+
+        model = self.model
+        if getattr(model, "_static_mode", False) and model._st is not None:
+            model._sync_scope_to_network()
+        state = {"param/" + k: np.asarray(v.numpy())
+                 for k, v in model.network.state_dict().items()}
+        opt = getattr(model, "_optimizer", None)
+        if opt is not None and hasattr(opt, "state_dict"):
+            for k, v in opt.state_dict().items():
+                if not isinstance(v, dict):
+                    state["opt/" + k] = np.asarray(v)
+        return state
 
     def on_epoch_end(self, epoch, logs=None):
         if self.save_dir and (epoch + 1) % self.save_freq == 0:
-            path = os.path.join(self.save_dir, f"{epoch}")
-            self.model.save(path)
+            self._mgr().save(epoch, state=self._capture(),
+                             host_state={"epoch": epoch})
 
     def on_train_end(self, logs=None):
         if self.save_dir:
+            if self._manager is not None:
+                self._manager.wait()
             self.model.save(os.path.join(self.save_dir, "final"))
+
+    def restore_latest(self, model=None):
+        """Load the newest intact epoch checkpoint into ``model`` (or
+        the attached one).  Returns the epoch number, or None when the
+        directory holds no committed checkpoint."""
+        import numpy as np
+
+        model = model or self.model
+        meta = self._mgr().restore()
+        if meta is None:
+            return None
+        state = meta["state"]
+        sd = {k[len("param/"):]: np.asarray(v) for k, v in state.items()
+              if k.startswith("param/")}
+        model.network.set_state_dict(sd)
+        if getattr(model, "_static_mode", False) and model._st is not None:
+            scope = model._st["scope"]
+            for p in model.network.parameters():
+                scope.set_var(p.name, np.asarray(p.numpy()))
+        opt = getattr(model, "_optimizer", None)
+        od = {k[len("opt/"):]: np.asarray(v) for k, v in state.items()
+              if k.startswith("opt/")}
+        if od and opt is not None and hasattr(opt, "set_state_dict"):
+            opt.set_state_dict(od)
+        return int(meta["host_state"].get("epoch", meta["step"]))
 
 
 class EarlyStopping(Callback):

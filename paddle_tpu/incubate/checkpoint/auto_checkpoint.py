@@ -3,11 +3,15 @@ resume.
 
 Role parity: reference fluid/incubate/checkpoint/auto_checkpoint.py:71
 (`AutoCheckpointChecker`, `train_epoch_range`, the `_auto_checkpoint`
-hook in Executor.run at executor.py:1200).  TPU-native simplifications:
-checkpoints go through the existing var_io format (the fresh-process
-resume parity test is the oracle), the RNG key and an epoch/step counter
-are saved alongside the persistables, and the rank-0 process writes on
-multi-process runs.
+hook in Executor.run at executor.py:1200).  TPU-native: checkpoints ride
+:class:`paddle_tpu.ckpt.CheckpointManager` — the save is asynchronous
+(training continues while the writer thread serializes), commits are
+atomic with a SHA-256 manifest, old snapshots are retention-GC'd, and
+resume restores the FULL scope (parameters, optimizer slots, AMP
+loss-scale counters, the RNG key) plus the epoch/step counters, so a
+restarted job is bitwise a continuation of the crashed one.  Rank 0
+writes on multi-process runs (the fresh-process resume parity test is
+the oracle).
 
 Enable via env (reference contract) or explicitly::
 
@@ -21,25 +25,26 @@ Enable via env (reference contract) or explicitly::
 """
 from __future__ import annotations
 
-import json
 import os
 import time
 from typing import Optional
-
-import numpy as np
 
 _cfg = None
 
 
 class _Config:
-    def __init__(self, dirname, save_interval_s=10.0, every_n_steps=None):
+    def __init__(self, dirname, save_interval_s=10.0, every_n_steps=None,
+                 async_save=None, keep_n=None):
         self.dirname = dirname
         self.save_interval_s = save_interval_s
         self.every_n_steps = every_n_steps
+        self.async_save = async_save
+        self.keep_n = keep_n
         self.last_save = 0.0
         self.step = 0
         self.epoch_state = {}
         self.resume_attempted = False
+        self.manager = None
 
 
 def _env_config() -> Optional[_Config]:
@@ -52,15 +57,21 @@ def _env_config() -> Optional[_Config]:
     return _Config(path, save_interval_s=interval)
 
 
-def configure(dirname, save_interval_s=10.0, every_n_steps=None):
-    """Programmatic enable (tests / single scripts)."""
+def configure(dirname, save_interval_s=10.0, every_n_steps=None,
+              async_save=None, keep_n=None):
+    """Programmatic enable (tests / single scripts).  ``async_save`` /
+    ``keep_n`` default from ``FLAGS_ckpt_async_save`` /
+    ``FLAGS_ckpt_keep_n``."""
     global _cfg
-    _cfg = _Config(dirname, save_interval_s, every_n_steps)
+    _cfg = _Config(dirname, save_interval_s, every_n_steps,
+                   async_save=async_save, keep_n=keep_n)
     return _cfg
 
 
 def disable():
     global _cfg
+    if _cfg is not None and _cfg.manager is not None:
+        _cfg.manager.close()
     _cfg = None
 
 
@@ -79,59 +90,52 @@ def _ckpt_dir(cfg):
     return os.path.join(cfg.dirname, "auto_ckpt")
 
 
+def _manager(cfg):
+    if cfg.manager is None:
+        from ...ckpt import CheckpointManager
+
+        cfg.manager = CheckpointManager(
+            _ckpt_dir(cfg), keep_n=cfg.keep_n, async_save=cfg.async_save)
+    return cfg.manager
+
+
+def wait(cfg=None):
+    """Drain the pending async save (test/shutdown barrier)."""
+    cfg = cfg or _active()
+    if cfg is not None and cfg.manager is not None:
+        cfg.manager.wait()
+
+
 def save_checkpoint(exe, program, scope, cfg=None):
-    """Write persistables + RNG + counters (reference save_checkpoint)."""
-    from ...fluid import io as fluid_io
-    from ...framework.executor import RNG_VAR
+    """Snapshot the FULL scope + counters through the manager (the
+    reference save_checkpoint saved persistables only and lost the RNG
+    on anything but rank 0's format)."""
     from ...framework.scope import global_scope
 
     cfg = cfg or _active()
     scope = scope or global_scope()
-    out = _ckpt_dir(cfg)
-    os.makedirs(out, exist_ok=True)
-    from ...fluid import scope_guard
-
-    with scope_guard(scope):
-        fluid_io.save_persistables(exe, out, main_program=program,
-                                   filename="persistables")
-    meta = {"step": cfg.step, "epoch_state": cfg.epoch_state,
-            "time": time.time()}
-    rng = scope.get_var(RNG_VAR) if scope.has_var(RNG_VAR) else None
-    if rng is not None:
-        meta["rng"] = np.asarray(rng).tolist()
-    tmp = os.path.join(out, "meta.json.tmp")
-    with open(tmp, "w") as f:
-        json.dump(meta, f)
-    os.replace(tmp, os.path.join(out, "meta.json"))  # atomic publish
+    _manager(cfg).save(cfg.step, scope=scope,
+                       host_state={"epoch_state": cfg.epoch_state,
+                                   "time": time.time()})
 
 
 def load_checkpoint(exe, program, scope, cfg=None) -> Optional[dict]:
-    """Restore a previous run's state; returns the meta dict or None."""
-    from ...fluid import io as fluid_io
-    from ...framework.executor import RNG_VAR
+    """Restore the newest intact snapshot; returns a meta dict with
+    ``step``/``epoch_state`` or None when nothing was ever committed."""
     from ...framework.scope import global_scope
 
     cfg = cfg or _active()
-    out = _ckpt_dir(cfg)
-    meta_path = os.path.join(out, "meta.json")
-    if not os.path.exists(meta_path):
-        return None
     scope = scope or global_scope()
-    from ...fluid import scope_guard
-
-    with scope_guard(scope):
-        fluid_io.load_persistables(exe, out, main_program=program,
-                                   filename="persistables")
-    with open(meta_path) as f:
-        meta = json.load(f)
-    if "rng" in meta:
-        import jax.numpy as jnp
-
-        scope.set_var(RNG_VAR, jnp.asarray(np.asarray(meta["rng"],
-                                                      np.uint32)))
-    cfg.step = int(meta.get("step", 0))
-    cfg.epoch_state = dict(meta.get("epoch_state", {}))
-    return meta
+    if not os.path.isdir(_ckpt_dir(cfg)):
+        return None
+    meta = _manager(cfg).restore(scope=scope)
+    if meta is None:
+        return None
+    host = meta.get("host_state", {}) or {}
+    cfg.step = int(meta["step"])
+    cfg.epoch_state = dict(host.get("epoch_state", {}))
+    return {"step": cfg.step, "epoch_state": cfg.epoch_state,
+            "time": host.get("time")}
 
 
 def on_executor_run(exe, program, scope, fed=True):

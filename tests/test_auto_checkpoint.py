@@ -48,9 +48,12 @@ def test_auto_checkpoint_saves_and_resumes(tmp_path):
         losses_a = [float(np.asarray(
             exe.run(main, feed=feed, fetch_list=[loss], scope=scope)[0]
         ).ravel()[0]) for _ in range(5)]
-        assert os.path.exists(tmp_path / "auto_ckpt" / "meta.json")
-        meta = json.load(open(tmp_path / "auto_ckpt" / "meta.json"))
-        assert meta["step"] == 4  # last even step
+        acp.wait()  # saves are async (paddle_tpu.ckpt manager)
+        # manager layout: committed step dirs with a hashed manifest
+        assert os.path.isdir(tmp_path / "auto_ckpt" / "step_4")
+        manifest = json.load(
+            open(tmp_path / "auto_ckpt" / "step_4" / "MANIFEST.json"))
+        assert manifest["step"] == 4  # last even step
     finally:
         acp.disable()
 

@@ -46,6 +46,7 @@ MODULES = [
     "paddle_tpu.slim",
     "paddle_tpu.monitor",
     "paddle_tpu.observe",
+    "paddle_tpu.ckpt",
     "paddle_tpu.framework.passes",
     "paddle_tpu.serving",
     "paddle_tpu.utils",
