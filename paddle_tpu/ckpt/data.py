@@ -40,9 +40,28 @@ class ResumableIterator:
     def __next__(self):
         if self._it is None:
             self._start_epoch()
-            while self._skip > 0:  # fast-forward after a restore
-                next(self._it)
-                self._skip -= 1
+            skip, self._skip = self._skip, 0
+            for done in range(skip):  # fast-forward after a restore
+                try:
+                    next(self._it)
+                except StopIteration:
+                    # The loader is shorter than it was at save time
+                    # (dataset shrank / different loader): surfacing the
+                    # bare StopIteration would silently END the
+                    # consumer's for-loop instead of flagging the stale
+                    # checkpoint state.
+                    from .manager import CheckpointError
+
+                    # leave a coherent position: a caller that catches
+                    # this and keeps iterating restarts THIS epoch from
+                    # batch 0 (not half-consumed with a stale counter)
+                    self.batch = 0
+                    self._it = None
+                    raise CheckpointError(
+                        f"resume fast-forward exhausted the loader "
+                        f"after {done} of {skip} batches (epoch "
+                        f"{self.epoch}): the restored iterator position "
+                        f"does not fit the current loader") from None
         try:
             b = next(self._it)
         except StopIteration:

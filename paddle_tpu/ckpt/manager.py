@@ -12,7 +12,11 @@ prunes old snapshots.  This module is the production replacement
   then hands serialization + file writes to a background writer thread;
   the step loop continues immediately.  A queued-but-unstarted save is
   COALESCED away when a newer one arrives (the newest state wins; the
-  writer never falls behind unboundedly).
+  writer never falls behind unboundedly).  Coalescing applies to
+  single-process managers only — with ``world_size > 1`` pending saves
+  queue strictly FIFO, because the commit barriers require every rank's
+  writer to execute the identical step sequence and a rank-local drop
+  decision would desynchronize them.
 - **Atomic**: shards are written into ``step_<N>.tmp``; the commit
   fsyncs every file, writes a SHA-256 manifest of every shard, fsyncs
   it, and renames the directory to ``step_<N>``.  A crash at ANY point
@@ -40,6 +44,7 @@ Observability: ``ckpt/snapshot|serialize|write|commit`` tracer spans,
 """
 from __future__ import annotations
 
+import collections
 import hashlib
 import json
 import logging
@@ -63,6 +68,9 @@ __all__ = ["CheckpointManager", "CheckpointError", "KVBarrier", "wait_all"]
 _STEP_RE = re.compile(r"^step_(\d+)$")
 _TMP_RE = re.compile(r"^step_(\d+)\.tmp$")
 _MANIFEST = "MANIFEST.json"
+# FIFO (multi-rank) backlog cap: save() blocks once this many snapshots
+# are pending, bounding host memory when the writer falls behind
+_MAX_PENDING_SAVES = 2
 
 
 class CheckpointError(RuntimeError):
@@ -103,16 +111,6 @@ def _sha256(path: str) -> str:
     return h.hexdigest()
 
 
-def _fsync_file(path: str) -> None:
-    if not _flags.flag("ckpt_fsync"):
-        return
-    fd = os.open(path, os.O_RDONLY)
-    try:
-        os.fsync(fd)
-    finally:
-        os.close(fd)
-
-
 def _fsync_dir(path: str) -> None:
     if not _flags.flag("ckpt_fsync"):
         return
@@ -150,12 +148,16 @@ class KVBarrier:
     ``ckpt_barrier/<prefix><tag>:g<gen>/<rank>`` and polls until all
     ranks arrived.
 
-    ``gen`` is a per-instance call counter advanced in lockstep on
-    every rank (all ranks call the same barrier sequence), so a tag —
-    e.g. a re-save of the same step — never reuses live keys within a
-    process lifetime.  Keys two generations back are swept by rank 0
-    (any rank arriving at generation g has provably passed g-1, so
-    g-2's keys can have no readers left).  Across a crash+restart
+    ``gen`` counts prior uses of the SAME tag by this instance, so a
+    tag — e.g. a re-save of the same step — never reuses live keys
+    within a process lifetime.  Per-tag (not a global call counter) on
+    purpose: after an asymmetric save failure one rank has consumed
+    fewer barrier calls than the others, and a global counter would
+    desynchronize every subsequent tag permanently; per-tag counts
+    re-align as soon as a fresh tag comes along.  Keys from two
+    completed barriers back are swept by rank 0 (any rank completing a
+    later barrier has provably passed the earlier one, so its keys can
+    have no readers left).  Across a crash+restart
     against a long-lived KV server, pass a run-unique ``prefix`` (job
     id, launch timestamp) to make stale keys unreachable; without one,
     a restart whose (tag, gen) collides with the crashed run's can at
@@ -172,7 +174,7 @@ class KVBarrier:
         self.world_size = int(world_size)
         self.timeout = float(timeout)
         self.prefix = (prefix + ":") if prefix else ""
-        self._gen = 0
+        self._tag_gens: Dict[str, int] = {}
         self._past_tags: list = []
 
     def _url(self, tag: str, rank: int) -> str:
@@ -182,20 +184,40 @@ class KVBarrier:
         import urllib.error
         import urllib.request
 
-        gen_tag = f"{tag}:g{self._gen}"
-        self._gen += 1
+        gen = self._tag_gens.get(tag, 0)
+        self._tag_gens[tag] = gen + 1
+        gen_tag = f"{tag}:g{gen}"
+        deadline = time.monotonic() + self.timeout
+        # URLError (connection refused/reset — the KV server restarting
+        # or not up yet) is as transient as a 404 HTTPError: retry both
+        # until the deadline instead of failing the whole save.
         req = urllib.request.Request(self._url(gen_tag, self.rank),
                                      data=b"1", method="PUT")
-        urllib.request.urlopen(req, timeout=self.timeout)
-        deadline = time.monotonic() + self.timeout
+        while True:
+            try:
+                # clamp to the remaining deadline: a stalled-but-
+                # accepting server would otherwise hold a sub-5s
+                # barrier budget for the full socket timeout
+                urllib.request.urlopen(req, timeout=min(
+                    5.0, max(0.1, deadline - time.monotonic())))
+                break
+            except (urllib.error.URLError, TimeoutError) as e:
+                if time.monotonic() >= deadline:
+                    raise CheckpointError(
+                        f"KVBarrier {gen_tag!r}: cannot announce to KV "
+                        f"server {self.endpoint} after {self.timeout}s: "
+                        f"{e}") from e
+                time.sleep(0.05)
         missing = set(range(self.world_size))
         while missing:
             for r in sorted(missing):
                 try:
-                    urllib.request.urlopen(self._url(gen_tag, r),
-                                           timeout=5)
+                    urllib.request.urlopen(
+                        self._url(gen_tag, r),
+                        timeout=min(5.0, max(
+                            0.1, deadline - time.monotonic())))
                     missing.discard(r)
-                except urllib.error.HTTPError:
+                except (urllib.error.URLError, TimeoutError):
                     pass
             if not missing:
                 break
@@ -205,16 +227,38 @@ class KVBarrier:
                     f"missing after {self.timeout}s "
                     f"(world={self.world_size})")
             time.sleep(0.02)
-        # deferred cleanup: sweep the barrier TWO generations back
-        self._past_tags.append(gen_tag)
-        if self.rank == 0 and len(self._past_tags) > 2:
-            old = self._past_tags.pop(0)
-            for r in range(self.world_size):
-                try:
-                    urllib.request.urlopen(urllib.request.Request(
-                        self._url(old, r), method="DELETE"), timeout=5)
-                except urllib.error.HTTPError:
-                    pass
+        # deferred cleanup: sweep the barrier TWO completed barriers
+        # back.  Every rank trims its list (it would otherwise grow
+        # unbounded over a long run); only rank 0 issues the DELETEs.
+        self._past_tags.append((tag, gen_tag))
+        if len(self._past_tags) > 2:
+            old_tag, old_gen_tag = self._past_tags.pop(0)
+            # the swept barrier's server keys are gone, so its gen
+            # count can go too (manager tags are job-unique — keeping
+            # every count would leak one entry per barrier for the
+            # process lifetime).  Keep it while a LATER use of the same
+            # tag is still live, so a reset can't re-mint its gen.
+            # Rank 0 additionally keeps the count when a DELETE failed:
+            # stale arrival keys + a re-minted gen would release a
+            # reused tag's barrier EARLY on the polling ranks, but the
+            # committer polling a gen nobody PUT just times out — the
+            # failure mode stays a failed save, never a bad commit.
+            swept = True
+            if self.rank == 0:
+                for r in range(self.world_size):
+                    try:
+                        # best-effort cleanup after the barrier already
+                        # succeeded: clamp to the leftover deadline so a
+                        # stalled server can't hold the writer ~5s per
+                        # rank past the configured budget
+                        urllib.request.urlopen(urllib.request.Request(
+                            self._url(old_gen_tag, r), method="DELETE"),
+                            timeout=min(5.0, max(
+                                0.5, deadline - time.monotonic())))
+                    except (urllib.error.URLError, TimeoutError):
+                        swept = False
+            if swept and all(t != old_tag for t, _ in self._past_tags):
+                self._tag_gens.pop(old_tag, None)
 
 
 def _default_barrier(tag: str) -> None:
@@ -232,13 +276,12 @@ def _default_barrier(tag: str) -> None:
 
 
 class _Job:
-    __slots__ = ("step", "state", "host_state", "t_queued")
+    __slots__ = ("step", "state", "host_state")
 
     def __init__(self, step, state, host_state):
         self.step = int(step)
         self.state = state
         self.host_state = host_state
-        self.t_queued = time.perf_counter()
 
 
 class CheckpointManager:
@@ -265,11 +308,23 @@ class CheckpointManager:
         self._components: Dict[str, object] = {}
         self._fault_hook: Optional[Callable[[str, int], None]] = None
         self._cond = threading.Condition()
-        self._queued: Optional[_Job] = None
+        self._queue: "collections.deque[_Job]" = collections.deque()
         self._active: Optional[_Job] = None
         self._error: Optional[BaseException] = None
         self._thread: Optional[threading.Thread] = None
         self._closed = False
+        # count of jobs RUN (not queued): in lockstep on every rank —
+        # the queue is strictly FIFO for world>1 and a job that fails
+        # INSIDE _run_job still consumed its sequence number on all
+        # ranks — so it stamps the barrier tags and a re-save of a
+        # failed step can never collide with the stale half-used tags
+        # of the first attempt.  Known liveness limit: a save that
+        # fails on one rank BEFORE its job runs (snapshot error, closed
+        # race) leaves that rank a seq behind; later saves then fail
+        # loudly by barrier timeout until process restart.  Commits are
+        # never corrupted by this — rank 0 only renames after its
+        # barriers pass.
+        self._job_seq = 0
         _LIVE.add(self)
 
     # -- topology ---------------------------------------------------------
@@ -344,6 +399,25 @@ class CheckpointManager:
                 scope = global_scope()
             with otrace.span("ckpt/snapshot", step=int(step)):
                 state = snapshot_scope(scope, var_names)
+        if self.world_size == 1:
+            # a partial shard in a single-process manager would commit a
+            # checkpoint missing every other rank's block — restore's
+            # re-assembly check rejects it, but only at resume time.
+            # Fail the SAVE instead of silently writing a dead snapshot
+            # (e.g. rank-0-local auto-checkpoint over ZeRO-sharded
+            # state: use distributed.checkpoint.save_sharded there).
+            for name, v in state.items():
+                if isinstance(v, LocalShard) \
+                        and tuple(v.array.shape) != tuple(v.global_shape):
+                    raise CheckpointError(
+                        f"var {name!r} is a partial shard "
+                        f"({v.array.shape} of global {v.global_shape}) "
+                        f"but this manager has world_size=1: the other "
+                        f"ranks' blocks would never be written and the "
+                        f"checkpoint could not restore. Save "
+                        f"multi-process-sharded state through a manager "
+                        f"with rank/world_size set on every rank "
+                        f"(distributed.checkpoint.save_sharded)")
         host = dict(host_state or {})
         if self._components:
             host["components"] = {n: c.state_dict()
@@ -354,16 +428,43 @@ class CheckpointManager:
             stat_time("ckpt_save_blocking_seconds",
                       time.perf_counter() - t0)
             return sorted(state)
+        # Coalescing is a per-rank timing decision, so it is only safe
+        # when this manager is the sole committer: with world>1 the
+        # commit barriers assume every rank's writer executes the
+        # identical step sequence, and rank A dropping a step rank B
+        # already started would deadlock the barrier.  Multi-rank
+        # managers therefore queue strictly FIFO.
+        can_coalesce = self.world_size == 1
         with self._cond:
-            if self._queued is not None:
+            if self._closed:
+                # the entry check at the top of save() is unlocked; a
+                # close() racing the snapshot could otherwise see us
+                # enqueue onto a closed (no longer drained) manager
+                raise CheckpointError("CheckpointManager is closed")
+            if can_coalesce and self._queue:
                 # coalesce: the unstarted stale save is superseded
+                # (coalescing keeps the queue depth at <= 1)
                 from ..monitor import stat_add
 
+                stale = self._queue.pop()
                 stat_add("ckpt_saves_coalesced")
                 logger.info("ckpt: coalescing pending save of step %d "
-                            "under newer step %d", self._queued.step,
-                            job.step)
-            self._queued = job
+                            "under newer step %d", stale.step, job.step)
+            elif not can_coalesce:
+                # FIFO needs explicit backpressure: each _Job holds a
+                # full host snapshot, so an unbounded backlog on a slow
+                # filesystem would exhaust host RAM.  Blocking here is
+                # rank-symmetric — every rank issues the identical save
+                # sequence, so all ranks block at the same save index.
+                while len(self._queue) >= _MAX_PENDING_SAVES \
+                        and not self._closed:
+                    self._cond.wait(timeout=0.1)
+                if self._closed:
+                    # close() won the race: enqueueing now would spawn a
+                    # writer on a closed manager (out of _LIVE, never
+                    # drained) and silently lose the checkpoint
+                    raise CheckpointError("CheckpointManager is closed")
+            self._queue.append(job)
             self._ensure_thread()
             self._cond.notify_all()
         stat_time("ckpt_save_blocking_seconds", time.perf_counter() - t0)
@@ -375,7 +476,7 @@ class CheckpointManager:
         """Barrier: block until no save is queued or in flight; re-raise
         the first background failure."""
         with self._cond:
-            while self._queued is not None or self._active is not None:
+            while self._queue or self._active is not None:
                 self._cond.wait(timeout=0.1)
             err, self._error = self._error, None
         if err is not None:
@@ -407,12 +508,12 @@ class CheckpointManager:
 
         while True:
             with self._cond:
-                while self._queued is None and not self._closed:
+                while not self._queue and not self._closed:
                     self._cond.wait(timeout=0.25)
-                if self._closed and self._queued is None:
+                if self._closed and not self._queue:
                     return
-                self._active, self._queued = self._queued, None
-                job = self._active
+                self._active = job = self._queue.popleft()
+                self._cond.notify_all()  # free a backpressure-blocked save()
             try:
                 self._run_job(job)
             except BaseException as e:  # noqa: BLE001 - writer survives
@@ -439,6 +540,8 @@ class CheckpointManager:
 
         t0 = time.perf_counter()
         rank, world = self.rank, self.world_size
+        seq, self._job_seq = self._job_seq, self._job_seq + 1
+        tag = f"{job.step}:j{seq}"
         tmp = self._step_dir(job.step) + ".tmp"
         final = self._step_dir(job.step)
         if rank == 0:
@@ -446,7 +549,7 @@ class CheckpointManager:
                 shutil.rmtree(tmp)
             os.makedirs(tmp, exist_ok=True)
         if world > 1:
-            self._barrier(f"mkdir:{job.step}")
+            self._barrier(f"mkdir:{tag}")
             os.makedirs(tmp, exist_ok=True)  # racing mkdir is fine
 
         self._fault("serialize", job.step)
@@ -499,7 +602,7 @@ class CheckpointManager:
         # -- commit: all ranks durable -> rank 0 manifests + renames ----
         with otrace.span("ckpt/commit", step=job.step):
             if world > 1:
-                self._barrier(f"written:{job.step}")
+                self._barrier(f"written:{tag}")
             if rank == 0:
                 self._fault("pre_commit", job.step)
                 files = {}
@@ -524,7 +627,7 @@ class CheckpointManager:
             if world > 1:
                 # save() callers on every rank return only once the
                 # checkpoint is visible
-                self._barrier(f"committed:{job.step}")
+                self._barrier(f"committed:{tag}")
 
         dt = time.perf_counter() - t0
         stat_time("ckpt_write_seconds", dt)

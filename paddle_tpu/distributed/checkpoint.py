@@ -38,14 +38,23 @@ def _manager(dirname: str) -> CheckpointManager:
     return m
 
 
-def save_sharded(scope, dirname, var_names: Optional[Sequence[str]] = None):
+def save_sharded(scope, dirname, var_names: Optional[Sequence[str]] = None,
+                 step: Optional[int] = None):
     """Write the scope's state as a committed checkpoint step under
     ``dirname``.  Sharded arrays are written distributed (each process
     stores its own axis-0 block); call from EVERY process of a
-    multi-process run.  Returns the sorted saved variable names."""
+    multi-process run.  Returns the sorted saved variable names.
+
+    ``step`` defaults to one past the newest committed step in
+    ``dirname``.  That inference reads the LOCAL directory listing, so
+    on a multi-process run over a filesystem with metadata visibility
+    lag (NFS attribute caching, object-store mounts) ranks could
+    disagree and stall the commit barrier — pass the training step
+    explicitly there; all ranks already agree on it."""
     m = _manager(dirname)
-    return m.save(m.next_step(), scope=scope, var_names=var_names,
-                  wait=True)
+    if step is None:
+        step = m.next_step()
+    return m.save(step, scope=scope, var_names=var_names, wait=True)
 
 
 def load_sharded(scope, dirname, var_names: Optional[Sequence[str]] = None):

@@ -117,11 +117,17 @@ def get_world_size() -> int:
 
 
 def get_rank() -> int:
-    import jax
-
     # host-level rank (reference trainer_id is per device; on TPU the
     # process drives all local devices, so rank == process index)
-    return int(os.environ.get("PADDLE_TRAINER_ID", jax.process_index()))
+    rid = os.environ.get("PADDLE_TRAINER_ID")
+    if rid not in (None, ""):
+        return int(rid)
+    try:
+        import jax
+
+        return int(jax.process_index())
+    except ImportError:  # pragma: no cover
+        return 0
 
 
 class ParallelEnv:

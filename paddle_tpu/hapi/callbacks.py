@@ -114,15 +114,22 @@ class ModelCheckpoint(Callback):
     loop only blocks for the host-side state capture.  ``on_train_end``
     drains pending saves and still writes the legacy ``final`` export
     via ``Model.save``.  ``restore_latest(model)`` reloads the newest
-    intact epoch (falling back past corrupt ones)."""
+    intact epoch (falling back past corrupt ones).
+
+    On-disk layout: epochs land as manager ``step_<epoch>`` dirs (npz
+    shards + manifest), NOT the reference's ``save_dir/{epoch}``
+    ``Model.save`` files.  Pass ``legacy_format=True`` to keep the old
+    paddle-parity per-epoch layout (synchronous ``Model.save``, no
+    atomicity/retention) for consumers that load those paths."""
 
     def __init__(self, save_freq=1, save_dir=None, keep_n=0,
-                 async_save=None):
+                 async_save=None, legacy_format=False):
         super().__init__()
         self.save_freq = save_freq
         self.save_dir = save_dir
         self.keep_n = keep_n
         self.async_save = async_save
+        self.legacy_format = legacy_format
         self._manager = None
 
     def _mgr(self):
@@ -137,7 +144,9 @@ class ModelCheckpoint(Callback):
     def _capture(self):
         """Host-side state dicts (the blocking part of an async save).
         Mirrors Model.save(training=True): network params + optimizer
-        state, prefixed so one flat dict round-trips both."""
+        state, prefixed so one flat dict round-trips both.  Dict-valued
+        optimizer entries (the LR_Scheduler state) can't ride the array
+        shard — they return separately to travel as host-state JSON."""
         import numpy as np
 
         model = self.model
@@ -145,17 +154,40 @@ class ModelCheckpoint(Callback):
             model._sync_scope_to_network()
         state = {"param/" + k: np.asarray(v.numpy())
                  for k, v in model.network.state_dict().items()}
+        opt_json = {}
         opt = getattr(model, "_optimizer", None)
         if opt is not None and hasattr(opt, "state_dict"):
+            import json
+            import logging
+
             for k, v in opt.state_dict().items():
-                if not isinstance(v, dict):
+                if isinstance(v, dict):
+                    # numpy scalars -> plain floats: this rides the
+                    # json-serialized host_state.  An un-JSON-able
+                    # entry is dropped (with a warning), not fatal — a
+                    # checkpoint missing one scheduler field beats
+                    # killing training at epoch end.
+                    try:
+                        opt_json[k] = json.loads(
+                            json.dumps(v, default=float))
+                    except (TypeError, ValueError):
+                        logging.getLogger(__name__).warning(
+                            "ModelCheckpoint: optimizer state %r is not "
+                            "JSON-serializable; it will not ride the "
+                            "checkpoint", k)
+                else:
                     state["opt/" + k] = np.asarray(v)
-        return state
+        return state, opt_json
 
     def on_epoch_end(self, epoch, logs=None):
         if self.save_dir and (epoch + 1) % self.save_freq == 0:
-            self._mgr().save(epoch, state=self._capture(),
-                             host_state={"epoch": epoch})
+            if self.legacy_format:
+                self.model.save(os.path.join(self.save_dir, f"{epoch}"))
+            else:
+                state, opt_json = self._capture()
+                self._mgr().save(epoch, state=state,
+                                 host_state={"epoch": epoch,
+                                             "opt_json": opt_json})
 
     def on_train_end(self, logs=None):
         if self.save_dir:
@@ -166,10 +198,24 @@ class ModelCheckpoint(Callback):
     def restore_latest(self, model=None):
         """Load the newest intact epoch checkpoint into ``model`` (or
         the attached one).  Returns the epoch number, or None when the
-        directory holds no committed checkpoint."""
+        directory holds no committed checkpoint.  With
+        ``legacy_format=True`` this loads the newest ``save_dir/{epoch}``
+        ``Model.save`` files instead of manager step dirs."""
         import numpy as np
 
         model = model or self.model
+        if self.legacy_format:
+            try:
+                entries = os.listdir(self.save_dir)
+            except OSError:
+                return None
+            epochs = sorted(int(e[:-len(".pdparams")]) for e in entries
+                            if e.endswith(".pdparams")
+                            and e[:-len(".pdparams")].isdigit())
+            if not epochs:
+                return None
+            model.load(os.path.join(self.save_dir, str(epochs[-1])))
+            return epochs[-1]
         meta = self._mgr().restore()
         if meta is None:
             return None
@@ -184,6 +230,7 @@ class ModelCheckpoint(Callback):
         opt = getattr(model, "_optimizer", None)
         od = {k[len("opt/"):]: np.asarray(v) for k, v in state.items()
               if k.startswith("opt/")}
+        od.update(meta["host_state"].get("opt_json") or {})
         if od and opt is not None and hasattr(opt, "set_state_dict"):
             opt.set_state_dict(od)
         return int(meta["host_state"].get("epoch", meta["step"]))

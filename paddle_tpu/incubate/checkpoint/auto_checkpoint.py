@@ -69,10 +69,13 @@ def configure(dirname, save_interval_s=10.0, every_n_steps=None,
 
 
 def disable():
+    # detach FIRST: close() re-raises a failed background save, and a
+    # config left active with a closed manager would crash every
+    # subsequent Executor.run instead of having auto-checkpoint off
     global _cfg
-    if _cfg is not None and _cfg.manager is not None:
-        _cfg.manager.close()
-    _cfg = None
+    cfg, _cfg = _cfg, None
+    if cfg is not None and cfg.manager is not None:
+        cfg.manager.close()
 
 
 def _active() -> Optional[_Config]:
@@ -83,7 +86,13 @@ def _active() -> Optional[_Config]:
 
 
 def _is_rank0() -> bool:
-    return int(os.environ.get("PADDLE_TRAINER_ID", "0") or 0) == 0
+    # the one rank convention (PADDLE_TRAINER_ID, else jax process
+    # index): a pure jax multi-process run never sets the env var, and
+    # treating every such process as rank 0 would race all of them on
+    # the same step_<N>.tmp directory
+    from ...distributed.parallel_env import get_rank
+
+    return get_rank() == 0
 
 
 def _ckpt_dir(cfg):
@@ -94,8 +103,15 @@ def _manager(cfg):
     if cfg.manager is None:
         from ...ckpt import CheckpointManager
 
+        # Only rank 0 ever saves (the on_executor_run gate), so the
+        # snapshot is rank-0-local: force rank=0/world_size=1 instead of
+        # letting the manager infer world_size=jax.process_count().  An
+        # inferred world>1 would make the lone writer wait forever on
+        # sync_global_devices barriers no other rank calls, and the
+        # manifest would require shard_r1..r{k} files nobody writes.
         cfg.manager = CheckpointManager(
-            _ckpt_dir(cfg), keep_n=cfg.keep_n, async_save=cfg.async_save)
+            _ckpt_dir(cfg), keep_n=cfg.keep_n, async_save=cfg.async_save,
+            rank=0, world_size=1)
     return cfg.manager
 
 

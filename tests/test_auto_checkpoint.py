@@ -140,3 +140,54 @@ def test_kv_http_server_roundtrip():
         assert urllib.request.urlopen(req).status == 200
     finally:
         srv.stop()
+
+
+def test_auto_checkpoint_manager_is_rank_local(tmp_path):
+    """Only rank 0 saves (the on_executor_run gate), so the manager must
+    be pinned to rank=0/world_size=1 — an inferred world_size from
+    jax.process_count() on a multi-process run would park the writer on
+    sync_global_devices barriers no other rank calls and demand
+    shard_r1.. files nobody writes."""
+    cfg = acp.configure(str(tmp_path))
+    try:
+        m = acp._manager(cfg)
+        assert m.rank == 0 and m.world_size == 1
+        # pinned explicitly, not inferred from the jax backend
+        assert m._rank == 0 and m._world == 1
+    finally:
+        acp.disable()
+
+
+def test_disable_detaches_even_when_drain_fails(tmp_path):
+    """disable() must deactivate auto-checkpointing BEFORE draining: if
+    close() re-raises a failed background save, a config left active
+    with a closed manager would crash every later Executor.run."""
+    import pytest
+
+    from paddle_tpu.ckpt import CheckpointError
+
+    cfg = acp.configure(str(tmp_path))
+
+    class _FailingManager:
+        def close(self):
+            raise CheckpointError("background save failed")
+
+    cfg.manager = _FailingManager()
+    with pytest.raises(CheckpointError):
+        acp.disable()
+    assert acp._cfg is None  # detached despite the raise
+
+
+def test_is_rank0_falls_back_to_jax_process_index(monkeypatch):
+    """Pure jax multi-process runs never set PADDLE_TRAINER_ID; every
+    process passing the rank-0 gate would race all of them on the same
+    checkpoint directory."""
+    import jax
+
+    monkeypatch.delenv("PADDLE_TRAINER_ID", raising=False)
+    monkeypatch.setattr(jax, "process_index", lambda: 1)
+    assert not acp._is_rank0()
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "0")
+    assert acp._is_rank0()  # explicit env wins over the jax fallback
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "3")
+    assert not acp._is_rank0()

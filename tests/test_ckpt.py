@@ -547,3 +547,405 @@ def test_model_checkpoint_async_retention(tmp_path):
     for k, v in fresh.network.state_dict().items():
         np.testing.assert_array_equal(np.asarray(v.numpy()), trained[k])
     cb._manager.close()
+
+
+# ---------------------------------------------------------------------------
+# review-hardening regressions
+# ---------------------------------------------------------------------------
+
+
+def test_two_rank_async_saves_queue_fifo(tmp_path):
+    """async_save + world>1: pending saves must NOT be coalesced (the
+    drop decision is per-rank timing, and the commit barriers need every
+    rank's writer to run the identical step sequence).  Three rapid-fire
+    async saves from both ranks must all commit, in order, with no
+    barrier deadlock."""
+    from paddle_tpu.distributed.fleet.utils import KVServer
+
+    srv = KVServer(0)
+    srv.start()
+    try:
+        ep = f"127.0.0.1:{srv.port}"
+        mgrs = [CheckpointManager(
+            str(tmp_path), keep_n=0, async_save=True, rank=r, world_size=2,
+            barrier=KVBarrier(ep, rank=r, world_size=2, timeout=30))
+            for r in range(2)]
+        full = np.arange(8, dtype="f4")
+        for step in (1, 2, 3):
+            for r, m in enumerate(mgrs):
+                # queued back-to-back: single-process managers would
+                # coalesce 1 and 2 away here
+                m.save(step, state={
+                    "s": LocalShard(full[r * 4:(r + 1) * 4] + step,
+                                    full.shape)})
+        errs = []
+
+        def drain(m):
+            try:
+                m.wait()
+            except BaseException as e:  # noqa: BLE001
+                errs.append(e)
+
+        ts = [threading.Thread(target=drain, args=(m,)) for m in mgrs]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=60)
+        assert not any(t.is_alive() for t in ts), "wait() deadlocked"
+        assert not errs, errs
+        assert mgrs[0].all_steps() == [1, 2, 3]
+        meta = mgrs[0].restore()
+        np.testing.assert_array_equal(meta["state"]["s"], full + 3)
+        for m in mgrs:
+            m.close()
+    finally:
+        srv.stop()
+
+
+def test_kv_barrier_unreachable_server_times_out_as_checkpoint_error():
+    """A down KV server (URLError: connection refused) must surface as a
+    deadline CheckpointError, not a raw URLError mid-save."""
+    b = KVBarrier("127.0.0.1:9", rank=0, world_size=1, timeout=0.5)
+    with pytest.raises(CheckpointError, match="cannot announce"):
+        b("commit:1")
+
+
+def test_kv_barrier_past_tags_trimmed_on_all_ranks(tmp_path):
+    from paddle_tpu.distributed.fleet.utils import KVServer
+
+    srv = KVServer(0)
+    srv.start()
+    try:
+        bs = [KVBarrier(f"127.0.0.1:{srv.port}", rank=r, world_size=2,
+                        timeout=30) for r in range(2)]
+        errs = []
+
+        def run(b):
+            try:
+                for i in range(6):
+                    b(f"t{i}")
+            except BaseException as e:  # noqa: BLE001
+                errs.append(e)
+
+        ts = [threading.Thread(target=run, args=(b,)) for b in bs]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=60)
+        assert not errs, errs
+        for b in bs:
+            assert len(b._past_tags) <= 2  # non-zero rank trims too
+            assert len(b._tag_gens) <= 3  # swept tags drop their gens
+    finally:
+        srv.stop()
+
+
+def test_resumable_iterator_stale_restore_state_raises(tmp_path):
+    """A restored batch position past the loader's current epoch length
+    (dataset shrank between save and resume) must raise, not let
+    StopIteration silently end the consumer's for-loop."""
+    batches = [np.full((2,), i, "f4") for i in range(3)]
+    it = ResumableIterator(batches)
+    it.set_state_dict({"epoch": 0, "batch": 5})  # loader only has 3
+    with pytest.raises(CheckpointError, match="fast-forward"):
+        next(it)
+
+
+def test_model_checkpoint_legacy_format(tmp_path):
+    """legacy_format=True keeps the reference per-epoch layout
+    (save_dir/{epoch} via Model.save) for consumers that load those
+    paths."""
+    import paddle_tpu.nn as nn
+    from paddle_tpu.hapi.callbacks import ModelCheckpoint
+    from paddle_tpu.io import DataLoader, TensorDataset
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 1)
+
+        def forward(self, x):
+            return self.fc(x)
+
+    rs = np.random.RandomState(0)
+    X = rs.randn(8, 4).astype("f4")
+    Y = X.sum(1, keepdims=True).astype("f4")
+    model = pt.Model(Net())
+    model.prepare(optimizer=pt.optimizer.Adam(
+        0.01, parameters=model.parameters()), loss=nn.MSELoss())
+    cb = ModelCheckpoint(save_freq=1, save_dir=str(tmp_path),
+                         legacy_format=True)
+    loader = DataLoader(TensorDataset([X, Y]), batch_size=8, shuffle=False)
+    model.fit(loader, epochs=2, verbose=0, callbacks=[cb])
+    assert (tmp_path / "0.pdparams").is_file()
+    assert (tmp_path / "1.pdparams").is_file()
+    assert (tmp_path / "final.pdparams").is_file()
+    assert cb._manager is None  # the manager path never engaged
+
+
+def test_save_sharded_explicit_step(tmp_path):
+    """Multi-process callers pass the (globally agreed) training step so
+    no rank derives it from a lag-prone local directory listing."""
+    from paddle_tpu.distributed import checkpoint as dckpt
+
+    sc = Scope()
+    sc.set_var("w", np.ones((2,), "f4"))
+    dckpt.save_sharded(sc, str(tmp_path), step=42)
+    m = dckpt._MANAGERS[os.path.abspath(str(tmp_path))]
+    assert m.all_steps() == [42]
+    dckpt.save_sharded(sc, str(tmp_path))  # inference still one-past
+    assert m.all_steps() == [42, 43]
+
+
+def test_model_checkpoint_roundtrips_lr_scheduler_state(tmp_path):
+    """Dict-valued optimizer state (the LR_Scheduler entry) rides the
+    host-state JSON — resume must not restart the schedule."""
+    import paddle_tpu.nn as nn
+    from paddle_tpu.hapi.callbacks import ModelCheckpoint
+    from paddle_tpu.io import DataLoader, TensorDataset
+    from paddle_tpu.optimizer_lr import StepDecay
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 1)
+
+        def forward(self, x):
+            return self.fc(x)
+
+    rs = np.random.RandomState(0)
+    X = rs.randn(8, 4).astype("f4")
+    Y = X.sum(1, keepdims=True).astype("f4")
+
+    def build():
+        model = pt.Model(Net())
+        sched = StepDecay(0.1, step_size=2)
+        model.prepare(optimizer=pt.optimizer.Adam(
+            sched, parameters=model.parameters()), loss=nn.MSELoss())
+        return model, sched
+
+    model, sched = build()
+    sched.step(), sched.step(), sched.step()
+    saved_state = sched.state_dict()
+    assert saved_state["last_epoch"] == 3
+    cb = ModelCheckpoint(save_freq=1, save_dir=str(tmp_path),
+                         async_save=False)
+    loader = DataLoader(TensorDataset([X, Y]), batch_size=8, shuffle=False)
+    model.fit(loader, epochs=1, verbose=0, callbacks=[cb])
+
+    fresh, fresh_sched = build()
+    assert fresh_sched.state_dict() != saved_state
+    cb.restore_latest(fresh)
+    assert fresh_sched.state_dict() == saved_state
+    cb._manager.close()
+
+
+def test_world1_manager_rejects_partial_shard(tmp_path):
+    """A partial shard saved through a world_size=1 manager (e.g. the
+    rank-0-local auto-checkpoint over ZeRO-sharded state) can never
+    restore — the save must fail loudly, not commit a dead snapshot."""
+    m = CheckpointManager(str(tmp_path), async_save=False)
+    block = np.arange(4, dtype="f4")
+    with pytest.raises(CheckpointError, match="partial shard"):
+        m.save(1, state={"s": LocalShard(block, (8,))})
+    assert m.all_steps() == []
+    # a FULL LocalShard (block == global) is fine single-process
+    m.save(2, state={"s": LocalShard(block, (4,))})
+    np.testing.assert_array_equal(m.restore()["state"]["s"], block)
+    m.close()
+
+
+def test_kv_barrier_stalled_server_times_out_as_checkpoint_error():
+    """A server that ACCEPTS the connection but never responds raises a
+    raw TimeoutError from urlopen (not URLError) — it must still be
+    retried until the deadline and surface as CheckpointError."""
+    import socket
+
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(4)
+    try:
+        port = srv.getsockname()[1]
+        b = KVBarrier(f"127.0.0.1:{port}", rank=0, world_size=1,
+                      timeout=0.5)
+        with pytest.raises(CheckpointError, match="cannot announce"):
+            b("x")
+    finally:
+        srv.close()
+
+
+def test_multi_rank_async_queue_is_bounded(tmp_path):
+    """FIFO (world>1) mode has no coalescing, so save() must apply
+    backpressure: each pending job holds a full host snapshot and an
+    unbounded backlog would exhaust host RAM."""
+    release = threading.Event()
+    m = CheckpointManager(str(tmp_path), keep_n=0, async_save=True,
+                          rank=0, world_size=2,
+                          barrier=lambda tag: None)
+    m.set_fault_hook(lambda phase, step: release.wait(30)
+                     if phase == "serialize" else None)
+    for s in (1, 2, 3):  # 1 active (stalled) + 2 queued = the cap
+        m.save(s, state={"w": np.zeros(1, "f4")})
+    unblocked = threading.Event()
+
+    def extra():
+        m.save(4, state={"w": np.zeros(1, "f4")})
+        unblocked.set()
+
+    t = threading.Thread(target=extra)
+    t.start()
+    assert not unblocked.wait(0.5), "4th save should block at the cap"
+    release.set()
+    assert unblocked.wait(20), "save must unblock once the writer drains"
+    t.join(timeout=10)
+    m.wait()
+    assert m.all_steps() == [1, 2, 3, 4]  # FIFO: nothing coalesced
+    m.close()
+
+
+def test_model_checkpoint_legacy_restore_latest(tmp_path):
+    """legacy_format restore_latest loads the newest save_dir/{epoch}
+    Model.save files instead of silently reporting 'no checkpoint'."""
+    import paddle_tpu.nn as nn
+    from paddle_tpu.hapi.callbacks import ModelCheckpoint
+    from paddle_tpu.io import DataLoader, TensorDataset
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 1)
+
+        def forward(self, x):
+            return self.fc(x)
+
+    rs = np.random.RandomState(0)
+    X = rs.randn(8, 4).astype("f4")
+    Y = X.sum(1, keepdims=True).astype("f4")
+
+    def build():
+        model = pt.Model(Net())
+        model.prepare(optimizer=pt.optimizer.Adam(
+            0.01, parameters=model.parameters()), loss=nn.MSELoss())
+        return model
+
+    model = build()
+    cb = ModelCheckpoint(save_freq=1, save_dir=str(tmp_path),
+                         legacy_format=True)
+    loader = DataLoader(TensorDataset([X, Y]), batch_size=8, shuffle=False)
+    model.fit(loader, epochs=2, verbose=0, callbacks=[cb])
+    trained = {k: np.asarray(v.numpy())
+               for k, v in model.network.state_dict().items()}
+
+    fresh = build()
+    assert cb.restore_latest(fresh) == 1
+    for k, v in fresh.network.state_dict().items():
+        np.testing.assert_array_equal(np.asarray(v.numpy()), trained[k])
+    assert cb._manager is None  # legacy path never builds a manager
+
+
+def test_kv_barrier_resyncs_after_asymmetric_timeout(tmp_path):
+    """Rank 1 times out on a barrier rank 0 never reached (asymmetric
+    failure): with per-tag generations the NEXT tag still rendezvous —
+    a global call counter would desynchronize every later barrier."""
+    from paddle_tpu.distributed.fleet.utils import KVServer
+
+    srv = KVServer(0)
+    srv.start()
+    try:
+        ep = f"127.0.0.1:{srv.port}"
+        bs = [KVBarrier(ep, rank=r, world_size=2, timeout=30)
+              for r in range(2)]
+        bs[1].timeout = 0.5
+        with pytest.raises(CheckpointError):
+            bs[1]("orphan")  # rank 0 never calls this one
+        bs[1].timeout = 30
+        errs = []
+
+        def run(b):
+            try:
+                b("next")
+            except BaseException as e:  # noqa: BLE001
+                errs.append(e)
+
+        ts = [threading.Thread(target=run, args=(b,)) for b in bs]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30)
+        assert not errs, errs
+    finally:
+        srv.stop()
+
+
+def test_multi_rank_save_recovers_after_asymmetric_failure(tmp_path):
+    """Rank 0's writer dies mid-save (rank 1 times out at the commit
+    barrier): a RETRY of the same step must succeed — the job-sequence
+    barrier tags plus per-tag generations keep the ranks aligned, so one
+    failed save can't brick checkpointing for the life of the run."""
+    from paddle_tpu.distributed.fleet.utils import KVServer
+
+    srv = KVServer(0)
+    srv.start()
+    try:
+        ep = f"127.0.0.1:{srv.port}"
+        mgrs = [CheckpointManager(
+            str(tmp_path), async_save=False, rank=r, world_size=2,
+            barrier=KVBarrier(ep, rank=r, world_size=2, timeout=4))
+            for r in range(2)]
+        boom = {"on": True}
+
+        def fault(phase, step):
+            if boom["on"] and phase == "write_shard":
+                raise RuntimeError("disk full")
+
+        mgrs[0].set_fault_hook(fault)
+        full = np.arange(4, dtype="f4")
+        states = [{"s": LocalShard(full[r * 2:(r + 1) * 2], full.shape)}
+                  for r in range(2)]
+
+        def attempt():
+            errs = [None, None]
+
+            def run(r):
+                try:
+                    mgrs[r].save(1, state=states[r])
+                except BaseException as e:  # noqa: BLE001
+                    errs[r] = e
+
+            ts = [threading.Thread(target=run, args=(r,))
+                  for r in range(2)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(timeout=60)
+            return errs
+
+        errs = attempt()
+        assert errs[0] is not None  # the injected write failure
+        assert errs[1] is not None  # barrier timeout, not a hang
+        assert mgrs[0].all_steps() == []
+
+        boom["on"] = False
+        errs = attempt()
+        assert errs == [None, None], errs
+        assert mgrs[0].all_steps() == [1]
+        np.testing.assert_array_equal(mgrs[0].restore()["state"]["s"],
+                                      full)
+        for m in mgrs:
+            m.close()
+    finally:
+        srv.stop()
+
+
+def test_resumable_iterator_coherent_after_stale_state_error(tmp_path):
+    """A caught stale-restore CheckpointError leaves the iterator at a
+    coherent position: continuing restarts the restored epoch from
+    batch 0 instead of tracking a position that never matched the feed."""
+    batches = [np.full((2,), i, "f4") for i in range(3)]
+    it = ResumableIterator(batches)
+    it.set_state_dict({"epoch": 2, "batch": 5})
+    with pytest.raises(CheckpointError):
+        next(it)
+    assert (it.epoch, it.batch) == (2, 0)
+    np.testing.assert_array_equal(next(it), batches[0])
+    assert (it.epoch, it.batch) == (2, 1)
