@@ -56,6 +56,7 @@ from .framework import (  # noqa: F401
     CUDAPlace,
     Executor,
     Program,
+    StepHandle,
     TPUPlace,
     default_main_program,
     default_startup_program,

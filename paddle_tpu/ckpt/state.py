@@ -77,7 +77,18 @@ def snapshot_scope(scope, var_names: Optional[Sequence[str]] = None
                    ) -> Dict[str, object]:
     """Copy the scope's state to host.  ``var_names=None`` takes every
     local variable (parameters, optimizer slots, AMP loss-scale state,
-    the RNG key — the executor writes nothing else back)."""
+    the RNG key — the executor writes nothing else back).
+
+    Pipelined dispatch: every live Executor's in-flight window is
+    drained first, so the snapshot captures a quiescent, bitwise-
+    consistent state (and any pending NaN-scan raises BEFORE a poisoned
+    checkpoint is written)."""
+    try:
+        from ..framework.executor import drain_all as _drain_all
+
+        _drain_all()
+    except ImportError:  # pragma: no cover - partial installs
+        pass
     if var_names is None:
         var_names = [n for n in scope.local_var_names()]
     out: Dict[str, object] = {}

@@ -1,6 +1,6 @@
 from . import ir_pb2  # noqa: F401
 from .dtypes import to_enum, to_jnp, to_np, to_str  # noqa: F401
-from .executor import Executor  # noqa: F401
+from .executor import Executor, StepHandle  # noqa: F401
 from .place import (  # noqa: F401
     CPUPlace,
     CUDAPinnedPlace,

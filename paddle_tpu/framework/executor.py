@@ -14,10 +14,22 @@ jitted with the state donated (in-place param update semantics), cached by
 one XLA executable launch; scheduling/fusion/memory are XLA's job (this
 collapses the reference's ParallelExecutor/SSA-graph machinery,
 parallel_executor.cc:504).
+
+Pipelined dispatch (FLAGS_max_inflight_steps, default 2): ``run`` returns
+a lazy :class:`StepHandle` instead of forcing a device→host sync per
+step; up to N steps stay in flight and dispatch backpressures by
+draining the oldest.  NaN-scan, FLAGS_benchmark sync, and StepTimer
+accounting happen at window-drain points (``Executor.drain``, handle
+reads, backpressure, ``close``, checkpoint snapshots) so telemetry only
+ever reflects completed steps.  ``FLAGS_max_inflight_steps=0`` restores
+the legacy synchronous fetch path.
 """
 from __future__ import annotations
 
+import collections
 import logging
+import threading
+import weakref
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -27,7 +39,8 @@ from . import dtypes
 from .lowering import PSEUDO_OPS, LoweringContext, get_lowering
 from .place import CPUPlace, Place, _default_place
 from .program import Program, Variable, default_main_program
-from .scope import PackedParamRef, Scope, global_scope
+from .scope import (PackedParamRef, Scope, global_scope,
+                    is_device_array as _is_device_array)
 
 logger = logging.getLogger(__name__)
 
@@ -103,6 +116,288 @@ class _Compiled:
     # (hapi/model_stat.py accounting) and allreduce payload bytes
     flops_per_step: float = 0.0
     allreduce_bytes: int = 0
+
+
+class _InflightStep:
+    """One dispatched-but-not-yet-synced executor step in the window."""
+
+    __slots__ = ("sync_refs", "nan_flags", "nan_ops", "t_dispatch",
+                 "steps", "examples", "compiled", "flops_per_step",
+                 "allreduce_bytes", "drained")
+
+    def __init__(self, sync_refs, nan_flags, nan_ops, t_dispatch, steps,
+                 examples, compiled, flops_per_step, allreduce_bytes):
+        self.sync_refs = sync_refs          # fetch device arrays (never
+        self.nan_flags = nan_flags          # donated, safe to hold)
+        self.nan_ops = nan_ops
+        self.t_dispatch = t_dispatch
+        self.steps = steps
+        self.examples = examples
+        self.compiled = compiled
+        self.flops_per_step = flops_per_step
+        self.allreduce_bytes = allreduce_bytes
+        self.drained = False
+
+
+class _InflightWindow:
+    """Bounded FIFO of in-flight pipelined steps (FLAGS_max_inflight_steps).
+
+    Dispatch pushes; ``backpressure`` drains the oldest entries until the
+    window is under the cap, so ahead-of-device Python can never pile up
+    unbounded live fetch buffers.  A drain is the truth point moved out
+    of the dispatch path: it blocks until the step's fetches are ready
+    (``fetch_sync_seconds`` histogram + ``dispatch/drain`` span), feeds
+    the StepTimer with the inter-drain wall time (== real per-step loop
+    time in steady state), checks the NaN-scan flags, and updates the
+    ``executor_inflight_steps`` gauge.  Entries hold only fetch buffers —
+    never scope state, which a later step may donate."""
+
+    def __init__(self):
+        self._entries = collections.deque()
+        self._lock = threading.RLock()
+        self._last_drain: Optional[float] = None
+        # a drain failure (XLA runtime error, NaN-scan raise) that was
+        # hit on a NON-raising path (StepTimer.summary's telemetry
+        # drain) is parked here and re-raised at the next raising drain
+        # point — a drained entry is popped, so without this the error
+        # would be consumed forever
+        self._failed: Optional[BaseException] = None
+
+    def __len__(self):
+        with self._lock:
+            return len(self._entries)
+
+    def push(self, entry: _InflightStep):
+        with self._lock:
+            self._entries.append(entry)
+        _update_inflight_gauge()
+
+    def _raise_pending(self):
+        if self._failed is not None:
+            e, self._failed = self._failed, None
+            raise e
+
+    def backpressure(self, cap: int):
+        """Block until fewer than ``cap`` steps are in flight."""
+        with self._lock:
+            self._raise_pending()
+            while len(self._entries) >= max(cap, 1):
+                self._drain_oldest()
+
+    def drain_through(self, entry: _InflightStep):
+        """Drain (in order) every entry up to and including ``entry``."""
+        with self._lock:
+            self._raise_pending()
+            while not entry.drained and self._entries:
+                self._drain_oldest()
+
+    def drain_all(self, raise_errors: bool = True):
+        """Drain everything.  ``raise_errors=False`` (the telemetry
+        read path) parks a drain failure in ``_failed`` instead of
+        raising, so the error is delivered at the next raising drain
+        point rather than swallowed."""
+        with self._lock:
+            if raise_errors:
+                self._raise_pending()
+            while self._entries:
+                self._drain_oldest(raise_errors=raise_errors)
+
+    def _drain_oldest(self, raise_errors: bool = True):
+        import time as _time
+
+        import jax
+
+        from ..observe import step_stats as _step_stats
+        from ..observe import tracer as otrace
+        from ..observe.histogram import stat_time
+
+        e = self._entries.popleft()
+        e.drained = True
+        _update_inflight_gauge()
+        t0 = _time.perf_counter()
+        try:
+            with otrace.span("dispatch/drain", steps=e.steps,
+                             n=len(e.sync_refs)):
+                jax.block_until_ready(e.sync_refs)
+                if e.nan_flags is not None:
+                    jax.block_until_ready(e.nan_flags)
+        except BaseException as err:
+            if raise_errors:
+                raise
+            if self._failed is None:
+                self._failed = err
+            return
+        now = _time.perf_counter()
+        stat_time("fetch_sync_seconds", now - t0)
+        # inter-drain wall time: in a steady pipelined loop drains are
+        # forced by backpressure once per dispatch, so this IS the
+        # training loop's per-step period (input wait included) — the
+        # number that says how fast the LOOP is, not just the chip
+        start = e.t_dispatch if self._last_drain is None \
+            else max(self._last_drain, e.t_dispatch)
+        self._last_drain = now
+        _step_stats.step_timer().record_run(
+            max(now - start, 0.0), steps=e.steps, examples=e.examples,
+            compiled=e.compiled, flops_per_step=e.flops_per_step,
+            allreduce_bytes_per_step=e.allreduce_bytes)
+        if e.nan_flags is not None:
+            try:
+                _raise_on_nan(np.asarray(e.nan_flags), e.nan_ops)
+            except BaseException as err:
+                if raise_errors:
+                    raise
+                if self._failed is None:
+                    self._failed = err
+
+
+def _raise_on_nan(nan_flags, nan_ops):
+    """Host-side check of the per-op finite flags fetched by the
+    nan-scan (shared by the sync path and the window drain)."""
+    nan_flags = nan_flags.astype(bool)
+    if not nan_ops:
+        return
+    ok = nan_flags.reshape(-1, len(nan_ops)).all(axis=0)
+    if not ok.all():
+        i = int(np.argmin(ok))
+        op_type, site = nan_ops[i]
+        raise RuntimeError(
+            f"FLAGS_check_nan_inf: op {op_type!r} (built at "
+            f"{site}) produced NaN/Inf (op #{i} of the compiled "
+            f"block)")
+
+
+class StepHandle(list):
+    """Lazy fetch list of one pipelined ``Executor.run``/``run_steps``.
+
+    A ``list`` subclass so every existing consumer keeps working —
+    indexing, iteration, unpacking, ``len`` — but the device→host sync
+    is deferred: items start as jax device arrays and materialize on
+    access.  With ``materialize=True`` (the ``run(return_numpy=True)``
+    contract) ``handle[i]`` returns a cached ``np.ndarray``; reading any
+    item first drains the executor's in-flight window through this step
+    (telemetry + NaN-scan fire there).  ``numpy()`` materializes
+    everything; ``block_until_ready()`` syncs without converting."""
+
+    def __init__(self, fetches, window=None, entry=None, materialize=True):
+        list.__init__(self, fetches)
+        self._window = window
+        self._entry = entry
+        self._materialize = materialize
+
+    def block_until_ready(self):
+        """Wait for this step (and every older in-flight step) to
+        complete on device; no host transfer."""
+        if self._window is not None and self._entry is not None:
+            self._window.drain_through(self._entry)
+        else:
+            import jax
+
+            jax.block_until_ready([v for v in list.__iter__(self)
+                                   if _is_jax_array(v)])
+        return self
+
+    def numpy(self):
+        """Materialize every fetch to host numpy (the one sync point);
+        returns a plain list."""
+        from ..observe import tracer as otrace
+
+        self.block_until_ready()
+        with otrace.span("executor/fetch", n=list.__len__(self)):
+            out = []
+            for i in range(list.__len__(self)):
+                v = list.__getitem__(self, i)
+                if not isinstance(v, np.ndarray):
+                    v = np.asarray(v)
+                    if self._materialize:
+                        list.__setitem__(self, i, v)
+                out.append(v)
+            return out
+
+    def device_arrays(self):
+        """The raw stored values, no sync (device arrays until the item
+        has been materialized through access)."""
+        return list(list.__iter__(self))
+
+    def _resolve(self, i):
+        v = list.__getitem__(self, i)
+        if self._materialize and not isinstance(v, np.ndarray):
+            from ..observe import tracer as otrace
+
+            self.block_until_ready()
+            with otrace.span("executor/fetch", n=1):
+                v = np.asarray(v)
+            list.__setitem__(self, i, v)
+        return v
+
+    def __getitem__(self, idx):
+        if isinstance(idx, slice):
+            return [self._resolve(i)
+                    for i in range(*idx.indices(list.__len__(self)))]
+        return self._resolve(idx)
+
+    def __iter__(self):
+        for i in range(list.__len__(self)):
+            yield self._resolve(i)
+
+    def __array__(self, dtype=None, copy=None):
+        arr = np.asarray(self.numpy())
+        return arr.astype(dtype) if dtype is not None else arr
+
+
+# every constructed Executor, for the process-wide drain points (ckpt
+# snapshot, StepTimer.summary): a checkpoint must capture a quiescent
+# state and telemetry reads must reflect completed steps
+_LIVE_EXECUTORS: "weakref.WeakSet[Executor]" = weakref.WeakSet()
+
+
+def _update_inflight_gauge():
+    """executor_inflight_steps = TOTAL in-flight steps across every live
+    Executor (a per-window write would make the single process gauge
+    flap between unrelated executors).  Reads other windows' deque
+    lengths without their locks: len() is GIL-atomic and this is a
+    gauge, not an invariant."""
+    from ..monitor import stat_set
+
+    try:
+        total = sum(len(exe._window._entries)
+                    for exe in list(_LIVE_EXECUTORS))
+    except RuntimeError:  # WeakSet mutated by a concurrent construction
+        return            # telemetry only: the next push/drain re-writes
+    stat_set("executor_inflight_steps", total)
+
+
+def drain_all(raise_errors: bool = True):
+    """Drain the in-flight window of every live Executor (the process-
+    wide quiescence point: ckpt snapshots and telemetry summaries call
+    this so they only ever observe completed steps).  With
+    ``raise_errors=False`` (telemetry reads) a drain failure is parked
+    on its window and re-raised at the next raising drain point instead
+    of being lost."""
+    for exe in list(_LIVE_EXECUTORS):
+        exe._window.drain_all(raise_errors=raise_errors)
+
+
+_compile_cache_dir_applied: Optional[str] = None
+
+
+def _maybe_enable_compile_cache():
+    """FLAGS_compile_cache_dir -> jax persistent compilation cache
+    (guarded via jax_compat: a jax without the knob is a silent no-op).
+    Re-checked per Executor construction so setting the flag after
+    import still takes effect."""
+    global _compile_cache_dir_applied
+
+    from . import flags
+
+    d = flags.flag("compile_cache_dir")
+    if not d or d == _compile_cache_dir_applied:
+        return
+    from ..monitor import stat_add
+    from .jax_compat import update_config
+
+    if update_config("jax_compilation_cache_dir", d):
+        _compile_cache_dir_applied = d
+        stat_add("executor_compile_cache_dir_set")
 
 
 def _block_written(program, block_idx: int) -> set:
@@ -267,6 +562,11 @@ class Executor:
         # pass-rewritten program (or the original when no pass applied)
         self._pass_cache: Dict[tuple, Program] = {}
         self._mesh = mesh  # explicit mesh wins over the global parallel env
+        # pipelined dispatch (FLAGS_max_inflight_steps): the bounded
+        # window of dispatched-but-unsynced steps owned by this executor
+        self._window = _InflightWindow()
+        _LIVE_EXECUTORS.add(self)
+        _maybe_enable_compile_cache()
 
     def _active_mesh(self):
         if self._mesh is not None:
@@ -318,9 +618,11 @@ class Executor:
 
             _acp.maybe_resume(self, program, scope, fed=bool(feed))
 
-        fetches = self._dispatch(program, feed, feed_arrays, spec,
-                                 fetch_names, scope, multi_step=False,
-                                 scan_steps=None, use_prune=use_prune)
+        fetches, inflight = self._dispatch(program, feed, feed_arrays, spec,
+                                           fetch_names, scope,
+                                           multi_step=False,
+                                           scan_steps=None,
+                                           use_prune=use_prune)
 
         # localsgd strategy: periodic cross-replica parameter averaging
         # (set by LocalSGDMetaOptimizer; see fleet/collective_transpiler.py)
@@ -334,12 +636,17 @@ class Executor:
 
             _acp.on_executor_run(self, program, scope, fed=bool(feed))
 
+        if inflight is not None:
+            # pipelined mode (FLAGS_max_inflight_steps > 0): a lazy
+            # handle — the device->host sync happens when the caller
+            # reads an item (or at a window-drain point), never here
+            return StepHandle(fetches, window=self._window, entry=inflight,
+                              materialize=return_numpy)
         if return_numpy:
             from ..observe import tracer as otrace
 
-            # the host-blocking device->host transfer of the fetch list
-            # (reference Executor fetch phase); async callers pass
-            # return_numpy=False and sync on their own schedule
+            # legacy sync mode: the host-blocking device->host transfer
+            # of the fetch list (reference Executor fetch phase)
             with otrace.span("executor/fetch", n=len(fetches)):
                 return [np.asarray(v) for v in fetches]
         return list(fetches)
@@ -402,9 +709,18 @@ class Executor:
                 self.run(program, feed=feed, fetch_list=fetch_list,
                          scope=scope)
         finally:
-            for s, snap in snapshots:
-                s._vars.clear()
-                s._vars.update(snap)
+            # quiesce before restoring: warmup steps still in the
+            # pipelined window must finish (and account their telemetry)
+            # before their scope writes are rolled back.  The restore
+            # must run even when the drain RAISES (a warmup step failing
+            # on device): skipping it would leave warmup-mutated —
+            # donation-dead — state in the user's scope
+            try:
+                self.drain()
+            finally:
+                for s, snap in snapshots:
+                    s._vars.clear()
+                    s._vars.update(snap)
         return len(self._cache) - n0
 
     # ------------------------------------------------------------------
@@ -480,9 +796,13 @@ class Executor:
                 arr = arr.astype(dt)
             feed_arrays[name] = arr
 
-        fetches = self._dispatch(program, feed, feed_arrays, spec,
-                                 fetch_names, scope, multi_step=True,
-                                 scan_steps=steps)
+        fetches, inflight = self._dispatch(program, feed, feed_arrays, spec,
+                                           fetch_names, scope,
+                                           multi_step=True,
+                                           scan_steps=steps)
+        if inflight is not None:
+            return StepHandle(fetches, window=self._window, entry=inflight,
+                              materialize=return_numpy)
         if return_numpy:
             return [np.asarray(v) for v in fetches]
         return list(fetches)
@@ -494,7 +814,11 @@ class Executor:
         RNG seeding, the executable call, and scope write-back.  Every
         phase is a tracer span (observe/tracer.py) and every call feeds
         the StepTimer (observe/step_stats.py) — the per-run cost of both
-        is a flag check when the tracer is off."""
+        is a flag check when the tracer is off.
+
+        Returns ``(fetches, inflight)``: ``inflight`` is the window
+        entry when the call was dispatched pipelined
+        (FLAGS_max_inflight_steps > 0), else None (legacy sync mode)."""
         from ..observe import tracer as otrace
 
         with otrace.span("executor/run", multi_step=bool(multi_step)):
@@ -598,6 +922,15 @@ class Executor:
             feed_vals, mut_vals, const_vals, rng = entry.globalize(
                 feed_vals, mut_vals, const_vals, rng)
 
+        # pipelined dispatch (FLAGS_max_inflight_steps): backpressure
+        # BEFORE launching the next step so at most `max_inflight` steps
+        # are ever in flight; 0 keeps the legacy synchronous-fetch path
+        max_inflight = int(flags.flag("max_inflight_steps"))
+        pipelined = max_inflight > 0
+
+        if pipelined:
+            self._window.backpressure(max_inflight)
+
         # jit traces lazily: the FIRST call of a fresh entry is the real
         # trace+XLA-compile (the "executor/lowering" span and per-
         # collective spans nest inside it); later calls are pure execute
@@ -609,15 +942,14 @@ class Executor:
             with otrace.span("executor/execute"):
                 fetches, new_state, new_rng = entry.fn(
                     feed_vals, mut_vals, const_vals, rng)
-                if flags.flag("benchmark"):
+                if not pipelined and flags.flag("benchmark"):
                     # reference FLAGS_benchmark: sync so the recorded
                     # time is the step, not the async dispatch
                     jax.block_until_ready((fetches, new_state))
         entry.n_calls += 1
 
-        # step telemetry: per-step wall time -> step_time_seconds
-        # histogram; examples from the feed batch dim; FLOPs/allreduce
-        # bytes are the compile-time static accounting on the entry
+        # examples/steps for the StepTimer; FLOPs/allreduce bytes are
+        # the compile-time static accounting on the entry
         if multi_step:
             n_steps = scan_steps
             if n_steps is None and feed_arrays:
@@ -626,31 +958,60 @@ class Executor:
         else:
             n_steps = 1
         batch = next((s[0] for _, s, _ in spec if s), 0)
-        _step_stats.step_timer().record_run(
-            _time.perf_counter() - t_exec0, steps=n_steps,
-            examples=int(batch) * n_steps, compiled=first_call,
-            flops_per_step=entry.flops_per_step,
-            allreduce_bytes_per_step=entry.allreduce_bytes)
 
         for n, v in zip(entry.state_out, new_state):
             scope.set_var(n, v)
         if entry.uses_rng:
             scope.set_var(RNG_VAR, new_rng)
+
+        if pipelined:
+            nan_flags = None
+            if entry.nan_scan:
+                # keep the sentinel on device: the host check moves to
+                # the window-drain point (no per-step sync)
+                nan_flags = fetches[-1]
+                fetches = fetches[:-1]
+            # a fetched var that is ALSO a state output may share its
+            # XLA buffer with the scope array the NEXT dispatch donates
+            # (jit dedupes identical outputs); give the handle its own
+            # buffer so a held, undrained fetch can't be overwritten —
+            # CPU donation is a no-op, but TPU/GPU donation is real
+            out_set = set(entry.state_out)
+            if any(n in out_set for n in entry.fetch_names):
+                import jax.numpy as jnp
+
+                fetches = tuple(
+                    jnp.copy(v) if n in out_set and _is_jax_array(v)
+                    else v
+                    for n, v in zip(entry.fetch_names, fetches))
+            inflight = _InflightStep(
+                sync_refs=tuple(fetches), nan_flags=nan_flags,
+                nan_ops=entry.nan_ops, t_dispatch=t_exec0, steps=n_steps,
+                examples=int(batch) * n_steps, compiled=first_call,
+                flops_per_step=entry.flops_per_step,
+                allreduce_bytes=entry.allreduce_bytes)
+            self._window.push(inflight)
+            if flags.flag("benchmark") or entry.nan_scan:
+                # both flags mean "per-call semantics": FLAGS_benchmark
+                # wants the recorded time to be the step, nan-scan wants
+                # the raise inside the offending run — drain right away
+                # (accounting/raise still happen AT the drain point)
+                self._window.drain_through(inflight)
+            return fetches, inflight
+
+        # legacy sync mode: telemetry + nan check at dispatch
+        _step_stats.step_timer().record_run(
+            _time.perf_counter() - t_exec0, steps=n_steps,
+            examples=int(batch) * n_steps, compiled=first_call,
+            flops_per_step=entry.flops_per_step,
+            allreduce_bytes_per_step=entry.allreduce_bytes)
         if entry.nan_scan:
             # NOT named `flags`: that would shadow the framework.flags
             # module imported at the top of this scope
-            nan_flags = np.asarray(fetches[-1]).astype(bool)
+            nan_flags = np.asarray(fetches[-1])
             fetches = fetches[:-1]
-            if entry.nan_ops:
-                ok = nan_flags.reshape(-1, len(entry.nan_ops)).all(axis=0)
-                if not ok.all():
-                    i = int(np.argmin(ok))
-                    op_type, site = entry.nan_ops[i]
-                    raise RuntimeError(
-                        f"FLAGS_check_nan_inf: op {op_type!r} (built at "
-                        f"{site}) produced NaN/Inf (op #{i} of the compiled "
-                        f"block)")
-        return fetches
+            _raise_on_nan(nan_flags, entry.nan_ops)
+        return fetches, None
 
     # ------------------------------------------------------------------
     def _apply_graph_passes(self, program, fetch_names, feed, scope):
@@ -687,6 +1048,9 @@ class Executor:
         """Interpret a host I/O block (save/load programs).  Mixed
         compute+io blocks are rejected: build a separate save program as
         the reference's io.py does."""
+        # a save program must observe a quiescent pipeline (telemetry +
+        # NaN checks of in-flight steps fire before any file is written)
+        self.drain()
         from . import var_io
 
         block = program.global_block
@@ -1185,8 +1549,17 @@ class Executor:
 
         return fn, globalize
 
+    def drain(self):
+        """Block until every in-flight pipelined step has completed:
+        telemetry is recorded, NaN-scan flags are checked, and the scope
+        holds a quiescent state.  No-op when nothing is in flight."""
+        self._window.drain_all()
+
     def close(self):
-        # drain pending async checkpoint saves FIRST: a shutdown must
+        # quiesce the pipeline first: in-flight steps must complete (and
+        # their telemetry/NaN checks fire) before caches are dropped
+        self.drain()
+        # drain pending async checkpoint saves NEXT: a shutdown must
         # never abandon a queued snapshot mid-write (the manager's
         # atomic commit makes a torn abort recoverable, but a clean
         # close should finish the work it accepted)
@@ -1204,8 +1577,9 @@ class Executor:
         self._pass_cache.clear()
 
 
-def _is_jax_array(x) -> bool:
-    return hasattr(x, "sharding") and hasattr(x, "dtype")
+# the one shared jax-Array duck-type probe lives in scope.py (leaf
+# module); this alias keeps the historical local name
+_is_jax_array = _is_device_array
 
 
 def _acp_configured() -> bool:

@@ -134,3 +134,20 @@ define_flag("device_peak_tflops", 275.0,
             "per-chip peak TFLOP/s used by the MFU estimate "
             "(observe/step_stats.py); default is TPU v4/v5e-class bf16 "
             "peak — set to your part's number for honest utilization")
+define_flag("max_inflight_steps", 2,
+            "pipelined step dispatch (framework/executor.py): Executor."
+            "run returns a lazy StepHandle and up to this many steps may "
+            "be in flight on the device before dispatch backpressures "
+            "(drains the oldest step).  0 = legacy synchronous fetch "
+            "(every run blocks on device->host transfer of its fetch "
+            "list).  NaN-scan, FLAGS_benchmark sync, and StepTimer "
+            "accounting all happen at window-drain points; "
+            "FLAGS_benchmark / FLAGS_check_nan_inf force an immediate "
+            "drain per step so their semantics stay per-call")
+define_flag("compile_cache_dir", "",
+            "persistent XLA compilation cache directory (sets jax's "
+            "jax_compilation_cache_dir through framework/jax_compat.py "
+            "when the installed jax has the knob): restarted jobs reuse "
+            "compiled executables instead of re-tracing + re-compiling; "
+            "empty = disabled.  Applied when an Executor is constructed; "
+            "counted once as executor_compile_cache_dir_set")

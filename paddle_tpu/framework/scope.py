@@ -14,6 +14,12 @@ from typing import Dict, Optional
 import numpy as np
 
 
+def is_device_array(x) -> bool:
+    """jax Array duck-type probe — THE shared detection rule (executor,
+    io, scope all import this one; a rule change lands everywhere)."""
+    return hasattr(x, "sharding") and hasattr(x, "dtype")
+
+
 class _TensorView:
     """Minimal ``.get_tensor()`` compatibility object."""
 
@@ -22,7 +28,12 @@ class _TensorView:
         self._name = name
 
     def set(self, array, place=None):
-        self._scope.set_var(self._name, np.asarray(array), place)
+        # a jax device array passes through untouched: np.asarray here
+        # would force a pointless device->host->device round trip (the
+        # scope stores device arrays natively)
+        if not is_device_array(array):
+            array = np.asarray(array)
+        self._scope.set_var(self._name, array, place)
 
     def shape(self):
         v = self._scope.get_var(self._name)

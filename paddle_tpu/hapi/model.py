@@ -28,6 +28,73 @@ class InputSpec:
         self.name = name
 
 
+class _Deferred:
+    """A not-yet-materialized log value (thunk over a lazy StepHandle)."""
+
+    __slots__ = ("fn",)
+
+    def __init__(self, fn):
+        self.fn = fn
+
+    def __call__(self):
+        return self.fn()
+
+
+class LazyLogs(dict):
+    """Batch logs whose values may be deferred: the static adapter's
+    ``train_batch``/``eval_batch`` run through the pipelined Executor
+    and return lazy StepHandles — a log value only forces the
+    device→host sync when something actually READS it (a callback
+    printing at ``log_freq``, the epoch-end history append), so the
+    training loop keeps steps in flight instead of blocking on every
+    loss.  Reads materialize in place; ``raw()`` returns the thunk
+    without forcing it (the evaluate loop defers its per-batch losses
+    to one sync at epoch end)."""
+
+    def _force(self, k, v):
+        if isinstance(v, _Deferred):
+            v = v()
+            dict.__setitem__(self, k, v)
+        return v
+
+    def __getitem__(self, k):
+        return self._force(k, dict.__getitem__(self, k))
+
+    def get(self, k, default=None):
+        if k in self:
+            return self.__getitem__(k)
+        return default
+
+    def items(self):
+        return [(k, self._force(k, dict.__getitem__(self, k)))
+                for k in self]
+
+    def values(self):
+        return [v for _, v in self.items()]
+
+    def raw(self, k, default=None):
+        """The stored value — a ``_Deferred`` thunk if not yet forced."""
+        return dict.get(self, k, default)
+
+    def force(self):
+        """Materialize every value in place (plain floats afterwards —
+        safe to dict()/copy()/unpack)."""
+        self.items()
+        return self
+
+    def copy(self):
+        return dict(self.items())  # a snapshot never leaks thunks
+
+
+def _callbacks_tolerate_lazy(cbks) -> bool:
+    """Only the framework's own callbacks are KNOWN not to snapshot
+    logs via dict(logs)/copy()/{**} (which bypass LazyLogs' lazy reads
+    and would leak _Deferred thunks).  Any user callback gets fully
+    materialized logs — correctness over overlap."""
+    return all(type(c).__module__.startswith("paddle_tpu.")
+               for c in getattr(cbks, "callbacks", []))
+
+
 class Model:
     """Mode follows the global graph mode at construction (reference
     hapi/model.py:819 picks _AdapterStatic vs dynamic the same way):
@@ -247,12 +314,24 @@ class Model:
         prog = st["train"] if kind == "train" else st["eval"]
         outs = st["exe"].run(prog, feed=feed, fetch_list=fetch,
                              scope=st["scope"])
-        logs = {}
+        logs = LazyLogs()
         if has_loss:
-            logs["loss"] = float(np.asarray(outs[-1]).ravel()[0])
+            # deferred: the pipelined Executor returned a lazy handle —
+            # the loss only syncs when a callback/history actually reads
+            # it, so dispatch of the next batch is never blocked here.
+            # Capture ONLY the loss's device scalar, not the handle: a
+            # thunk pinning the whole fetch list would keep every
+            # batch's predictions alive for as long as the logs live
+            # (evaluate accumulates one thunk per batch)
+            loss_ref = (outs.device_arrays()[-1]
+                        if hasattr(outs, "device_arrays") else outs[-1])
+            logs["loss"] = _Deferred(
+                lambda: float(np.asarray(loss_ref).ravel()[0]))
         if labels is not None and self._metrics:
             from ..dygraph.tensor import Tensor
 
+            # metrics READ the prediction: materialize it (this is the
+            # one per-batch sync a metric-carrying loop genuinely needs)
             pred = Tensor(np.asarray(outs[0]))
             lbl = Tensor(np.asarray(
                 labels[0] if isinstance(labels, (list, tuple)) else labels))
@@ -296,6 +375,7 @@ class Model:
                                          for n in _as_list(m.name())])
         self.stop_training = False
         cbks.on_train_begin()
+        lazy_ok = _callbacks_tolerate_lazy(cbks)
         history = {"loss": []}
         for epoch in range(epochs):
             cbks.on_epoch_begin(epoch)
@@ -309,6 +389,8 @@ class Model:
                 for m in self._metrics:
                     for n, v in zip(_as_list(m.name()), _as_list(m.accumulate())):
                         logs[n] = v
+                if not lazy_ok and isinstance(logs, LazyLogs):
+                    logs.force()  # user callbacks see plain floats
                 cbks.on_train_batch_end(step, logs)
             history["loss"].append(logs.get("loss"))
             cbks.on_epoch_end(epoch, logs)
@@ -330,17 +412,25 @@ class Model:
         for m in self._metrics:
             m.reset()
         cbks.on_eval_begin()
+        lazy_ok = _callbacks_tolerate_lazy(cbks)
         logs = {}
         losses = []
         for step, batch in enumerate(loader):
             cbks.on_eval_batch_begin(step)
             xs, ys = self._split_batch(batch)
             logs = self.eval_batch(xs, ys)
+            if not lazy_ok and isinstance(logs, LazyLogs):
+                logs.force()
             if "loss" in logs:
-                losses.append(logs["loss"])
+                # keep the thunk: all batch losses sync in ONE pass at
+                # the end instead of serializing the eval pipeline
+                losses.append(logs.raw("loss")
+                              if isinstance(logs, LazyLogs)
+                              else logs["loss"])
             cbks.on_eval_batch_end(step, logs)
         if losses:
-            logs["loss"] = float(np.mean(losses))
+            logs["loss"] = float(np.mean(
+                [v() if isinstance(v, _Deferred) else v for v in losses]))
         for m in self._metrics:
             for n, v in zip(_as_list(m.name()), _as_list(m.accumulate())):
                 logs[n] = v

@@ -206,28 +206,35 @@ def default_collate_fn(batch: List):
     return arr
 
 
-class _PrefetchIterator:
-    """Background-thread prefetch (the reference buffered_reader /
-    multiprocess worker role; threads suffice because workers mostly wait
-    on IO and numpy releases the GIL)."""
+class _StageIterator:
+    """Consumer half of one background pipeline stage: bounded queue,
+    ``_END`` marker, exception propagation, stop-event abandonment, and
+    the ``input_wait_seconds`` accounting.  ``_PrefetchIterator`` (host
+    batch assembly) and ``DevicePrefetcher`` (H2D transfer) are this
+    plus a producer thread running ``_stage_fill``."""
 
     _END = object()
 
-    def __init__(self, make_batches, num_workers, prefetch_factor=2):
-        self._q = queue.Queue(maxsize=max(2, num_workers * prefetch_factor))
+    def __init__(self, queue_size, record_wait=True):
+        self._q = queue.Queue(maxsize=queue_size)
         self._exc_box: list = []
         self._stop_evt = threading.Event()
+        self._done = False
+        # input_wait_seconds is the TRAINING loop's stall metric: only
+        # the OUTERMOST stage records it (an inner stage's queue waits
+        # are background-thread idle time, not consumer stalls)
+        self._record_wait = record_wait
+
+    def _start(self, target, args):
         # the fill function must NOT hold a strong ref to self: a running
         # thread would keep the iterator alive forever and __del__ (the
         # worker-reaping trigger on abandonment) would never fire
-        self._thread = threading.Thread(
-            target=_prefetch_fill,
-            args=(make_batches, self._q, self._exc_box, self._stop_evt),
-            daemon=True)
+        self._thread = threading.Thread(target=target, args=args,
+                                        daemon=True)
         self._thread.start()
 
     def close(self):
-        """Release the fill thread (and through it the worker processes)
+        """Release the fill thread (and through it any worker processes)
         when the consumer abandons the iterator mid-epoch."""
         self._stop_evt.set()
 
@@ -237,18 +244,58 @@ class _PrefetchIterator:
         return self
 
     def __next__(self):
-        item = self._q.get()
+        if self._done:
+            # the single _END marker was already consumed and the fill
+            # thread has exited: a re-entered exhausted iterator must
+            # keep raising StopIteration, not block on an empty queue
+            raise StopIteration
+        if self._record_wait:
+            import time as _time
+
+            from ..observe.histogram import stat_time
+
+            t0 = _time.perf_counter()
+            item = self._q.get()
+            stat_time("input_wait_seconds", _time.perf_counter() - t0)
+        else:
+            item = self._q.get()
         if item is self._END:
+            self._done = True
             if self._exc_box:
                 raise self._exc_box[0]
             raise StopIteration
         return item
 
 
-def _prefetch_fill(make_batches, q, exc_box, stop_evt):
-    gen = make_batches()
+class _PrefetchIterator(_StageIterator):
+    """Background-thread prefetch (the reference buffered_reader /
+    multiprocess worker role; threads suffice because workers mostly wait
+    on IO and numpy releases the GIL)."""
+
+    def __init__(self, make_batches, num_workers, prefetch_factor=2,
+                 record_wait=True):
+        super().__init__(max(2, num_workers * prefetch_factor),
+                         record_wait=record_wait)
+        self._start(_prefetch_fill,
+                    (make_batches, self._q, self._exc_box, self._stop_evt))
+
+
+def _stage_fill(gen, q, exc_box, stop_evt, end_marker, transform=None):
+    """The one background pipeline-stage body (_PrefetchIterator and
+    DevicePrefetcher both run this): pull items from ``gen``, optionally
+    ``transform`` each, block-put into the bounded queue with stop-event
+    polling, surface exceptions through ``exc_box``.
+
+    The ``end_marker`` must ALWAYS reach the consumer, even when the
+    queue is still full of undrained batches (e.g. an epoch with fewer
+    batches than the queue capacity finishes before the consumer takes
+    its first item) — a dropped marker blocks ``__next__`` forever.
+    Block-put with the same stop-event polling as normal batches; only
+    an explicit close() abandons delivery."""
     try:
         for b in gen:
+            if transform is not None:
+                b = transform(b)
             placed = False
             while not stop_evt.is_set():
                 try:
@@ -266,19 +313,103 @@ def _prefetch_fill(make_batches, q, exc_box, stop_evt):
         # which shuts down any worker processes it spawned
         if hasattr(gen, "close"):
             gen.close()
-        # The _END marker must ALWAYS reach the consumer, even when the
-        # queue is still full of undrained batches (e.g. an epoch with
-        # fewer batches than the queue capacity finishes before the
-        # consumer takes its first item) — a dropped marker blocks
-        # __next__ forever.  Block-put with the same stop-event polling
-        # as normal batches; only an explicit close() abandons delivery.
         while True:
             try:
-                q.put(_PrefetchIterator._END, timeout=0.25)
+                q.put(end_marker, timeout=0.25)
                 break
             except queue.Full:
                 if stop_evt.is_set():
                     break
+
+
+def _prefetch_fill(make_batches, q, exc_box, stop_evt):
+    _stage_fill(make_batches(), q, exc_box, stop_evt,
+                _PrefetchIterator._END)
+
+
+from ..framework.scope import is_device_array as _is_device_array  # noqa: E402
+
+
+def _device_put_batch(batch, sharding):
+    """Transfer every array leaf of ``batch`` (nested tuples/lists/
+    dicts) to device, returning ``(device_batch, bytes_transferred)``.
+    ``sharding`` may be a single jax Sharding/device applied to every
+    leaf, or a dict/sequence matching the batch structure for per-feed
+    placement.  Leaves that are already device arrays pass through
+    untouched when no explicit sharding is requested (clean fallback
+    for loaders that already yield device data)."""
+    import jax
+
+    n_bytes = 0
+
+    def put(x, sh):
+        nonlocal n_bytes
+        if isinstance(x, dict):
+            shs = sh if isinstance(sh, dict) else {k: sh for k in x}
+            return {k: put(v, shs.get(k)) for k, v in x.items()}
+        if isinstance(x, (list, tuple)):
+            if isinstance(sh, (list, tuple)) and len(sh) == len(x):
+                out = [put(v, s) for v, s in zip(x, sh)]
+            else:
+                out = [put(v, sh) for v in x]
+            return tuple(out) if isinstance(x, tuple) else out
+        if _is_device_array(x) and sh is None:
+            return x  # already placed; nothing to transfer
+        arr = x if hasattr(x, "nbytes") else np.asarray(x)
+        n_bytes += int(getattr(arr, "nbytes", 0))
+        return jax.device_put(arr, sh)
+
+    return put(batch, sharding), n_bytes
+
+
+def _device_prefetch_fill(it, q, exc_box, stop_evt, sharding):
+    """Background transfer stage: pull host batches, ``jax.device_put``
+    them (H2D overlaps device compute instead of serializing inside the
+    jitted step call), queue device batches.  The queue/END/abandonment
+    protocol is _stage_fill's — only the per-item transform differs."""
+    from ..monitor import stat_add, stat_set
+    from ..observe import tracer as otrace
+
+    def to_device(b):
+        with otrace.span("h2d_prefetch"):
+            b, n = _device_put_batch(b, sharding)
+            otrace.set_span_args(bytes=n)
+        stat_set("h2d_bytes_per_step", n)
+        stat_add("h2d_bytes_total", n)
+        return b
+
+    _stage_fill(it, q, exc_box, stop_evt, DevicePrefetcher._END,
+                transform=to_device)
+
+
+class DevicePrefetcher(_StageIterator):
+    """Device-side input prefetch: wraps any batch iterable and moves
+    the next ``prefetch_factor`` batches onto device from a background
+    thread (double buffering), so the H2D transfer overlaps the device's
+    compute instead of serializing inside the Executor's jitted call.
+
+    ``sharding`` places leaves onto the step's feed sharding (a jax
+    Sharding/device, or a dict/sequence matching the batch structure);
+    ``None`` uses jax's default device.  Batches whose leaves are
+    already device arrays pass through untouched.  Exceptions from the
+    source iterable (or the transfer) surface on the consumer's
+    ``next()``.  ``input_wait_seconds`` (histogram) records how long the
+    consumer blocked per batch; ``h2d_bytes_per_step`` (gauge) /
+    ``h2d_bytes_total`` (counter) and the ``h2d_prefetch`` tracer span
+    account the transfers."""
+
+    def __init__(self, iterable, prefetch_factor: int = 2, sharding=None):
+        super().__init__(max(int(prefetch_factor), 1))
+        it = iter(iterable)
+        if isinstance(it, _StageIterator):
+            # this stage is now the outermost: the inner stage's queue
+            # waits happen on OUR background thread and must not be
+            # recorded as training-loop input stalls.  Checked on the
+            # ITERATOR — wrapping a DataLoader directly builds its
+            # _PrefetchIterator only at iter()
+            it._record_wait = False
+        self._start(_device_prefetch_fill,
+                    (it, self._q, self._exc_box, self._stop_evt, sharding))
 
 
 _ENV_PIN_LOCK = threading.Lock()  # guards the JAX_PLATFORMS pin in start
@@ -351,7 +482,8 @@ class DataLoader:
                  batch_sampler=None, batch_size=1, shuffle=False,
                  drop_last=False, collate_fn=None, num_workers=0,
                  use_buffer_reader=True, prefetch_factor=2, use_shared_memory=True,
-                 timeout=0, worker_init_fn=None):
+                 timeout=0, worker_init_fn=None, device_prefetch=False,
+                 feed_sharding=None):
         self.dataset = dataset
         self.return_list = return_list
         self.collate_fn = collate_fn or default_collate_fn
@@ -360,6 +492,11 @@ class DataLoader:
         self.use_buffer_reader = use_buffer_reader
         self.timeout = timeout
         self.worker_init_fn = worker_init_fn
+        # device-side input prefetch (DevicePrefetcher): batches come
+        # back with array leaves already jax.device_put onto
+        # ``feed_sharding`` from a background transfer thread
+        self.device_prefetch = device_prefetch
+        self.feed_sharding = feed_sharding
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
             self.batch_sampler = None
@@ -587,9 +724,15 @@ class DataLoader:
 
     def __iter__(self):
         if self.use_buffer_reader:
-            return _PrefetchIterator(self._batches, max(self.num_workers, 1),
-                                     self.prefetch_factor)
-        return self._batches()
+            it = _PrefetchIterator(self._batches, max(self.num_workers, 1),
+                                   self.prefetch_factor,
+                                   record_wait=not self.device_prefetch)
+        else:
+            it = self._batches()
+        if self.device_prefetch:
+            it = DevicePrefetcher(it, prefetch_factor=self.prefetch_factor,
+                                  sharding=self.feed_sharding)
+        return it
 
     def __len__(self):
         if self._iterable_mode:

@@ -17,10 +17,16 @@ Out the other end:
   that says how far from "as fast as the hardware allows" a step is.
 
 Timing honesty: jax arrays are async, so a run's wall time is dispatch
-time unless something blocks.  ``FLAGS_benchmark`` makes the Executor
-block on the fetches before stopping the clock (already the reference
-meaning of that flag); multi-step ``run_steps`` calls amortize the
-launch so their per-step number is accurate either way.
+time unless something blocks.  Under pipelined dispatch
+(``FLAGS_max_inflight_steps`` > 0, the default) the Executor records
+each step at its window-DRAIN point with the inter-drain wall time — in
+a steady loop drains fire once per dispatch (backpressure), so the
+recorded number is the training loop's true per-step period, input wait
+included.  ``summary()`` drains every live Executor first so it only
+reports completed steps.  ``FLAGS_benchmark`` forces an immediate drain
+per call (the reference meaning of that flag); multi-step ``run_steps``
+calls amortize the launch so their per-step number is accurate either
+way.
 """
 from __future__ import annotations
 
@@ -94,6 +100,19 @@ class StepTimer:
 
     # -- reading ---------------------------------------------------------
     def summary(self, peak_tflops: Optional[float] = None) -> Dict:
+        # pipelined dispatch moves per-step accounting to window-drain
+        # points: a summary is a read point, so quiesce every live
+        # Executor first — the numbers then reflect completed steps
+        # only.  raise_errors=False: a step failure hit here is PARKED
+        # on its window and re-raised at the next raising drain point
+        # (next dispatch, handle read, drain/close, ckpt snapshot) —
+        # telemetry never raises, but it never eats the error either
+        try:
+            from ..framework.executor import drain_all as _drain_all
+
+            _drain_all(raise_errors=False)
+        except ImportError:  # pragma: no cover - partial installs
+            pass
         with self._lock:
             runs, steps, examples = self.runs, self.steps, self.examples
             compiles = self.compiles

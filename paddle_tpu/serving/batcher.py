@@ -288,6 +288,12 @@ class Batcher:
                              requests=len(requests)):
                 with RecordEvent(f"serving/batch_b{bucket_rows}"):
                     outs = self._runner(feeds)
+                if hasattr(outs, "numpy"):
+                    # lazy StepHandle from the pipelined Executor: the
+                    # reply path must own host copies, so the one sync
+                    # happens here — inside the execute span, so batch
+                    # latency attribution stays truthful
+                    outs = outs.numpy()
                 outs = [np.asarray(o) for o in outs]
         except Exception as e:  # noqa: BLE001 — fault isolation per batch
             for r in requests:

@@ -318,7 +318,10 @@ class TestExecutorTelemetry:
             Y = np.ones((16, 1), "f4")
             feed = {"x": X, "y": Y}
             exe.run(main, feed=feed, fetch_list=[loss], scope=scope)
-            exe.run(main, feed=feed, fetch_list=[loss], scope=scope)
+            out = exe.run(main, feed=feed, fetch_list=[loss], scope=scope)
+            # pipelined dispatch: the executor/fetch span fires when the
+            # fetches are actually READ (StepHandle materialization)
+            np.asarray(out[0])
         finally:
             reset_mesh()
 

@@ -1,0 +1,533 @@
+"""Pipelined step dispatch (PR 5): async StepHandle fetches, the bounded
+in-flight window (FLAGS_max_inflight_steps), window-drain telemetry, and
+the DataLoader device-side input prefetch stage.
+
+Acceptance oracles:
+- pipelined mode (the default) is bitwise-parity with sync mode
+  (FLAGS_max_inflight_steps=0) over a multi-step train run with live
+  dropout RNG and Momentum slots;
+- dispatch backpressures at the window cap and drains on fetch;
+- a checkpoint snapshot taken mid-pipeline drains the window first and
+  captures the exact state a sync run would have (crash-resume parity);
+- the CPU micro-bench: with a simulated slow input source, per-step
+  host-blocking time drops >= 2x vs sync mode;
+- input_wait_seconds / fetch_sync_seconds / executor_inflight_steps /
+  h2d_bytes_per_step ride /metrics (prometheus exposition).
+"""
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers, observe
+from paddle_tpu.framework import unique_name
+from paddle_tpu.framework.program import Program, program_guard
+from paddle_tpu.framework.executor import StepHandle
+from paddle_tpu.io import DataLoader, DevicePrefetcher, TensorDataset
+from paddle_tpu.monitor import stat_get
+from paddle_tpu.optimizer import MomentumOptimizer
+
+
+@pytest.fixture
+def window(request):
+    """Set FLAGS_max_inflight_steps for a test; restore the default."""
+
+    def set_to(n):
+        pt.set_flags({"FLAGS_max_inflight_steps": n})
+
+    yield set_to
+    pt.set_flags({"FLAGS_max_inflight_steps": 2})
+
+
+def _train_model(seed=3):
+    """fc -> dropout (consumes RNG) -> fc, MSE, Momentum: parameters,
+    velocity slots, and the RNG key are all live state."""
+    main, startup = Program(), Program()
+    main.random_seed = seed
+    with unique_name.guard(), program_guard(main, startup):
+        x = layers.data("x", [8])
+        y = layers.data("y", [1])
+        h = layers.fc(x, 16, act="relu")
+        h = layers.dropout(h, 0.3)
+        pred = layers.fc(h, 1)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        MomentumOptimizer(0.05, 0.9).minimize(loss)
+    return main, startup, loss
+
+
+def _batches(n_steps, batch=16):
+    rs = np.random.RandomState(0)
+    X = rs.randn(n_steps, batch, 8).astype("f4")
+    Y = X.sum(2, keepdims=True).astype("f4") * 0.3
+    return [(X[i], Y[i]) for i in range(n_steps)]
+
+
+def _heavy_model(width=800, depth=16):
+    """Forward-only fc chain sized so one step takes a measurable wall
+    time on CPU (the device work the pipeline must hide)."""
+    main, startup = Program(), Program()
+    main.random_seed = 1
+    with unique_name.guard(), program_guard(main, startup):
+        x = layers.data("x", [width])
+        h = x
+        for _ in range(depth):
+            h = layers.fc(h, width, act="tanh", bias_attr=False)
+        out = layers.mean(h)
+    return main, startup, out
+
+
+def _run_training(n_steps, max_inflight, seed=3, read_each=True):
+    """Fresh program/scope/executor train loop; returns (losses, host
+    state snapshot, executor, scope)."""
+    pt.set_flags({"FLAGS_max_inflight_steps": max_inflight})
+    main, startup, loss = _train_model(seed=seed)
+    exe = pt.Executor(pt.CPUPlace())
+    sc = pt.framework.Scope()
+    exe.run(startup, scope=sc)
+    handles = []
+    for bx, by in _batches(n_steps):
+        handles.append(exe.run(main, feed={"x": bx, "y": by},
+                               fetch_list=[loss], scope=sc))
+    if read_each:
+        losses = [float(np.asarray(h[0]).ravel()[0]) for h in handles]
+    else:
+        losses = None
+    exe.drain()
+    state = {n: np.asarray(sc.get_var(n)) for n in sorted(sc.local_var_names())
+             if hasattr(sc.get_var(n), "dtype")}
+    return losses, state, exe, sc
+
+
+# ---------------------------------------------------------------------------
+# async-vs-sync bitwise parity
+# ---------------------------------------------------------------------------
+
+
+def test_async_sync_bitwise_loss_and_state_parity(window):
+    """THE parity oracle: 8 train steps with dropout RNG and Momentum
+    velocity slots — pipelined (default window 2) must be bitwise the
+    sync run (window 0), losses AND final state (params, slots, RNG)."""
+    try:
+        sync_l, sync_s, _, _ = _run_training(8, max_inflight=0)
+        pipe_l, pipe_s, _, _ = _run_training(8, max_inflight=2)
+    finally:
+        window(2)
+    assert sync_l == pipe_l
+    assert set(sync_s) == set(pipe_s)
+    for n in sync_s:
+        np.testing.assert_array_equal(sync_s[n], pipe_s[n], err_msg=n)
+
+
+def test_handle_semantics(window):
+    """StepHandle is a lazy list: items materialize (and cache) on
+    access, numpy() syncs everything, device_arrays() never syncs, and
+    a return_numpy=False handle yields device arrays."""
+    window(2)
+    main, startup, loss = _train_model()
+    exe = pt.Executor(pt.CPUPlace())
+    sc = pt.framework.Scope()
+    exe.run(startup, scope=sc)
+    bx, by = _batches(1)[0]
+    h = exe.run(main, feed={"x": bx, "y": by}, fetch_list=[loss], scope=sc)
+    assert isinstance(h, StepHandle) and isinstance(h, list)
+    assert len(h) == 1
+    raw = h.device_arrays()[0]
+    assert hasattr(raw, "sharding")  # still a device array: no sync yet
+    v = h[0]
+    assert isinstance(v, np.ndarray)
+    assert h[0] is v  # cached in place
+    assert h.numpy()[0] is v
+    # unpacking / iteration work like a list
+    (only,) = h
+    assert only is v
+    # return_numpy=False: device arrays on access
+    h2 = exe.run(main, feed={"x": bx, "y": by}, fetch_list=[loss], scope=sc,
+                 return_numpy=False)
+    assert hasattr(h2[0], "sharding")
+    assert np.isfinite(np.asarray(h2.numpy()[0])).all()
+    exe.drain()
+
+
+def test_nan_scan_raises_inside_the_run(window):
+    """FLAGS_check_nan_inf forces an immediate window drain, so the
+    raise still happens inside the offending run() call even in
+    pipelined mode."""
+    window(2)
+    main, startup = Program(), Program()
+    with unique_name.guard(), program_guard(main, startup):
+        x = layers.data("x", [3])
+        y = layers.log(x)
+        z = layers.scale(y, 2.0)
+    exe = pt.Executor(pt.CPUPlace())
+    sc = pt.framework.Scope()
+    exe.run(startup, scope=sc)
+    pt.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        with pytest.raises(RuntimeError, match="log"):
+            exe.run(main, feed={"x": np.array([[-1.0, 2.0, 3.0]], "f4")},
+                    fetch_list=[z], scope=sc)
+        assert len(exe._window) == 0  # the failed step is not in flight
+    finally:
+        pt.set_flags({"FLAGS_check_nan_inf": False})
+
+
+# ---------------------------------------------------------------------------
+# backpressure + the host-blocking micro-bench
+# ---------------------------------------------------------------------------
+
+
+def test_inflight_window_backpressure_and_drain_on_fetch(window):
+    """Dispatch is free until the cap, blocks AT the cap (draining the
+    oldest step), and reading a handle drains through its step."""
+    window(2)
+    main, startup, out = _heavy_model()
+    exe = pt.Executor(pt.CPUPlace())
+    sc = pt.framework.Scope()
+    exe.run(startup, scope=sc)
+    feed = {"x": np.random.RandomState(0).randn(768, 800).astype("f4")}
+    # warm (compile) + measure one sync step
+    exe.run(main, feed=feed, fetch_list=[out], scope=sc).numpy()
+    t0 = time.perf_counter()
+    exe.run(main, feed=feed, fetch_list=[out], scope=sc).numpy()
+    t_step = time.perf_counter() - t0
+    assert len(exe._window) == 0
+
+    def dispatch():
+        t0 = time.perf_counter()
+        h = exe.run(main, feed=feed, fetch_list=[out], scope=sc)
+        return h, time.perf_counter() - t0
+
+    h1, d1 = dispatch()
+    h2, d2 = dispatch()
+    assert len(exe._window) == 2
+    assert stat_get("executor_inflight_steps") == 2
+    h3, d3 = dispatch()  # cap hit: must wait for step 1 to complete
+    assert len(exe._window) == 2  # 1 drained, 3 pushed
+    # under the cap dispatch is async (a small fraction of a step);
+    # at the cap it blocks for about the remaining step time
+    assert d1 < t_step / 2, (d1, t_step)
+    assert d2 < t_step / 2, (d2, t_step)
+    assert d3 > t_step / 4, (d3, t_step)
+    assert h1._entry.drained  # the oldest step was drained by backpressure
+    # reading the NEWEST handle drains everything up to and incl. it
+    h3.numpy()
+    assert len(exe._window) == 0
+    assert h2._entry.drained
+    exe.drain()
+
+
+def test_host_blocking_drops_2x_with_slow_input_source(window):
+    """Acceptance micro-bench: a training loop fed by a slow input
+    source (sleep ~ one step time per batch).  Sync mode blocks ~a full
+    step per iteration; pipelined mode overlaps input wait with device
+    compute, so per-step host-blocking time must drop >= 2x."""
+    main, startup, out = _heavy_model()
+    exe = pt.Executor(pt.CPUPlace())
+    sc = pt.framework.Scope()
+    exe.run(startup, scope=sc)
+    feed = {"x": np.random.RandomState(0).randn(768, 800).astype("f4")}
+    exe.run(main, feed=feed, fetch_list=[out], scope=sc).numpy()  # compile
+    t0 = time.perf_counter()
+    exe.run(main, feed=feed, fetch_list=[out], scope=sc).numpy()
+    t_step = time.perf_counter() - t0
+
+    n_steps = 6
+    t_input = t_step * 1.2  # the "slow" input source
+
+    def run_mode(max_inflight):
+        window(max_inflight)
+        blocking = 0.0
+        handles = []
+        for _ in range(n_steps):
+            time.sleep(t_input)  # simulated input pipeline
+            t0 = time.perf_counter()
+            h = exe.run(main, feed=feed, fetch_list=[out], scope=sc)
+            if max_inflight == 0:
+                np.asarray(h[0])  # sync mode reads every step
+            else:
+                handles.append(h)
+            blocking += time.perf_counter() - t0
+        for h in handles:
+            h.numpy()  # final sync is outside the per-step measurement
+        exe.drain()
+        return blocking / n_steps
+
+    try:
+        sync_block = run_mode(0)
+        pipe_block = run_mode(2)
+    finally:
+        window(2)
+    assert pipe_block * 2 <= sync_block, (
+        f"pipelined host-blocking {pipe_block * 1e3:.2f}ms/step did not "
+        f"drop 2x vs sync {sync_block * 1e3:.2f}ms/step "
+        f"(step {t_step * 1e3:.1f}ms)")
+
+
+# ---------------------------------------------------------------------------
+# checkpoint quiescence
+# ---------------------------------------------------------------------------
+
+
+def test_ckpt_snapshot_mid_pipeline_drains_and_matches_sync(window, tmp_path):
+    """A snapshot taken while steps are still in flight must drain the
+    window first and capture bitwise the state a sync run has at the
+    same step; resuming from it continues bitwise-identically."""
+    from paddle_tpu.ckpt import CheckpointManager, restore_scope
+
+    # sync reference: 5 steps, snapshot state at step 3
+    try:
+        window(0)
+        main, startup, loss = _train_model()
+        exe = pt.Executor(pt.CPUPlace())
+        sc = pt.framework.Scope()
+        exe.run(startup, scope=sc)
+        sync_losses = []
+        sync_state3 = None
+        for i, (bx, by) in enumerate(_batches(5), 1):
+            o = exe.run(main, feed={"x": bx, "y": by}, fetch_list=[loss],
+                        scope=sc)
+            sync_losses.append(float(np.asarray(o[0]).ravel()[0]))
+            if i == 3:
+                from paddle_tpu.ckpt import snapshot_scope
+
+                sync_state3 = snapshot_scope(sc)
+
+        # pipelined run: dispatch 3 steps, save mid-pipeline WITHOUT
+        # reading anything — the manager must drain before snapshotting
+        window(2)
+        main2, startup2, loss2 = _train_model()
+        exe2 = pt.Executor(pt.CPUPlace())
+        sc2 = pt.framework.Scope()
+        exe2.run(startup2, scope=sc2)
+        handles = []
+        for bx, by in _batches(3):
+            handles.append(exe2.run(main2, feed={"x": bx, "y": by},
+                                    fetch_list=[loss2], scope=sc2))
+        assert len(exe2._window) > 0  # steps genuinely in flight
+        m = CheckpointManager(str(tmp_path), keep_n=0, async_save=False)
+        m.save(3, scope=sc2, wait=True)
+        assert len(exe2._window) == 0  # snapshot drained the pipeline
+        m.close()
+        pipe_losses = [float(np.asarray(h[0]).ravel()[0]) for h in handles]
+        assert pipe_losses == sync_losses[:3]
+
+        # the committed snapshot is bitwise the sync run's step-3 state
+        m2 = CheckpointManager(str(tmp_path), keep_n=0, async_save=False)
+        meta = m2.restore()
+        assert meta is not None and meta["step"] == 3
+        assert set(meta["state"]) == set(sync_state3)
+        for n in sync_state3:
+            np.testing.assert_array_equal(
+                np.asarray(meta["state"][n]), np.asarray(sync_state3[n]),
+                err_msg=n)
+
+        # crash-resume parity: restore into a fresh process-alike and
+        # run steps 4..5 pipelined -> bitwise the uninterrupted run
+        main3, startup3, loss3 = _train_model()
+        exe3 = pt.Executor(pt.CPUPlace())
+        sc3 = pt.framework.Scope()
+        exe3.run(startup3, scope=sc3)
+        restore_scope(sc3, meta["state"])
+        m2.close()
+        resumed = []
+        for bx, by in _batches(5)[3:]:
+            o = exe3.run(main3, feed={"x": bx, "y": by}, fetch_list=[loss3],
+                         scope=sc3)
+            resumed.append(float(np.asarray(o[0]).ravel()[0]))
+        assert resumed == sync_losses[3:]
+    finally:
+        window(2)
+
+
+# ---------------------------------------------------------------------------
+# DataLoader device prefetch
+# ---------------------------------------------------------------------------
+
+
+class _FailingDataset:
+    def __len__(self):
+        return 10
+
+    def __getitem__(self, i):
+        if i >= 6:
+            raise ValueError(f"boom at {i}")
+        return np.float32(i)
+
+
+def test_device_prefetch_ordering_and_types():
+    X = np.arange(128, dtype="f4").reshape(64, 2)
+    Y = (np.arange(64, dtype="f4") * 2).reshape(64, 1)
+    dl = DataLoader(TensorDataset([X, Y]), batch_size=8, shuffle=False,
+                    device_prefetch=True)
+    got = list(dl)
+    assert len(got) == 8
+    for i, (bx, by) in enumerate(got):
+        # leaves arrive ON DEVICE, in order, value-identical
+        assert hasattr(bx, "sharding") and hasattr(by, "sharding")
+        np.testing.assert_array_equal(np.asarray(bx), X[i * 8:(i + 1) * 8])
+        np.testing.assert_array_equal(np.asarray(by), Y[i * 8:(i + 1) * 8])
+
+
+def test_device_prefetch_exception_propagates():
+    dl = DataLoader(_FailingDataset(), batch_size=2, device_prefetch=True)
+    with pytest.raises(ValueError, match="boom"):
+        list(dl)
+
+
+def test_device_prefetch_passes_device_arrays_through():
+    import jax
+
+    src = [(jax.device_put(np.full(3, i, "f4")),) for i in range(4)]
+    outs = list(DevicePrefetcher(iter(src)))
+    assert len(outs) == 4
+    for (o,), (s,) in zip(outs, src):
+        assert o is s  # no copy, no re-transfer
+
+
+def test_device_prefetch_feeds_pipelined_executor(window):
+    """End to end: device-prefetched batches feed pipelined Executor.run
+    and produce the same losses as a host-fed sync loop."""
+    X = np.random.RandomState(7).randn(32, 8).astype("f4")
+    Y = X.sum(1, keepdims=True).astype("f4") * 0.3
+    try:
+        results = {}
+        for mode, (win, dev) in {"sync": (0, False),
+                                 "pipe": (2, True)}.items():
+            window(win)
+            main, startup, loss = _train_model()
+            exe = pt.Executor(pt.CPUPlace())
+            sc = pt.framework.Scope()
+            exe.run(startup, scope=sc)
+            dl = DataLoader(TensorDataset([X, Y]), batch_size=8,
+                            shuffle=False, device_prefetch=dev)
+            handles = [exe.run(main, feed={"x": bx, "y": by},
+                               fetch_list=[loss], scope=sc)
+                       for bx, by in dl]
+            results[mode] = [float(np.asarray(h[0]).ravel()[0])
+                             for h in handles]
+            exe.drain()
+        assert results["sync"] == results["pipe"]
+    finally:
+        window(2)
+
+
+# ---------------------------------------------------------------------------
+# metrics exposure
+# ---------------------------------------------------------------------------
+
+
+def test_pipeline_metrics_ride_the_metrics_route(window):
+    """input_wait_seconds / fetch_sync_seconds histograms, the
+    executor_inflight_steps gauge, and the h2d byte counters all render
+    in the prometheus text served by the fleet KV server's /metrics
+    route (test_observe pins that the route serves this exposition)."""
+    window(2)
+    X = np.random.RandomState(0).randn(16, 8).astype("f4")
+    Y = X.sum(1, keepdims=True).astype("f4")
+    main, startup, loss = _train_model()
+    exe = pt.Executor(pt.CPUPlace())
+    sc = pt.framework.Scope()
+    exe.run(startup, scope=sc)
+    dl = DataLoader(TensorDataset([X, Y]), batch_size=8, shuffle=False,
+                    device_prefetch=True)
+    for bx, by in dl:
+        exe.run(main, feed={"x": bx, "y": by}, fetch_list=[loss], scope=sc)
+    exe.drain()
+    assert observe.histogram("input_wait_seconds").count > 0
+    assert observe.histogram("fetch_sync_seconds").count > 0
+    text = observe.prometheus_text()
+    assert "paddle_tpu_executor_inflight_steps" in text
+    assert "paddle_tpu_input_wait_seconds_bucket{" in text
+    assert "paddle_tpu_fetch_sync_seconds_bucket{" in text
+    assert "paddle_tpu_h2d_bytes_per_step" in text
+    assert "paddle_tpu_h2d_bytes_total" in text
+
+
+def test_inflight_gauge_sums_across_executors(window):
+    """executor_inflight_steps totals every live Executor's window — a
+    per-window write would flap between unrelated executors."""
+    window(2)
+    bx, by = _batches(1)[0]
+    exes = []
+    for seed in (3, 4):
+        main, startup, loss = _train_model(seed=seed)
+        exe = pt.Executor(pt.CPUPlace())
+        sc = pt.framework.Scope()
+        exe.run(startup, scope=sc)
+        exe.drain()
+        exe.run(main, feed={"x": bx, "y": by}, fetch_list=[loss], scope=sc)
+        exes.append(exe)
+    assert stat_get("executor_inflight_steps") == 2  # 1 + 1, not "last"
+    for exe in exes:
+        exe.drain()
+    assert stat_get("executor_inflight_steps") == 0
+
+
+def test_exhausted_prefetch_iterators_keep_raising_stopiteration():
+    """Re-entering an exhausted iterator must raise StopIteration, not
+    block forever on the empty queue (the single _END marker is gone)."""
+    X = np.zeros((4, 1), "f4")
+    it = iter(DataLoader(TensorDataset([X]), batch_size=2,
+                         device_prefetch=True))
+    assert len(list(it)) == 2
+    for _ in range(2):
+        with pytest.raises(StopIteration):
+            next(it)
+    it2 = iter(DataLoader(TensorDataset([X]), batch_size=2))
+    list(it2)
+    for _ in range(2):
+        with pytest.raises(StopIteration):
+            next(it2)
+
+
+def test_device_prefetcher_wrapping_a_loader_records_wait_once():
+    """Wrapping a DataLoader directly must still suppress the INNER
+    stage's input_wait recording (its queue waits are background idle
+    time): exactly one observation per consumer get."""
+    X = np.zeros((8, 1), "f4")
+    dl = DataLoader(TensorDataset([X]), batch_size=2)  # buffered reader
+    observe.histogram("input_wait_seconds").reset()
+    got = list(DevicePrefetcher(dl))
+    assert len(got) == 4
+    # 4 batches + the END get — the inner _PrefetchIterator adds none
+    assert observe.histogram("input_wait_seconds").count == 5
+
+
+def test_telemetry_drain_parks_failures_for_the_next_raising_point(window):
+    """A drain failure hit on the telemetry path (StepTimer.summary,
+    raise_errors=False) must not be swallowed: it is parked on the
+    window and re-raised at the next raising drain point, exactly
+    once."""
+    from paddle_tpu.framework.executor import _InflightStep
+
+    window(2)
+    exe = pt.Executor(pt.CPUPlace())
+    bad = _InflightStep(
+        sync_refs=(), nan_flags=np.zeros((1,), bool),
+        nan_ops=(("log", "<test>"),), t_dispatch=0.0, steps=1,
+        examples=0, compiled=False, flops_per_step=0.0, allreduce_bytes=0)
+    exe._window.push(bad)
+    observe.step_timer().summary()  # telemetry read: must not raise
+    assert len(exe._window) == 0  # the entry was drained (and parked)
+    with pytest.raises(RuntimeError, match="log"):
+        exe.drain()  # the parked failure is delivered here
+    exe.drain()  # ... and only once
+
+
+def test_step_timer_summary_drains_the_window(window):
+    """StepTimer.summary() is a telemetry read point: it must reflect
+    completed steps even when nothing was ever fetched."""
+    window(2)
+    observe.reset_step_stats()
+    main, startup, loss = _train_model()
+    exe = pt.Executor(pt.CPUPlace())
+    sc = pt.framework.Scope()
+    exe.run(startup, scope=sc)
+    for bx, by in _batches(4):
+        exe.run(main, feed={"x": bx, "y": by}, fetch_list=[loss], scope=sc)
+    s = observe.step_timer().summary()
+    assert len(exe._window) == 0
+    # startup + first main run are compiles; the other 3 are steps
+    assert s["steps"] == 3
+    assert s["step_time_s"]["count"] == 3
