@@ -439,13 +439,16 @@ def preflight_device(attempts=2, timeout=240):
     in a child bounds the wait (a hung init can't wedge the bench
     process), yields a readable diagnostic, and the retry absorbs a
     transiently-held chip (e.g. an orphaned worker that is still being
-    reaped).  Returns (platform, None) or (None, diagnostic)."""
+    reaped).  Returns (platform, None, n_attempts) or
+    (None, diagnostic, n_attempts)."""
     import subprocess
     import sys
 
     code = "import jax; print(jax.devices()[0].platform)"
     diag = "no attempts made"
+    used = 0
     for i in range(attempts):
+        used = i + 1
         try:
             r = subprocess.run([sys.executable, "-c", code],
                                capture_output=True, text=True,
@@ -454,11 +457,34 @@ def preflight_device(attempts=2, timeout=240):
             diag = f"device init did not complete within {timeout}s"
         else:
             if r.returncode == 0 and r.stdout.strip():
-                return r.stdout.strip(), None
+                return r.stdout.strip(), None, used
             diag = (r.stderr or "no stderr").strip()[-2000:]
         if i + 1 < attempts:
             time.sleep(10)
-    return None, diag
+    return None, diag, used
+
+
+def _device_failure_record(result, stage, diag, attempts):
+    """Structured failure record for a preflight/device failure: the
+    driver (and the future elastic supervisor, ROADMAP item 4) gets
+    machine-readable ``status``/``failure_stage``/``diag`` keys plus a
+    postmortem bundle path — not a bare 0.0 with a one-line string.
+    The bundle is dumped host-side (stacks, metrics, flight tail,
+    flags): importing paddle_tpu does NOT touch the dead device."""
+    result.update(status="device_failure", failure_stage=stage,
+                  diag=diag, preflight_attempts=attempts,
+                  error=f"device {stage} failed: {diag}")
+    try:
+        from paddle_tpu.observe import flight, health
+
+        flight.record("bench/device_failure", stage=stage,
+                      diag=diag[:500], attempts=attempts)
+        result["postmortem"] = health.dump_postmortem(
+            f"device_{stage}", extra={"diag": diag,
+                                      "attempts": attempts})
+    except Exception as e:  # noqa: BLE001 - the record must still print
+        result["postmortem_error"] = f"{type(e).__name__}: {e}"[:300]
+    return result
 
 
 def main():
@@ -470,10 +496,10 @@ def main():
     }
     errors = {}
 
-    platform, diag = preflight_device()
+    platform, diag, attempts = preflight_device()
     if platform is None:
-        result["error"] = f"device preflight failed: {diag}"
-        print(json.dumps(result))
+        print(json.dumps(_device_failure_record(
+            result, "preflight", diag, attempts)))
         return
 
     import jax
@@ -481,6 +507,15 @@ def main():
     import paddle_tpu as pt
 
     from paddle_tpu import observe
+    from paddle_tpu.observe import flight, health
+
+    # a bench process that dies mid-flagship must leave the same bundle
+    # a stall would: crash hook + fatal-signal stacks, and a flight
+    # event marking the round's start (run metadata follows at the
+    # first Executor construction)
+    health.install_crash_handler()
+    flight.record("bench/start", platform=platform,
+                  preflight_attempts=attempts)
 
     # FLAGS_benchmark: the Executor syncs each call before stopping its
     # step clock, so the StepTimer histogram holds real per-step wall
@@ -501,7 +536,9 @@ def main():
                 hist["p50"] * 1e3, 3)
             out[f"{prefix}_step_time_ms_p95"] = round(
                 hist["p95"] * 1e3, 3)
-        if "mfu" in s:
+        # mfu is None when FLAGS_device_peak_tflops is unset/zero (no
+        # denominator): omit the key rather than publish a null/0 MFU
+        if s.get("mfu") is not None:
             out[f"{prefix}_mfu_estimate"] = s["mfu"]
         if "allreduce_bytes_per_step" in s:
             out[f"{prefix}_allreduce_bytes_per_step"] = \
@@ -574,8 +611,22 @@ def main():
     # "error" but does not void the round
     flagship_ok = ips is not None and tps is not None
     result["vs_baseline"] = round(min(ratios), 3) if flagship_ok else 0.0
+    result["status"] = "ok" if not errors else (
+        "partial" if flagship_ok or ips is not None or tps is not None
+        else "failed")
     if errors:
         result["error"] = "; ".join(f"{k}: {v}" for k, v in errors.items())
+        if not flagship_ok:
+            # flagships died AFTER a passing preflight: in-run device
+            # loss — leave the same structured record + bundle the
+            # preflight path does (partial aux results stay in place)
+            result["failure_stage"] = "flagship"
+            try:
+                result["postmortem"] = health.dump_postmortem(
+                    "flagship_failure", extra={"errors": errors})
+            except Exception as e:  # noqa: BLE001
+                result["postmortem_error"] = \
+                    f"{type(e).__name__}: {e}"[:300]
     print(json.dumps(result))
 
 

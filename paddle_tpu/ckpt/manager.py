@@ -423,6 +423,13 @@ class CheckpointManager:
             host["components"] = {n: c.state_dict()
                                   for n, c in self._components.items()}
         job = _Job(step, state, host)
+        # flight-record the ACCEPTANCE separately from the commit
+        # (ckpt/commit, in _run_job): a save that enqueues but never
+        # commits is exactly the kind of hang the recorder exists for
+        from ..observe import flight as _flight
+
+        _flight.record("ckpt/save", step=int(step), vars=len(state),
+                       async_save=self.async_save)
         if not self.async_save:
             self._run_job(job)
             stat_time("ckpt_save_blocking_seconds",
@@ -518,6 +525,10 @@ class CheckpointManager:
                 self._run_job(job)
             except BaseException as e:  # noqa: BLE001 - writer survives
                 stat_add("ckpt_save_failures")
+                from ..observe import flight as _flight
+
+                _flight.record("ckpt/save_error", step=int(job.step),
+                               error=f"{type(e).__name__}: {e}"[:500])
                 logger.exception(
                     "ckpt: background save of step %d failed (torn "
                     ".tmp left for inspection; restore() will fall "
@@ -634,6 +645,11 @@ class CheckpointManager:
         stat_add("ckpt_saves")
         stat_add("ckpt_bytes_written",
                  sum(a.nbytes for a in payload.values()))
+        from ..observe import flight as _flight
+
+        _flight.record("ckpt/commit", step=int(job.step), rank=rank,
+                       write_seconds=round(dt, 4),
+                       bytes=sum(a.nbytes for a in payload.values()))
         if rank == 0:
             self._gc(current_step=job.step)
 
@@ -740,6 +756,7 @@ class CheckpointManager:
         ``host_state``, ``vars`` and — when ``scope`` is None —
         ``state`` (the merged host arrays)."""
         from ..monitor import stat_add
+        from ..observe import flight as _flight
 
         steps = self.all_steps()
         if step is not None:
@@ -757,6 +774,8 @@ class CheckpointManager:
             ok, why = self.validate(s)
             if not ok:
                 stat_add("ckpt_restore_fallbacks")
+                _flight.record("ckpt/restore_fallback", step=int(s),
+                               reason=str(why)[:300])
                 logger.warning(
                     "ckpt: step %d in %s is not intact (%s); falling "
                     "back", s, self.dirname, why)
@@ -775,6 +794,8 @@ class CheckpointManager:
                 if obj is not None:
                     obj.set_state_dict(cstate)
             stat_add("ckpt_restores")
+            _flight.record("ckpt/restore", step=int(s),
+                           vars=len(meta["vars"]))
             return meta
         raise CheckpointError(
             f"no intact checkpoint in {self.dirname}: "

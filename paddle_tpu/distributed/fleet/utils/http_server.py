@@ -103,6 +103,17 @@ class KVHTTPServer(ThreadingHTTPServer):
         if self.routes.get("/metrics") is None:
             del self.routes["/metrics"]
 
+    def kv_snapshot(self, prefix: str = "") -> dict:
+        """Consistent copy of the KV store (optionally filtered by key
+        prefix) — the read path for aggregating routes like the
+        cluster-health ``/metrics/cluster`` (observe/health.py), which
+        must not hold the KV lock while rendering."""
+        with self.kv_lock:
+            if not prefix:
+                return dict(self.kv)
+            return {k: v for k, v in self.kv.items()
+                    if k.startswith(prefix)}
+
 
 class KVServer:
     """Reference KVServer: start/stop a background KV HTTP server."""
@@ -114,6 +125,10 @@ class KVServer:
     def add_route(self, path: str, fn) -> None:
         """Register ``path`` to serve ``fn()`` as JSON on GET."""
         self.http_server.routes[path] = fn
+
+    def kv_snapshot(self, prefix: str = "") -> dict:
+        """Copy of the KV store, optionally filtered by key prefix."""
+        return self.http_server.kv_snapshot(prefix)
 
     @property
     def port(self):

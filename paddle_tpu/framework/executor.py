@@ -207,26 +207,44 @@ class _InflightWindow:
 
         import jax
 
+        from ..monitor import stat_add
+        from ..observe import flight as _flight
         from ..observe import step_stats as _step_stats
         from ..observe import tracer as otrace
         from ..observe.histogram import stat_time
 
-        e = self._entries.popleft()
-        e.drained = True
-        _update_inflight_gauge()
-        t0 = _time.perf_counter()
+        # the entry stays IN the deque while its drain blocks (popped in
+        # the finally): a hung device call is then visible to the stall
+        # watchdog's lock-free sample (observe/health.py) as a live
+        # window entry whose age keeps growing — popping first would
+        # make the one step that matters invisible mid-hang
+        e = self._entries[0]
         try:
-            with otrace.span("dispatch/drain", steps=e.steps,
-                             n=len(e.sync_refs)):
-                jax.block_until_ready(e.sync_refs)
-                if e.nan_flags is not None:
-                    jax.block_until_ready(e.nan_flags)
-        except BaseException as err:
-            if raise_errors:
-                raise
-            if self._failed is None:
-                self._failed = err
-            return
+            t0 = _time.perf_counter()
+            try:
+                with otrace.span("dispatch/drain", steps=e.steps,
+                                 n=len(e.sync_refs)):
+                    jax.block_until_ready(e.sync_refs)
+                    if e.nan_flags is not None:
+                        jax.block_until_ready(e.nan_flags)
+            except BaseException as err:
+                # a drain that RAISES is still progress (the process is
+                # failing, not hung): advance the drained counter so the
+                # stall watchdog never mistakes a delivered error for a
+                # stall
+                stat_add("executor_steps_drained", e.steps)
+                _flight.record("executor/drain_error", steps=e.steps,
+                               error=f"{type(err).__name__}: {err}"[:500])
+                if raise_errors:
+                    raise
+                if self._failed is None:
+                    self._failed = err
+                return
+            stat_add("executor_steps_drained", e.steps)
+        finally:
+            self._entries.popleft()
+            e.drained = True
+            _update_inflight_gauge()
         now = _time.perf_counter()
         stat_time("fetch_sync_seconds", now - t0)
         # inter-drain wall time: in a steady pipelined loop drains are
@@ -244,6 +262,8 @@ class _InflightWindow:
             try:
                 _raise_on_nan(np.asarray(e.nan_flags), e.nan_ops)
             except BaseException as err:
+                _flight.record("executor/nan_detected",
+                               error=f"{err}"[:500])
                 if raise_errors:
                     raise
                 if self._failed is None:
@@ -348,6 +368,13 @@ class StepHandle(list):
 # snapshot, StepTimer.summary): a checkpoint must capture a quiescent
 # state and telemetry reads must reflect completed steps
 _LIVE_EXECUTORS: "weakref.WeakSet[Executor]" = weakref.WeakSet()
+
+# thread id -> perf_counter start of an in-flight FIRST executable call
+# (trace + XLA compile).  Sampled lock-free by the stall watchdog
+# (observe/health.py): a legitimate multi-minute compile must not read
+# as a hung device step, so the watchdog scales its timeout while one
+# is active (GIL-atomic dict set/del; telemetry only)
+_ACTIVE_COMPILES: Dict[int, float] = {}
 
 
 def _update_inflight_gauge():
@@ -567,6 +594,18 @@ class Executor:
         self._window = _InflightWindow()
         _LIVE_EXECUTORS.add(self)
         _maybe_enable_compile_cache()
+        # flight recorder + health plane (observe/): the run-metadata
+        # event fires once per process, executor creation is a
+        # lifecycle event, and FLAGS_stall_timeout_s > 0 arms the stall
+        # watchdog — all ~zero cost when the flags are off
+        from ..observe import flight as _flight
+        from ..observe import health as _health
+
+        _flight.record_run_metadata()
+        _flight.record("executor/created",
+                       place=type(self.place).__name__,
+                       device_id=self.place.device_id)
+        _health.maybe_start_watchdog()
 
     def _active_mesh(self):
         if self._mesh is not None:
@@ -893,9 +932,19 @@ class Executor:
             # with affects_lowering=True joins automatically
             flags.lowering_key(),
         )
+        from ..observe import flight as _flight
+
         entry = self._cache.get(key)
         if entry is None:
             stat_add("executor_compile")
+            # the backend is definitionally in use from here on: the
+            # one safe point to flight-record the device topology
+            # (jax.devices() on a DEAD backend is the hang itself)
+            _flight.record_device_topology()
+            _flight.record("executor/compile",
+                           fingerprint=program.fingerprint()[:16],
+                           fetches=len(fetch_names),
+                           multi_step=bool(multi_step))
             entry = self._compile(program, spec, state_in, state_out,
                                   fetch_names, mesh=mesh,
                                   multi_step=multi_step, scan_steps=scan_steps,
@@ -938,14 +987,20 @@ class Executor:
         outer = otrace.span("executor/compile") if first_call \
             else otrace.NULL_SPAN
         t_exec0 = _time.perf_counter()
-        with outer:
-            with otrace.span("executor/execute"):
-                fetches, new_state, new_rng = entry.fn(
-                    feed_vals, mut_vals, const_vals, rng)
-                if not pipelined and flags.flag("benchmark"):
-                    # reference FLAGS_benchmark: sync so the recorded
-                    # time is the step, not the async dispatch
-                    jax.block_until_ready((fetches, new_state))
+        if first_call:
+            _ACTIVE_COMPILES[threading.get_ident()] = t_exec0
+        try:
+            with outer:
+                with otrace.span("executor/execute"):
+                    fetches, new_state, new_rng = entry.fn(
+                        feed_vals, mut_vals, const_vals, rng)
+                    if not pipelined and flags.flag("benchmark"):
+                        # reference FLAGS_benchmark: sync so the recorded
+                        # time is the step, not the async dispatch
+                        jax.block_until_ready((fetches, new_state))
+        finally:
+            if first_call:
+                _ACTIVE_COMPILES.pop(threading.get_ident(), None)
         entry.n_calls += 1
 
         # examples/steps for the StepTimer; FLOPs/allreduce bytes are
@@ -991,6 +1046,9 @@ class Executor:
                 flops_per_step=entry.flops_per_step,
                 allreduce_bytes=entry.allreduce_bytes)
             self._window.push(inflight)
+            stat_add("executor_steps_dispatched", n_steps)
+            _flight.record("executor/dispatch", steps=n_steps,
+                           compiled=first_call, inflight=len(self._window))
             if flags.flag("benchmark") or entry.nan_scan:
                 # both flags mean "per-call semantics": FLAGS_benchmark
                 # wants the recorded time to be the step, nan-scan wants
@@ -999,7 +1057,13 @@ class Executor:
                 self._window.drain_through(inflight)
             return fetches, inflight
 
-        # legacy sync mode: telemetry + nan check at dispatch
+        # legacy sync mode: telemetry + nan check at dispatch.  The
+        # call above already blocked (or will on first read), so the
+        # step counts as dispatched AND drained for the health plane
+        stat_add("executor_steps_dispatched", n_steps)
+        stat_add("executor_steps_drained", n_steps)
+        _flight.record("executor/dispatch", steps=n_steps,
+                       compiled=first_call, sync=True)
         _step_stats.step_timer().record_run(
             _time.perf_counter() - t_exec0, steps=n_steps,
             examples=int(batch) * n_steps, compiled=first_call,

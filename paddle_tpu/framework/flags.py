@@ -85,6 +85,13 @@ def flag(name: str):
     return _REGISTRY[name].value
 
 
+def flags_snapshot() -> Dict:
+    """Current value of EVERY registered flag (flight-recorder run
+    metadata + postmortem bundles: the config a failure ran under is
+    half the diagnosis)."""
+    return {n: f.value for n, f in sorted(_REGISTRY.items())}
+
+
 # ---- the registry (reference platform/flags.cc equivalents that are
 # meaningful under XLA; memory/GC/cudnn knobs are N/A by design) ----------
 define_flag("check_nan_inf", False,
@@ -144,6 +151,34 @@ define_flag("max_inflight_steps", 2,
             "accounting all happen at window-drain points; "
             "FLAGS_benchmark / FLAGS_check_nan_inf force an immediate "
             "drain per step so their semantics stay per-call")
+define_flag("flight_recorder", True,
+            "record structured lifecycle events (run metadata, executor "
+            "dispatch/drain, ckpt save/restore, serving start/stop) into "
+            "the bounded in-process flight-recorder ring "
+            "(paddle_tpu.observe.flight); ~µs per event, read back by "
+            "postmortem bundles and observe.flight.tail()")
+define_flag("flight_recorder_file", "",
+            "optional always-on JSONL sink for flight-recorder events: "
+            "every event is appended + flushed to this path, so a "
+            "process that dies without running any handler still leaves "
+            "its event tail on disk; empty = ring buffer only")
+define_flag("stall_timeout_s", 0.0,
+            "stall watchdog (paddle_tpu.observe.health): when > 0, a "
+            "daemon thread samples executor progress (steps dispatched "
+            "vs drained, in-flight window age) and dumps a postmortem "
+            "bundle (all-thread stacks, Chrome trace, metrics snapshot, "
+            "flight-recorder tail, flags) after this many seconds of "
+            "no-progress with work pending; 0 = disabled")
+define_flag("postmortem_dir", "postmortem",
+            "directory postmortem bundles are written under (stall "
+            "watchdog, crash hook, bench failure records); each dump is "
+            "its own bundle_<ts>_<pid>_<reason> subdirectory — read one "
+            "with: python -m tools.postmortem <dir>")
+define_flag("heartbeat_interval_s", 10.0,
+            "cluster health telemetry (observe/health.py): period of "
+            "each rank's HealthReporter heartbeat PUT to the fleet KV "
+            "HTTP server; a rank is reported dead on /metrics/cluster "
+            "after 3 missed intervals")
 define_flag("compile_cache_dir", "",
             "persistent XLA compilation cache directory (sets jax's "
             "jax_compilation_cache_dir through framework/jax_compat.py "

@@ -334,10 +334,19 @@ class BenchmarkCallback(Callback):
                 out["examples_per_sec"] = round(
                     self.batch_size * self._steps / self._time, 3)
             if self.flops_per_step:
-                mfu = observe.mfu_estimate(
-                    self.flops_per_step, self._time / self._steps,
-                    self.peak_tflops)
-                out["mfu"] = float(f"{mfu:.4g}")
+                from ..framework import flags as _flags
+
+                peak = self.peak_tflops if self.peak_tflops is not None \
+                    else float(_flags.flag("device_peak_tflops"))
+                if peak > 0.0:
+                    mfu = observe.mfu_estimate(
+                        self.flops_per_step, self._time / self._steps,
+                        peak)
+                    out["mfu"] = float(f"{mfu:.4g}")
+                else:
+                    # no peak configured: no denominator — null, not a
+                    # misleading 0.0 (matches StepTimer.summary)
+                    out["mfu"] = None
         if "mfu" not in out:
             # static adapter: the Executor's StepTimer priced the
             # program IR (hapi/model_stat.py) — reuse its MFU
@@ -355,7 +364,7 @@ class BenchmarkCallback(Callback):
                      f"p95 {s['step_time_s'].get('p95', 0) * 1e3:.2f}ms"]
             if "examples_per_sec" in s:
                 parts.append(f"{s['examples_per_sec']:.1f} ex/s")
-            if "mfu" in s:
+            if s.get("mfu") is not None:  # None = peak tflops unset
                 parts.append(f"MFU {s['mfu']:.3f}")
             print("[bench] " + " - ".join(parts))
 

@@ -17,7 +17,22 @@ This package is the middle:
 - ``step_stats`` — ``StepTimer``: step-time distribution, examples/sec,
   compile-vs-execute split, allreduce bytes/step, and the MFU estimate
   (FLOPs from ``hapi/model_stat.py`` over the program IR).
+- ``flight``     — always-on bounded ring of structured lifecycle
+  events (run metadata, executor dispatch/drain, ckpt save/restore,
+  serving start/stop), gated by ``FLAGS_flight_recorder``, with an
+  optional JSONL file sink (``FLAGS_flight_recorder_file``).
+- ``health``     — stall watchdog (``FLAGS_stall_timeout_s``) dumping
+  postmortem bundles (all-thread stacks, Chrome trace, metrics
+  snapshot, flight tail, flags), crash/atexit hooks, and cluster-wide
+  health telemetry (per-rank heartbeats over the fleet KV server +
+  the aggregated ``/metrics/cluster`` route on rank 0).
 """
+from . import flight, health
+from .flight import FlightRecorder, get_flight_recorder
+from .health import (HealthReporter, StallWatchdog, cluster_health,
+                     dump_postmortem, executor_progress,
+                     install_crash_handler, serve_cluster_health,
+                     start_watchdog, stop_watchdog)
 from .histogram import (Histogram, HistogramRegistry, export_histograms,
                         histogram, prometheus_text, stat_time)
 from .step_stats import (StepTimer, mfu_estimate, reset_step_stats,
@@ -38,4 +53,10 @@ __all__ = [
     "export_histograms", "prometheus_text",
     # step telemetry
     "StepTimer", "step_timer", "reset_step_stats", "mfu_estimate",
+    # flight recorder
+    "flight", "FlightRecorder", "get_flight_recorder",
+    # health plane
+    "health", "StallWatchdog", "HealthReporter", "executor_progress",
+    "dump_postmortem", "start_watchdog", "stop_watchdog",
+    "install_crash_handler", "cluster_health", "serve_cluster_health",
 ]

@@ -133,9 +133,17 @@ class StepTimer:
             out["allreduce_bytes_per_step"] = ar_bytes // steps
             if flops:
                 out["flops_per_step"] = int(flops / steps)
-                # significant digits, not decimal places: a toy model's
-                # 1e-6 MFU must not round to a dead zero
-                out["mfu"] = float(f"{mfu_estimate(flops / steps, et / steps, peak_tflops):.4g}")
+                peak = peak_tflops if peak_tflops is not None \
+                    else float(_flags.flag("device_peak_tflops"))
+                if peak > 0.0:
+                    # significant digits, not decimal places: a toy
+                    # model's 1e-6 MFU must not round to a dead zero
+                    out["mfu"] = float(
+                        f"{mfu_estimate(flops / steps, et / steps, peak):.4g}")
+                else:
+                    # FLAGS_device_peak_tflops unset/zero: there is no
+                    # denominator — null, not a misleading 0.0
+                    out["mfu"] = None
         return out
 
     def reset(self) -> None:

@@ -123,6 +123,11 @@ class Server:
             self._kv.start()
         self._t_start = time.monotonic()
         self._started = True
+        from ..observe import flight as _flight
+
+        _flight.record("serving/start",
+                       http_port=self._config.http_port,
+                       warmup=bool(warmup))
         return self
 
     def stop(self, drain: bool = True):
@@ -131,6 +136,9 @@ class Server:
             self._kv.stop()
             self._kv = None
         self._started = False
+        from ..observe import flight as _flight
+
+        _flight.record("serving/stop", drain=bool(drain))
 
     def __enter__(self) -> "Server":
         return self.start()

@@ -1,0 +1,6 @@
+"""Repo tooling (API gate, op-registry compat, postmortem reader).
+
+A real package so the CLIs are ``python -m``-invocable from the repo
+root (``python -m tools.postmortem``, mirroring
+``python -m paddle_tpu.observe.timeline``).
+"""

@@ -1,0 +1,184 @@
+"""Pretty-print a postmortem bundle (paddle_tpu.observe.health).
+
+A bundle is what the stall watchdog / crash hook / bench failure path
+leaves behind: ``meta.json``, ``stacks.txt``, ``trace.json``,
+``metrics.prom``, ``flight.jsonl``, ``flags.json`` in one
+``bundle_<ts>_<pid>_<reason>`` directory.  This reader is pure stdlib —
+it must work on a machine (or in a container) where the framework
+itself won't even import, because that is exactly when you need it.
+
+Usage::
+
+    python -m tools.postmortem BUNDLE_DIR            # one bundle
+    python -m tools.postmortem POSTMORTEM_DIR        # newest bundle in it
+    python -m tools.postmortem BUNDLE --tail 50      # more flight events
+    python -m tools.postmortem BUNDLE --stacks       # full thread stacks
+    python -m tools.postmortem BUNDLE --metrics      # full metrics text
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+BUNDLE_FILES = ("meta.json", "stacks.txt", "trace.json", "metrics.prom",
+                "flight.jsonl", "flags.json")
+
+
+def _is_bundle(path: str) -> bool:
+    return os.path.isfile(os.path.join(path, "meta.json"))
+
+
+def resolve_bundle(path: str) -> str:
+    """Accept a bundle dir directly, or a parent directory of bundles
+    (pick the newest by mtime)."""
+    path = os.path.abspath(path)
+    if _is_bundle(path):
+        return path
+    if os.path.isdir(path):
+        cands = [os.path.join(path, d) for d in os.listdir(path)
+                 if d.startswith("bundle_")]
+        cands = [c for c in cands if _is_bundle(c)]
+        if cands:
+            return max(cands, key=os.path.getmtime)
+    raise FileNotFoundError(
+        f"{path} is neither a postmortem bundle (no meta.json) nor a "
+        f"directory containing bundle_* subdirectories")
+
+
+def _read_json(path: str):
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _read_text(path: str) -> Optional[str]:
+    try:
+        with open(path) as f:
+            return f.read()
+    except OSError:
+        return None
+
+
+def _fmt_event(ev: dict) -> str:
+    rest = {k: v for k, v in ev.items()
+            if k not in ("ts", "seq", "event")}
+    body = " ".join(f"{k}={v!r}" for k, v in rest.items())
+    return f"  [{ev.get('seq', '?'):>6}] {ev.get('event', '?'):<28} {body}"
+
+
+def render(bundle: str, tail: int = 15, stacks: bool = False,
+           metrics: bool = False, out=None) -> int:
+    out = out or sys.stdout
+    w = out.write
+    meta = _read_json(os.path.join(bundle, "meta.json")) or {}
+    w(f"postmortem bundle: {bundle}\n")
+    w(f"  reason:   {meta.get('reason', '?')}\n")
+    w(f"  time:     {meta.get('time', '?')}  pid {meta.get('pid', '?')}"
+      f"  rank {meta.get('rank', '?')}/{meta.get('world_size', '?')}\n")
+    prog = meta.get("progress") or {}
+    if prog:
+        w(f"  progress: dispatched={prog.get('dispatched')} "
+          f"drained={prog.get('drained')} inflight={prog.get('inflight')} "
+          f"oldest_inflight_age_s={prog.get('oldest_inflight_age_s')}\n")
+    exc = meta.get("exception")
+    if exc:
+        w(f"  exception: {exc.get('type')}: {exc.get('value')}\n")
+    extra = meta.get("extra")
+    if extra:
+        w(f"  extra:    {json.dumps(extra)[:500]}\n")
+    errs = meta.get("section_errors") or {}
+    if errs:
+        w(f"  section errors: {errs}\n")
+
+    present = [f for f in BUNDLE_FILES
+               if os.path.isfile(os.path.join(bundle, f))]
+    w(f"  files:    {', '.join(present)}\n")
+
+    # -- flight-recorder tail --------------------------------------------
+    fl = _read_text(os.path.join(bundle, "flight.jsonl"))
+    if fl is not None:
+        events: List[dict] = []
+        for line in fl.splitlines():
+            try:
+                events.append(json.loads(line))
+            except ValueError:
+                continue
+        w(f"\nflight recorder ({len(events)} events, last {tail}):\n")
+        for ev in events[-tail:]:
+            w(_fmt_event(ev) + "\n")
+
+    # -- threads ----------------------------------------------------------
+    st = _read_text(os.path.join(bundle, "stacks.txt"))
+    if st is not None:
+        heads = [ln for ln in st.splitlines()
+                 if ln.startswith("--- thread ")]
+        w(f"\nthreads ({len(heads)}):\n")
+        for h in heads:
+            w(f"  {h.strip('- ')}\n")
+        if stacks:
+            w("\n" + st + "\n")
+
+    # -- trace span count --------------------------------------------------
+    tr = _read_json(os.path.join(bundle, "trace.json"))
+    if tr is not None:
+        evs = tr.get("traceEvents", [])
+        spans = [e for e in evs if e.get("ph") == "X"]
+        w(f"\ntracer: {len(spans)} spans "
+          f"(dropped {tr.get('otherData', {}).get('dropped_spans', 0)}); "
+          f"load trace.json in Perfetto/chrome://tracing\n")
+
+    # -- metrics -----------------------------------------------------------
+    mt = _read_text(os.path.join(bundle, "metrics.prom"))
+    if mt is not None:
+        rows = [ln for ln in mt.splitlines()
+                if ln and not ln.startswith("#")
+                and "_bucket{" not in ln]
+        w(f"\nmetrics snapshot ({len(rows)} series"
+          f"{'' if metrics else ', --metrics for all'}):\n")
+        keys = ("executor_steps_", "executor_inflight", "watchdog_",
+                "postmortem_", "cluster_", "ckpt_saves", "ckpt_save_f",
+                "health_")
+        for ln in rows:
+            if metrics or any(k in ln for k in keys):
+                w(f"  {ln}\n")
+
+    flg = _read_json(os.path.join(bundle, "flags.json"))
+    if flg is not None:
+        w(f"\nflags: {len(flg)} recorded "
+          f"(stall_timeout_s={flg.get('stall_timeout_s')}, "
+          f"max_inflight_steps={flg.get('max_inflight_steps')}); "
+          f"full snapshot in flags.json\n")
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.postmortem",
+        description="Pretty-print a paddle_tpu postmortem bundle")
+    ap.add_argument("bundle",
+                    help="bundle directory, or a directory of bundle_* "
+                         "subdirectories (newest wins)")
+    ap.add_argument("--tail", type=int, default=15,
+                    help="flight-recorder events to show (default 15)")
+    ap.add_argument("--stacks", action="store_true",
+                    help="print the full all-thread stack dump")
+    ap.add_argument("--metrics", action="store_true",
+                    help="print every metrics series, not just the "
+                         "health-plane ones")
+    args = ap.parse_args(argv)
+    try:
+        bundle = resolve_bundle(args.bundle)
+    except FileNotFoundError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    return render(bundle, tail=args.tail, stacks=args.stacks,
+                  metrics=args.metrics)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -46,6 +46,8 @@ MODULES = [
     "paddle_tpu.slim",
     "paddle_tpu.monitor",
     "paddle_tpu.observe",
+    "paddle_tpu.observe.flight",
+    "paddle_tpu.observe.health",
     "paddle_tpu.ckpt",
     "paddle_tpu.framework.passes",
     "paddle_tpu.serving",
