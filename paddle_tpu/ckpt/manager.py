@@ -579,6 +579,12 @@ class CheckpointManager:
                         "sharded": True,
                         "global_shape": list(v.global_shape),
                     }
+                    if v.origin is not None:
+                        # non-axis-0 / 2D block (tensor-parallel
+                        # NamedSharding layouts): restore places the
+                        # block at this offset instead of concatenating
+                        # rank blocks along axis 0
+                        var_meta[name]["origin"] = list(v.origin)
                 elif rank == 0:
                     arr = np.asarray(v)
                     payload[name] = arr
@@ -814,13 +820,21 @@ class CheckpointManager:
         merged: Dict[str, np.ndarray] = {}
         shard_parts: Dict[str, List[Tuple[int, np.ndarray]]] = {}
         shard_info: Dict[str, dict] = {}
+        # origin-carrying shards (tensor-parallel non-axis-0 / 2D
+        # blocks): placed by offset; legacy entries (no origin) keep the
+        # axis-0 rank-order concat contract
+        origin_parts: Dict[str, List[Tuple[tuple, np.ndarray]]] = {}
         for m in metas:
             if m.get("rank", 0) == 0:
                 host_state = m.get("host_state", {}) or {}
             with np.load(os.path.join(d, m["shard"])) as z:
                 for name, rec in m.get("vars", {}).items():
                     arr = _np_restore_dtype(z[name], rec["dtype"])
-                    if rec.get("sharded"):
+                    if rec.get("sharded") and rec.get("origin") is not None:
+                        origin_parts.setdefault(name, []).append(
+                            (tuple(int(o) for o in rec["origin"]), arr))
+                        shard_info[name] = rec
+                    elif rec.get("sharded"):
                         shard_parts.setdefault(name, []).append(
                             (m.get("rank", 0), arr))
                         shard_info[name] = rec
@@ -835,4 +849,63 @@ class CheckpointManager:
                     f"sharded var {name!r} re-assembles to {full.shape}, "
                     f"manifest says {want} (rank files inconsistent)")
             merged[name] = full
+        for name, parts in origin_parts.items():
+            want = tuple(shard_info[name].get("global_shape") or ())
+            self._check_origin_coverage(name, parts, want)
+            full = np.empty(want, dtype=parts[0][1].dtype)
+            for origin, arr in parts:
+                sl = tuple(slice(o, o + s)
+                           for o, s in zip(origin, arr.shape))
+                full[sl] = arr
+            merged[name] = full
         return merged, host_state
+
+    @staticmethod
+    def _check_origin_coverage(name, parts, want):
+        """HOLES mean a rank's contribution is missing — an
+        unrestorable value must fail loudly here, not corrupt training
+        silently.  NamedSharding blocks are axis-aligned rectangles on
+        a regular per-dimension origin grid, so coverage is checked
+        arithmetically in O(#blocks) — NOT with a global-shape bool
+        mask, which would add a byte per element of peak restore
+        memory (25% overhead on an fp32 table)."""
+        blocks = {}
+        for origin, arr in parts:
+            if len(origin) != len(want) or any(
+                    o + s > w for o, s, w in zip(origin, arr.shape, want)):
+                raise CheckpointError(
+                    f"sharded var {name!r}: block {arr.shape} at "
+                    f"origin {origin} does not fit global {want}")
+            prev = blocks.get(origin)
+            if prev is not None and prev != arr.shape:
+                raise CheckpointError(
+                    f"sharded var {name!r}: conflicting blocks "
+                    f"{prev} vs {arr.shape} at origin {origin}")
+            blocks[origin] = arr.shape  # replicated dups collapse
+        per_dim = [sorted({o[d] for o in blocks})
+                   for d in range(len(want))]
+        for d, origins in enumerate(per_dim):
+            if origins and origins[0] != 0:
+                raise CheckpointError(
+                    f"sharded var {name!r}: dim {d} grid starts at "
+                    f"{origins[0]}, not 0 (missing rank file?)")
+        # every grid cell present, each dim's origins+extents tiling
+        # [0, want_d] exactly
+        import itertools
+
+        for origin in itertools.product(*per_dim):
+            shape = blocks.get(origin)
+            if shape is None:
+                raise CheckpointError(
+                    f"sharded var {name!r}: no block at grid origin "
+                    f"{origin} of global {want} (missing rank file?)")
+            for d, (o, s) in enumerate(zip(origin, shape)):
+                nxt = per_dim[d].index(o) + 1
+                end = per_dim[d][nxt] if nxt < len(per_dim[d]) \
+                    else want[d]
+                if o + s != end:
+                    raise CheckpointError(
+                        f"sharded var {name!r}: block at {origin} "
+                        f"spans [{o}, {o + s}) on dim {d} but the "
+                        f"grid expects [{o}, {end}) over global "
+                        f"{want} (holes or overlap)")

@@ -25,25 +25,61 @@ import numpy as np
 
 
 class LocalShard:
-    """This process's contiguous axis-0 block of a globally-sharded
-    array.  ``array`` is host data; ``global_shape`` is the full value's
-    shape.  Restore concatenates the rank blocks in rank order (mesh
-    devices are built process-major, so axis-0 blocks are contiguous per
-    process — see parallel_env.init_parallel_env)."""
+    """This process's contiguous block of a globally-sharded array.
 
-    __slots__ = ("array", "global_shape")
+    ``array`` is host data; ``global_shape`` is the full value's shape;
+    ``origin`` is the block's per-dimension start offset within the
+    global value.  ``origin=None`` is the legacy axis-0 contract:
+    restore concatenates the rank blocks in rank order (mesh devices
+    are built process-major, so axis-0 blocks are contiguous per
+    process — see parallel_env.init_parallel_env).  With an explicit
+    origin the block may live anywhere — the non-axis-0 / 2D layouts
+    tensor-parallel NamedShardings produce (a column-parallel weight's
+    block starts at (0, k·N/mp)) — and restore places each rank's
+    block at its recorded offset."""
 
-    def __init__(self, array, global_shape):
+    __slots__ = ("array", "global_shape", "origin")
+
+    def __init__(self, array, global_shape, origin=None):
         self.array = np.asarray(array)
         self.global_shape = tuple(int(d) for d in global_shape)
+        self.origin = (tuple(int(o) for o in origin)
+                       if origin is not None else None)
 
     @property
     def dtype(self):
         return self.array.dtype
 
     def __repr__(self):
+        o = f", origin={self.origin}" if self.origin is not None else ""
         return (f"LocalShard(block={self.array.shape}, "
-                f"global={self.global_shape})")
+                f"global={self.global_shape}{o})")
+
+
+def _assemble_blocks(blocks, ndim):
+    """Assemble this process's device blocks — {origin tuple: np
+    block} — into ONE contiguous hyperrectangle.  Blocks must tile the
+    cartesian grid of their per-dim origins (true for any NamedSharding
+    layout: every mesh axis slices one tensor dim evenly).  Returns
+    (array, origin)."""
+    per_dim = [sorted({o[d] for o in blocks}) for d in range(ndim)]
+    grid_shape = tuple(len(s) for s in per_dim)
+    expect = 1
+    for g in grid_shape:
+        expect *= g
+    if expect != len(blocks):
+        raise ValueError(
+            f"process-local shards do not tile a contiguous block: "
+            f"{len(blocks)} blocks over a {grid_shape} origin grid")
+    # stitch one dim at a time, innermost first
+    def stitch(prefix, dim):
+        if dim == ndim:
+            return blocks[tuple(prefix)]
+        parts = [stitch(prefix + [o], dim + 1) for o in per_dim[dim]]
+        return np.concatenate(parts, axis=dim) if len(parts) > 1 \
+            else parts[0]
+
+    return stitch([], 0), tuple(s[0] for s in per_dim)
 
 
 def _host_value(v):
@@ -54,16 +90,27 @@ def _host_value(v):
     if hasattr(v, "sharding") and hasattr(v, "dtype"):
         if getattr(v, "is_fully_addressable", True):
             return np.asarray(v)
-        # multi-process global array: gather the addressable blocks
+        # multi-process global array: gather the addressable blocks,
+        # keyed (and deduped — replication over a mesh axis puts the
+        # same block on several local devices) by their global origin
+        ndim = len(v.shape)
         blocks = {}
         for s in v.addressable_shards:
-            idx = s.index[0] if s.index else slice(None)
-            start = idx.start or 0 if isinstance(idx, slice) else 0
-            blocks[start] = s.data
-        parts = [np.asarray(blocks[k]) for k in sorted(blocks)]
-        if len(parts) == 1 and parts[0].shape == tuple(v.shape):
-            return parts[0]  # replicated across this process's devices
-        return LocalShard(np.concatenate(parts, axis=0), v.shape)
+            idx = tuple(s.index) if s.index else (slice(None),) * ndim
+            origin = tuple(
+                (sl.start or 0) if isinstance(sl, slice) else int(sl)
+                for sl in idx)
+            if origin not in blocks:
+                blocks[origin] = np.asarray(s.data)
+        if len(blocks) == 1:
+            origin, arr = next(iter(blocks.items()))
+            if arr.shape == tuple(v.shape):
+                return arr  # replicated across this process's devices
+            return LocalShard(arr, v.shape, origin=origin)
+        arr, origin = _assemble_blocks(blocks, ndim)
+        if arr.shape == tuple(v.shape):
+            return arr
+        return LocalShard(arr, v.shape, origin=origin)
     try:
         arr = np.asarray(v)
     except Exception:

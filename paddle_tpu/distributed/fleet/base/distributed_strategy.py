@@ -113,7 +113,35 @@ class DistributedStrategy:
     pipeline_configs = _config_prop("pipeline_configs")
     sharding_configs = _config_prop("sharding_configs")
     a_sync_configs = _config_prop("a_sync_configs")
-    tensor_parallel_configs = _config_prop("tensor_parallel_configs")
+
+    # extra tensor_parallel config keys the proto cannot hold (the
+    # TensorParallelConfig message carries only degree + seed):
+    # "partition_rules" — ordered (regex, spec) list, spec either a
+    # "None,mp" string or a tuple; "mesh_shape" — (dp, mp) used by
+    # helpers building the mesh.  Python-side only: they do NOT survive
+    # serialize_to_string (the rules DO survive program clone/proto
+    # round-trips once minimize stamps them onto the optimizer ops).
+    _TP_EXTRA_KEYS = ("partition_rules", "mesh_shape")
+
+    @property
+    def tensor_parallel_configs(self):
+        out = _config_to_dict(self._proto.tensor_parallel_configs)
+        out.update(getattr(self, "_tp_extra", {}))
+        return out
+
+    @tensor_parallel_configs.setter
+    def tensor_parallel_configs(self, configs):
+        extra = {}
+        proto_cfg = {}
+        for k, v in (configs or {}).items():
+            if k in self._TP_EXTRA_KEYS:
+                extra[k] = v
+            else:
+                proto_cfg[k] = v
+        _dict_to_config(self._proto.tensor_parallel_configs, proto_cfg)
+        if not hasattr(self, "_tp_extra"):
+            self._tp_extra = {}
+        self._tp_extra.update(extra)
 
     @property
     def nccl_comm_num(self):

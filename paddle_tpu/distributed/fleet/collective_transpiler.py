@@ -67,7 +67,8 @@ class GradAllReduce:
         grad_names = {g for _, g in pairs}
         last_writer = _last_writer_map(block.ops)
 
-        from ...framework.passes import FUSE_SIZE_ATTR, FUSED_ALLREDUCE_ATTR
+        from ...framework.passes import (DP_LOSS_SCALE_ATTR, FUSE_SIZE_ATTR,
+                                         FUSED_ALLREDUCE_ATTR)
 
         mark = {}
         if self.fuse_all_reduce:
@@ -82,11 +83,15 @@ class GradAllReduce:
                     and op.type == "fill_constant":
                 from ...framework.program import Operator
 
+                # DP_LOSS_SCALE_ATTR: the tensor-parallel meta-optimizer
+                # removes this op — under GSPMD the loss is the GLOBAL
+                # batch mean, so its gradient needs no 1/nranks correction
                 new_ops.append(Operator(
                     block, "scale", {"X": [loss_grad_name]},
                     {"Out": [loss_grad_name]},
                     {"scale": 1.0 / self.nranks, "bias": 0.0,
-                     "bias_after_scale": True}))
+                     "bias_after_scale": True,
+                     DP_LOSS_SCALE_ATTR: True}))
             # allreduce each grad right after the op that produces it last
             produced = [g for g in op.output_arg_names() if g in grad_names]
             for g in produced:

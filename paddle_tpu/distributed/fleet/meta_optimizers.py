@@ -568,6 +568,7 @@ class ShardingMetaOptimizer(MetaOptimizerBase):
         allreduce+slice), plain `c_allreduce_sum` for params left
         replicated.  Loss-grad 1/nranks scaling as in GradAllReduce."""
         from ...framework import dtypes
+        from ...framework.passes import DP_LOSS_SCALE_ATTR
         from ...framework.program import Operator
 
         n = self._nranks()
@@ -588,7 +589,8 @@ class ShardingMetaOptimizer(MetaOptimizerBase):
                     block, "scale", {"X": [loss_grad_name]},
                     {"Out": [loss_grad_name]},
                     {"scale": 1.0 / n, "bias": 0.0,
-                     "bias_after_scale": True}))
+                     "bias_after_scale": True,
+                     DP_LOSS_SCALE_ATTR: True}))
             for g in op.output_arg_names():
                 pname = grad_to_param.get(g)
                 if pname is None or last_writer.get(g) != i:
@@ -748,6 +750,89 @@ class PipelineMetaOptimizer(MetaOptimizerBase):
             loss, startup_program, parameter_list, no_grad_set)
 
 
+class TensorParallelMetaOptimizer(MetaOptimizerBase):
+    """Tensor-parallel (Megatron-style intra-layer) sharding over a
+    named dp×mp mesh — reference
+    fleet/meta_optimizers/tensor_parallel_optimizer.py role, GSPMD-
+    native form.
+
+    Outermost wrapper (NOT a can_be_last graph-level optimizer): it
+    composes with whichever graph-level chain applied — the plain DP
+    transpile, ZeRO-1 sharding, fused allreduce, AMP, recompute — by
+    stamping the partition-rule contract onto the program's optimizer
+    ops (``TP_RULES_ATTR``/``TP_DEGREE_ATTR``, surviving clone/proto
+    round-trips and re-keying every executor cache via the
+    fingerprint).  The executor-side ``ShardingPropagationPass`` turns
+    the rules into a :class:`~paddle_tpu.framework.passes.TPShardingPlan`
+    and the Executor compiles through jit + ``NamedSharding``.
+
+    The one program rewrite done HERE: the dp transpile's 1/nranks
+    loss-grad scale op (marked ``DP_LOSS_SCALE_ATTR``) is removed —
+    under GSPMD the traced loss is the global-batch mean, so its
+    gradient is already exact; keeping the scale would shrink every
+    gradient by the dp degree."""
+
+    def _can_apply(self):
+        return self.user_strategy.tensor_parallel
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        from ...framework.passes import (DEFAULT_MEGATRON_RULES,
+                                         DP_LOSS_SCALE_ATTR, TP_DEGREE_ATTR,
+                                         TP_RULES_ATTR, decode_spec,
+                                         encode_spec)
+        from ..parallel_env import get_mesh
+
+        strat = self.user_strategy
+        if strat.pipeline or strat.localsgd:
+            raise NotImplementedError(
+                "strategy.tensor_parallel does not compose with "
+                "strategy.pipeline/localsgd yet: both re-own program "
+                "execution; unset one")
+        mesh = get_mesh()
+        if mesh is not None and "mp" not in mesh.axis_names:
+            raise ValueError(
+                "strategy.tensor_parallel needs a mesh with an 'mp' "
+                "axis; build it with init_parallel_env(mesh_shape="
+                "(dp, mp), axis_names=('dp', 'mp'))")
+
+        ops, params_grads = self.inner_opt.minimize(
+            loss, startup_program, parameter_list, no_grad_set)
+
+        cfg = strat.tensor_parallel_configs or {}
+        # proto default is 1 ("unset"): 0 in the stamp means "use the
+        # mesh's mp axis size"; an explicit degree >= 2 is VALIDATED
+        # against the mesh at dispatch time
+        degree = int(cfg.get("tensor_parallel_degree") or 0)
+        if degree <= 1:
+            degree = 0
+        rules = cfg.get("partition_rules") or DEFAULT_MEGATRON_RULES
+        encoded = []
+        for pat, spec in rules:
+            if not isinstance(spec, str):
+                spec = encode_spec(spec)
+            decode_spec(spec)  # validate early: bad specs fail HERE
+            encoded.append(f"{pat}\t{spec}")
+
+        prog = loss.block.program
+        block = prog.global_block
+        block.ops[:] = [op for op in block.ops
+                        if not op.attr(DP_LOSS_SCALE_ATTR)]
+        stamped = False
+        for op in block.ops:
+            if op.type in _OPTIMIZER_OP_TYPES:
+                op.attrs[TP_RULES_ATTR] = list(encoded)
+                op.attrs[TP_DEGREE_ATTR] = degree
+                stamped = True
+        if not stamped:
+            raise ValueError(
+                "strategy.tensor_parallel found no optimizer ops to "
+                "stamp its partition rules on; minimize() must build "
+                "the training program first")
+        prog._bump()
+        return ops, params_grads
+
+
 class GraphExecutionMetaOptimizer(MetaOptimizerBase):
     """The default collective DP transpile (reference
     graph_execution_optimizer.py:92 + transpiler/collective.py:244)."""
@@ -789,13 +874,16 @@ META_OPTIMIZERS = [
     PipelineMetaOptimizer,  # graph-level; wins over plain DP when set
     ShardingMetaOptimizer,  # graph-level; wins over plain DP when set
     GraphExecutionMetaOptimizer,
+    # OUTERMOST (wraps the graph-level winner): stamps the tensor-
+    # parallel rule contract after the dp/ZeRO transpile ran, so it
+    # composes with fused-allreduce, AMP, recompute, and ZeRO chains
+    TensorParallelMetaOptimizer,
 ]
 
 # strategy flags with no implementation yet: refuse loudly rather than
 # silently training without the requested behavior (the reference raises
 # when a meta-optimizer is unavailable too)
-_UNSUPPORTED_FLAGS = ("a_sync", "elastic", "tensor_parallel",
-                      "sequence_parallel")
+_UNSUPPORTED_FLAGS = ("a_sync", "elastic", "sequence_parallel")
 
 
 def compile_strategy(loss, role_maker, inner_opt, strategy):

@@ -404,6 +404,31 @@ def drain_all(raise_errors: bool = True):
         exe._window.drain_all(raise_errors=raise_errors)
 
 
+_threefry_partitionable_applied = False
+
+
+def _maybe_enable_partitionable_threefry():
+    """Switch jax to the partitionable threefry implementation (the
+    modern default upstream).  The legacy implementation generates
+    DIFFERENT bits when XLA shards the consumer of a random op — a
+    dropout mask inside the tensor-parallel GSPMD executable would
+    silently differ from the same program's replicated run (repro:
+    bernoulli under jit with a dp-sharded consumer output), breaking
+    the tp-vs-oracle loss-parity contract.  Partitionable threefry's
+    bit-stream is sharding-invariant, so every path — single-device,
+    shard_map dp, GSPMD tp — draws identical values for identical
+    keys.  Applied process-wide at the first Executor construction:
+    consistency REQUIRES one mode everywhere."""
+    global _threefry_partitionable_applied
+
+    if _threefry_partitionable_applied:
+        return
+    from .jax_compat import update_config
+
+    if update_config("jax_threefry_partitionable", True):
+        _threefry_partitionable_applied = True
+
+
 _compile_cache_dir_applied: Optional[str] = None
 
 
@@ -494,9 +519,16 @@ _ALLREDUCE_OPS = {"c_allreduce_sum", "c_allreduce_max", "c_allreduce_min",
                   "c_allreduce_prod", "allreduce", "mp_allreduce_sum"}
 
 
-def _collective_span_args(env, op):
+def _collective_span_args(env, op, mesh=None):
     """bytes/dtype args for a collective's tracer span, read off the
-    traced input value (static shapes at trace time)."""
+    traced input value (static shapes at trace time).
+
+    Tensor-parallel programs (GSPMD path): a grad collective carrying
+    the ShardingPropagationPass's ``__tp_spec__`` stamp reports the
+    dp-axis payload its reduce actually moves — the mp-SHARD bytes,
+    with an explicit ``axes`` arg — because the grad stays mp-sharded
+    through its dp sum (the acceptance telemetry for "grad allreduce
+    over the dp axis only")."""
     names = op.input_arg_names()
     v = env.get(names[0]) if names else None
     if v is None or not hasattr(v, "shape") or not hasattr(v, "dtype"):
@@ -504,8 +536,18 @@ def _collective_span_args(env, op):
     n = 1
     for s in v.shape:
         n *= int(s)
-    return {"bytes": n * np.dtype(v.dtype).itemsize, "dtype": str(v.dtype),
+    nbytes = n * np.dtype(v.dtype).itemsize
+    args = {"bytes": nbytes, "dtype": str(v.dtype),
             "var": names[0] if names else ""}
+    from .passes import TP_SPEC_ATTR
+
+    tp_spec = op.attr(TP_SPEC_ATTR, None)
+    if tp_spec and mesh is not None and "mp" in mesh.axis_names:
+        if "mp" in str(tp_spec).split(","):
+            args["bytes"] = nbytes // int(mesh.shape["mp"])
+        args["axes"] = "dp"
+        args["tp_spec"] = str(tp_spec)
+    return args
 
 
 def _program_allreduce_bytes(block, op_list) -> int:
@@ -594,6 +636,7 @@ class Executor:
         self._window = _InflightWindow()
         _LIVE_EXECUTORS.add(self)
         _maybe_enable_compile_cache()
+        _maybe_enable_partitionable_threefry()
         # flight recorder + health plane (observe/): the run-metadata
         # event fires once per process, executor creation is a
         # lifecycle event, and FLAGS_stall_timeout_s > 0 arms the stall
@@ -1086,23 +1129,34 @@ class Executor:
         names, scope serial); FLAGS_fuse_passes (affects_lowering=True)
         gates the whole pipeline AND re-keys the compile cache."""
         from . import flags
+        from . import passes as passes_mod
 
-        if not flags.flag("fuse_passes"):
-            return program
         if getattr(program, "_pipeline", None) is not None:
             return program  # the pipeline executor owns its own rewrite
-        from . import passes as passes_mod
+        if not flags.flag("fuse_passes"):
+            # FLAGS_fuse_passes gates the OPTIMIZATION passes only; a
+            # tensor-parallel program still needs its sharding plan (the
+            # dp loss-grad scale was removed at transpile time, so
+            # running it un-sharded would be numerically wrong, not
+            # just slow) — apply the sharding pass alone
+            if not passes_mod.has_tp_marks(program):
+                return program
+            pipeline = passes_mod.PassPipeline(
+                [passes_mod.ShardingPropagationPass()])
+        else:
+            pipeline = passes_mod.default_pipeline()
         from ..monitor import stat_add
 
-        pipeline = passes_mod.default_pipeline()
+        mesh = self._active_mesh()
         key = (program.fingerprint(), pipeline.config_key(), fetch_names,
-               frozenset(feed), scope.serial)
+               frozenset(feed), scope.serial, id(mesh))
         cached = self._pass_cache.get(key)
         if cached is not None:
             stat_add("executor_pass_cache_hit")
             return cached
         ctx = passes_mod.PassContext(fetch_names=fetch_names,
-                                     feed_names=tuple(feed), scope=scope)
+                                     feed_names=tuple(feed), scope=scope,
+                                     mesh=mesh)
         out = pipeline.apply(program, ctx)
         self._pass_cache[key] = out
         return out
@@ -1214,6 +1268,22 @@ class Executor:
         block = program.global_block
         op_list = [op for op in (ops if ops is not None else block.ops)
                    if op.type not in PSEUDO_OPS]
+        # tensor-parallel plan (ShardingPropagationPass output on the
+        # post-pass program).  A tp-stamped program WITHOUT a plan means
+        # the pass could not run — refuse rather than fall through to
+        # the shard_map dp path, whose gradient math assumes the dp
+        # loss-grad scale the tp transpile removed.
+        tp_plan = getattr(program, "_tp_plan", None)
+        if tp_plan is None:
+            from .passes import has_tp_marks
+
+            if has_tp_marks(program):
+                raise ValueError(
+                    "this program was built with DistributedStrategy."
+                    "tensor_parallel but the executor has no mesh with "
+                    "an 'mp' axis; build one with init_parallel_env("
+                    "mesh_shape=(dp, mp), axis_names=('dp', 'mp')) or "
+                    "set_mesh(Mesh(devs.reshape(dp, mp), ('dp', 'mp')))")
         # static per-step accounting for the StepTimer/MFU readout; a
         # failure here must never fail a compile
         try:
@@ -1231,7 +1301,20 @@ class Executor:
                     flops_per_step *= max(int(shape0[0]), 1)
         except Exception:  # noqa: BLE001 — telemetry only
             flops_per_step = 0.0
-        allreduce_bytes = _program_allreduce_bytes(block, op_list)
+        if tp_plan is not None:
+            # per-CHIP FLOPs under tensor parallelism: each chip holds
+            # 1/mp of every sharded layer, so comparing program FLOPs
+            # against FLAGS_device_peak_tflops without the division
+            # overstates MFU by mp× on sharded runs
+            flops_per_step /= max(tp_plan.mp_degree, 1)
+            # per-grad dp-allreduce payloads from the plan: mp-sharded
+            # grads move only their shard over dp (the post-pass op
+            # stream's var shapes are global and would overcount)
+            allreduce_bytes = sum(
+                int(r.get("bytes", 0))
+                for r in tp_plan.grad_reduce.values())
+        else:
+            allreduce_bytes = _program_allreduce_bytes(block, op_list)
         out_set = set(state_out)
         state_mut = tuple(n for n in state_in if n in out_set)
         state_const = tuple(n for n in state_in if n not in out_set)
@@ -1254,6 +1337,9 @@ class Executor:
             ctx = LoweringContext(block, env, rng_key=rng, mesh=mesh,
                                   axis_env=axis_env, ring_axes=ring_axes,
                                   fold_axes=fold_axes)
+            from .lowering import apply_tp_constraints
+            from .passes import TP_CONSTRAINT_ATTR
+
             flags = []
             with otrace.span("executor/lowering", ops=len(op_list)):
                 for op in op_list:
@@ -1264,11 +1350,17 @@ class Executor:
                             # trace cost; the args are what the timeline
                             # is really for)
                             with otrace.span(f"collective/{op.type}",
-                                             **_collective_span_args(env,
-                                                                     op)):
+                                             **_collective_span_args(
+                                                 env, op, mesh=mesh)):
                                 get_lowering(op.type)(ctx, op)
                         else:
                             get_lowering(op.type)(ctx, op)
+                        if tp_plan is not None \
+                                and op.has_attr(TP_CONSTRAINT_ATTR):
+                            # sharding anchors: pin the propagated spec
+                            # so XLA places the mp partial-sum reduce at
+                            # THIS op (Megatron f/g operator placement)
+                            apply_tp_constraints(env, op, mesh)
                     except Exception as e:
                         site = op.callstack[-1] if op.callstack \
                             else "<unknown>"
@@ -1339,7 +1431,20 @@ class Executor:
             )
 
         globalize = None
-        if mesh is None and not multi_step:
+        if tp_plan is not None:
+            # tensor-parallel GSPMD path: the whole block is ONE logical
+            # program jitted with NamedSharding in/out specs from the
+            # plan — semantics stay single-program (loss parity is by
+            # construction), sharding is pure layout, and XLA inserts
+            # the dp grad reduces and mp partial-sum reduces.  The
+            # placer rides the globalize hook: state laid out
+            # differently (startup output, restored checkpoint) is
+            # device_put onto the plan's shardings before the call.
+            run_on_device, globalize = self._build_gspmd_fn(
+                mesh, tp_plan, feed_spec, feed_names, state_mut,
+                state_const, state_out, fetch_names, trace_block,
+                multi_step=multi_step, scan_steps=scan_steps)
+        elif mesh is None and not multi_step:
             def fn(feed_vals, mut_vals, const_vals, rng):
                 env = {}
                 env.update(zip(state_mut, mut_vals))
@@ -1362,17 +1467,19 @@ class Executor:
                 state_out, fetch_names, trace_block, multi_step=multi_step,
                 scan_steps=scan_steps)
 
-        # jit traces lazily on first call; donating the mutable state gives
-        # in-place parameter-update memory behavior (buffers alias outputs).
-        jfn = jax.jit(fn, donate_argnums=(1,))
-        device = self.place.jax_device()
+        if tp_plan is None:
+            # jit traces lazily on first call; donating the mutable
+            # state gives in-place parameter-update memory behavior
+            # (buffers alias outputs).
+            jfn = jax.jit(fn, donate_argnums=(1,))
+            device = self.place.jax_device()
 
-        if mesh is None:
-            def run_on_device(feed_vals, mut_vals, const_vals, rng):
-                with jax.default_device(device):
-                    return jfn(feed_vals, mut_vals, const_vals, rng)
-        else:
-            run_on_device = jfn  # placement is the mesh's job
+            if mesh is None:
+                def run_on_device(feed_vals, mut_vals, const_vals, rng):
+                    with jax.default_device(device):
+                        return jfn(feed_vals, mut_vals, const_vals, rng)
+            else:
+                run_on_device = jfn  # placement is the mesh's job
 
         compiled = _Compiled(
             fn=run_on_device,
@@ -1612,6 +1719,116 @@ class Executor:
                 return feeds, muts, consts, to_global(rng, P())
 
         return fn, globalize
+
+    def _build_gspmd_fn(self, mesh, tp_plan, feed_spec, feed_names,
+                        state_mut, state_const, state_out, fetch_names,
+                        trace_block, multi_step=False, scan_steps=None):
+        """Tensor-parallel execution: ``jax.jit`` over the dp×mp mesh
+        with per-var ``NamedSharding`` in/out specs from the
+        :class:`~.passes.TPShardingPlan` (GSPMD; SNIPPETS.md [2]/[3]
+        pjit substrate).
+
+        Unlike the shard_map dp path there is no manual axis
+        environment: the traced program keeps GLOBAL shapes and
+        single-program semantics (program c_* collectives lower to
+        identity), the in/out shardings lay state out over the mesh —
+        tp-matched params and their optimizer slots physically live as
+        1/mp shards per chip — and XLA's SPMD partitioner inserts the
+        collectives: dp all-reduces for gradients (over shard-sized
+        payloads, since grads inherit their param's mp sharding) and
+        mp partial-sum reduces at the pass's constraint anchors.
+
+        Scope arrays come back sharded and stay sharded across steps
+        (donation aliases them in place); fetches are forced replicated
+        so handle reads and ``np.asarray`` reassemble transparently."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        if any(d.process_index != jax.process_index()
+               for d in mesh.devices.flat):
+            raise NotImplementedError(
+                "tensor_parallel over a multi-process mesh is not "
+                "implemented yet: process-local shards would need "
+                "make_array_from_process_local_data assembly per the "
+                "plan's 2D specs; run one process (all chips local) or "
+                "use the dp-only shard_map path")
+
+        dp_axis = tp_plan.dp_axis if tp_plan.dp_axis in mesh.axis_names \
+            else None
+        dp_size = int(mesh.shape[dp_axis]) if dp_axis else 1
+
+        def feed_pspec(shape):
+            # batch-dim dp sharding when it divides evenly; GSPMD
+            # semantics are identical either way (a replicated feed
+            # still computes the same global value), so non-divisible
+            # batches replicate instead of erroring like the shard_map
+            # path must
+            if (not shape or dp_axis is None or int(shape[0]) <= 1
+                    or int(shape[0]) % dp_size):
+                return P()
+            return P(dp_axis)
+
+        base_feed_specs = tuple(feed_pspec(s) for _, s, _ in feed_spec)
+        if multi_step and scan_steps is None:
+            # stacked feeds: leading step dim replicated, per-step batch
+            # dim (now dim 1) sharded over dp
+            feed_specs = tuple(P(*((None,) + tuple(s)))
+                               for s in base_feed_specs)
+        else:
+            feed_specs = base_feed_specs
+
+        def state_sharding(n):
+            return NamedSharding(mesh, tp_plan.partition_spec(n))
+
+        repl = NamedSharding(mesh, P())
+
+        if not multi_step:
+            def traced(feed_vals, mut_vals, const_vals, rng):
+                env = {}
+                env.update(zip(state_mut, mut_vals))
+                env.update(zip(state_const, const_vals))
+                env.update(zip(feed_names, feed_vals))
+                ctx = trace_block(env, rng)
+                fetches = tuple(env[n] for n in fetch_names)
+                new_state = tuple(env[n] for n in state_out)
+                return fetches, new_state, ctx.rng_key
+        else:
+            def step_fn(env, key):
+                ctx = trace_block(env, key)
+                return tuple(env[n] for n in fetch_names), ctx.rng_key
+
+            traced = _make_scan_fn(step_fn, state_mut, state_const,
+                                   state_out, feed_names, scan_steps)
+
+        feed_sh = tuple(NamedSharding(mesh, s) for s in feed_specs)
+        mut_sh = tuple(state_sharding(n) for n in state_mut)
+        const_sh = tuple(state_sharding(n) for n in state_const)
+        in_sh = (feed_sh, mut_sh, const_sh, repl)
+        out_sh = (tuple(repl for _ in fetch_names),
+                  tuple(state_sharding(n) for n in state_out),
+                  repl)
+        jfn = jax.jit(traced, in_shardings=in_sh, out_shardings=out_sh,
+                      donate_argnums=(1,))
+
+        def _place(vals, shardings):
+            # jit with explicit in_shardings REJECTS committed arrays
+            # laid out differently (e.g. mesh-replicated startup output,
+            # or a checkpoint restored onto another topology): reshard
+            # those with device_put.  Steady-state arrays already match
+            # (the step's out_shardings produced them) and np feeds are
+            # sharded by jit itself — both skip the copy.
+            return tuple(
+                jax.device_put(v, s)
+                if _is_jax_array(v) and getattr(v, "sharding", None) != s
+                else v
+                for v, s in zip(vals, shardings))
+
+        def placer(feed_vals, mut_vals, const_vals, rng):
+            return (_place(feed_vals, feed_sh), _place(mut_vals, mut_sh),
+                    _place(const_vals, const_sh),
+                    _place((rng,), (repl,))[0])
+
+        return jfn, placer
 
     def drain(self):
         """Block until every in-flight pipelined step has completed:

@@ -36,6 +36,32 @@ def register_lower(*op_types: str):
 GENERIC_GRAD_LOWERING: Optional[Callable] = None
 
 
+def apply_tp_constraints(env, op, mesh):
+    """Tensor-parallel sharding anchors: apply
+    ``lax.with_sharding_constraint`` to the op outputs the
+    ShardingPropagationPass stamped (``TP_CONSTRAINT_ATTR`` entries,
+    "var\\tspec").  This is how the per-var shardings the pass computed
+    reach the jitted computation at trace time — XLA's SPMD partitioner
+    then places the mp partial-sum reduces exactly at these anchors
+    (Megatron's f/g operators, GSPMD-style).
+
+    Defensive by design: a constraint whose rank no longer matches the
+    traced value (a rewritten program) is skipped, never fatal."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from .passes import TP_CONSTRAINT_ATTR, decode_spec
+
+    for ent in op.attr(TP_CONSTRAINT_ATTR, []) or []:
+        name, _, enc = ent.partition("\t")
+        v = env.get(name)
+        spec = decode_spec(enc)
+        if v is None or getattr(v, "ndim", None) != len(spec):
+            continue
+        env[name] = jax.lax.with_sharding_constraint(
+            v, NamedSharding(mesh, PartitionSpec(*spec)))
+
+
 def get_lowering(op_type: str) -> Callable:
     try:
         return LOWERINGS[op_type]

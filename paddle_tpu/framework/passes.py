@@ -20,6 +20,14 @@ so flipping it re-keys the compile cache too.
 
 Passes in default order:
 
+0. ``ShardingPropagationPass`` — tensor-parallel auto-sharding: maps
+   the ordered regex partition rules the TensorParallelMetaOptimizer
+   stamped onto the program over every var, propagates specs through
+   the op stream (``with_sharding_constraint`` anchors at matmul ops,
+   replicated fallback), makes optimizer slots inherit their param's
+   spec, and attaches the :class:`TPShardingPlan` the Executor lowers
+   to ``NamedSharding`` jit in/out specs on the dp×mp mesh.  Runs
+   FIRST so the fuse pass below sees its per-collective spec stamps.
 1. ``FuseAllReducePass`` — groups the `c_allreduce_sum` ops the
    collective transpiler marked (``__fused_allreduce__`` attr) into
    per-dtype buckets capped at ``__fuse_grad_size_mb__`` (default 32 MB,
@@ -51,13 +59,25 @@ import numpy as np
 
 from . import dtypes
 
+GRAD_SUFFIX_TP = "@GRAD"  # == program.GRAD_SUFFIX (local: no import cycle)
+
 __all__ = [
     "FUSED_ALLREDUCE_ATTR",
     "FUSE_SIZE_ATTR",
     "DEFAULT_FUSE_MB",
+    "TP_RULES_ATTR",
+    "TP_DEGREE_ATTR",
+    "TP_SPEC_ATTR",
+    "TP_CONSTRAINT_ATTR",
+    "DP_LOSS_SCALE_ATTR",
+    "DEFAULT_MEGATRON_RULES",
+    "encode_spec",
+    "decode_spec",
+    "TPShardingPlan",
     "Pass",
     "PassContext",
     "PassPipeline",
+    "ShardingPropagationPass",
     "FuseAllReducePass",
     "RedundantCastEliminationPass",
     "DeadOpEliminationPass",
@@ -74,19 +94,116 @@ FUSED_ALLREDUCE_ATTR = "__fused_allreduce__"
 FUSE_SIZE_ATTR = "__fuse_grad_size_mb__"
 DEFAULT_FUSE_MB = 32.0
 
+# tensor-parallel markers (TensorParallelMetaOptimizer stamps the first
+# two on the program's optimizer ops; ShardingPropagationPass stamps the
+# next two per-op).  All are op attrs so the tp contract survives
+# clone/proto round-trips AND joins the program fingerprint — a changed
+# rule list re-keys every executor cache automatically.
+TP_RULES_ATTR = "__tp_rules__"          # list of "regex\tspec" strings
+TP_DEGREE_ATTR = "__tp_degree__"        # required mp degree (0 = any)
+TP_SPEC_ATTR = "__tp_spec__"            # on grad collectives: grad's spec
+TP_CONSTRAINT_ATTR = "__tp_constraint__"  # list of "var\tspec" anchors
+# stamped by GradAllReduce/ShardingMetaOptimizer on the 1/nranks
+# loss-grad scale op so the tensor-parallel meta-optimizer can remove it
+# (GSPMD computes global-batch-mean gradients directly; keeping the
+# scale would shrink every gradient by the dp degree)
+DP_LOSS_SCALE_ATTR = "__dp_loss_scale__"
+
+
+def encode_spec(spec) -> str:
+    """Partition spec tuple -> attr string: ``(None,'mp')`` -> "None,mp".
+    The empty tuple (fully replicated / scalar) encodes as ""."""
+    return ",".join("None" if s is None else str(s) for s in spec)
+
+
+def decode_spec(enc: str):
+    """Inverse of :func:`encode_spec`."""
+    if not enc:
+        return ()
+    return tuple(None if tok == "None" else tok for tok in enc.split(","))
+
+
+# Megatron-LM style defaults over this framework's parameter naming
+# (layer_helper: "<name>.w_0"/"<name>.b_0"; text/static_models.py BERT:
+# enc_<i>_{q,k,v,out}, enc_<i>_{ffn1,ffn2}, word_embedding).  Ordered:
+# first match wins.  Anything unmatched stays replicated — plain fc
+# stacks have no inherent row/column orientation, so generic fc params
+# are NOT sharded by default (pass partition_rules for custom nets).
+DEFAULT_MEGATRON_RULES = (
+    # attention QKV projections: column-parallel (heads split over mp)
+    (r"(_q|_k|_v|_qkv|_query|_key|_value)\.w_\d+$", "None,mp"),
+    (r"(_q|_k|_v|_qkv|_query|_key|_value)\.b_\d+$", "mp"),
+    # attention/vocab output projections: row-parallel (mp-sharded
+    # contraction; the pass anchors the partial-sum reduce there)
+    (r"(_out|_proj|_o)\.w_\d+$", "mp,None"),
+    # transformer FFN: first fc column-parallel, second row-parallel
+    (r"(_ffn1|_fc1|_h_4h)\.w_\d+$", "None,mp"),
+    (r"(_ffn1|_fc1|_h_4h)\.b_\d+$", "mp"),
+    (r"(_ffn2|_fc2|_4h_h)\.w_\d+$", "mp,None"),
+    # vocab-parallel embedding table (rows = vocab over mp)
+    (r"^word_embedding$", "mp,None"),
+)
+
+
+class TPShardingPlan:
+    """The ShardingPropagationPass output: name -> partition-axes tuple
+    over the named (dp, mp) mesh, plus the static grad-reduce
+    accounting the telemetry layer reads.
+
+    Attached to the POST-pass program object (``program._tp_plan``);
+    the Executor compiles the tp program through ``jax.jit`` with
+    ``NamedSharding`` in/out specs built from this plan (GSPMD —
+    semantics stay those of the single logical program, sharding is
+    pure layout, and XLA inserts the mp partial-sum reduces the
+    constraint anchors pin)."""
+
+    __slots__ = ("specs", "mp_degree", "dp_axis", "mp_axis",
+                 "grad_reduce", "n_sharded", "n_fallback")
+
+    def __init__(self, specs, mp_degree, dp_axis="dp", mp_axis="mp",
+                 grad_reduce=None, n_sharded=0, n_fallback=0):
+        self.specs = dict(specs)
+        self.mp_degree = int(mp_degree)
+        self.dp_axis = dp_axis
+        self.mp_axis = mp_axis
+        # grad name -> {"axes": ("dp",), "bytes": per-step payload of
+        # its dp allreduce (shard-local bytes for mp-sharded grads)}
+        self.grad_reduce = dict(grad_reduce or {})
+        self.n_sharded = int(n_sharded)
+        self.n_fallback = int(n_fallback)
+
+    def spec_tuple(self, name: str) -> tuple:
+        return tuple(self.specs.get(name, ()))
+
+    def partition_spec(self, name: str):
+        from jax.sharding import PartitionSpec
+
+        return PartitionSpec(*self.specs.get(name, ()))
+
+    def named_sharding(self, mesh, name: str):
+        from jax.sharding import NamedSharding
+
+        return NamedSharding(mesh, self.partition_spec(name))
+
+    def __repr__(self):
+        return (f"TPShardingPlan(mp={self.mp_degree}, "
+                f"sharded={self.n_sharded}, fallback={self.n_fallback})")
+
 
 class PassContext:
     """Per-application context: what the Executor knows at dispatch time.
 
     ``fetch_names``/``feed_names``/``scope`` feed the dead-op slice and
     the cast dataflow; all three join the Executor's pass-cache key.
-    """
+    ``mesh`` (the executor's active mesh) drives the tensor-parallel
+    sharding pass and joins the cache key by identity."""
 
     def __init__(self, fetch_names: Sequence[str] = (),
-                 feed_names: Sequence[str] = (), scope=None):
+                 feed_names: Sequence[str] = (), scope=None, mesh=None):
         self.fetch_names = tuple(fetch_names)
         self.feed_names = tuple(feed_names)
         self.scope = scope
+        self.mesh = mesh
         # per-application scratch for passes (e.g. DCE memoizes its
         # prune slice across should_apply/apply)
         self._memo: Dict[tuple, object] = {}
@@ -137,6 +254,377 @@ def _marked_inplace_cast(op, name: str) -> bool:
     return (op.type == "cast" and bool(op.attr(FUSED_ALLREDUCE_ATTR))
             and op.inputs.get("X", []) == [name]
             and op.outputs.get("Out", []) == [name])
+
+
+def has_tp_marks(program) -> bool:
+    """True when a TensorParallelMetaOptimizer stamped this program
+    (the executor refuses to run such a program outside the GSPMD tp
+    path — the dp loss-grad scale was removed, so the shard_map dp
+    path would compute wrong gradients)."""
+    return any(op.attr(TP_RULES_ATTR) for op in program.global_block.ops)
+
+
+# ops whose output provably carries its (first) input's partition spec
+# through unchanged — the propagation walks only through these plus the
+# structured handlers below; everything else resets to unknown
+_TP_SPEC_PRESERVING = {
+    "relu", "gelu", "tanh", "sigmoid", "softmax", "dropout", "cast",
+    "scale", "assign", "c_identity", "recompute_barrier", "relu_grad",
+    "gelu_grad", "tanh_grad", "sigmoid_grad", "dropout_grad",
+    "layer_norm",  # Y spec == X spec (mean/var reduce over trailing
+                   # dims is GSPMD's job when those dims are sharded)
+}
+
+_TP_MATMUL_OPS = {"mul", "matmul", "matmul_v2"}
+
+
+@register_pass
+class ShardingPropagationPass(Pass):
+    """Tensor-parallel auto-sharding (GSPMD substrate; SNIPPETS.md [2]
+    ``match_partition_rules`` -> ``NamedSharding`` -> pjit).
+
+    Input contract: the TensorParallelMetaOptimizer stamped the
+    program's optimizer ops with ``TP_RULES_ATTR`` (ordered regex ->
+    spec rules) and ``TP_DEGREE_ATTR``; ``ctx.mesh`` is a named mesh
+    with an 'mp' axis.
+
+    What it does:
+
+    1. **Param matching** — every block var is matched against the
+       ordered rules (first match wins); a matched var whose sharded
+       dims are not divisible by the mp degree falls back to replicated
+       (counted in ``pass_tp_fallback_replicated``, never dropped).
+    2. **Slot inheritance** — optimizer accumulator slots (Velocity,
+       Moment1/2, ... — the _OPTIMIZER_ACC_SLOTS table) and param-shaped
+       persistable extras (MasterParam) inherit their Param's spec;
+       ZeRO-1 ``__sharded_accumulators__`` of replicated params get
+       P('dp') on dim 0 instead (optimizer-state memory still drops by
+       the dp degree under GSPMD layout sharding).
+    3. **Propagation** — a forward walk assigns specs to intermediates
+       (matmul contraction/output rules, elementwise merge, transpose
+       permute, spec-preserving ops, ``X@GRAD`` inherits X's spec) and
+       stamps ``TP_CONSTRAINT_ATTR`` on matmul-family anchor ops so the
+       lowering applies ``with_sharding_constraint`` there — pinning
+       the Megatron pattern: a row-parallel matmul's output constrained
+       replicated-on-mp forces XLA to place the mp partial-sum reduce
+       at that op.  Unknown intermediates stay unconstrained
+       (replicated fallback; GSPMD chooses).
+    4. **Grad-collective stamping** — transpiler-inserted
+       ``c_allreduce_sum`` ops whose grad is mp-sharded get
+       ``TP_SPEC_ATTR`` (so FuseAllReducePass never buckets across
+       sharding specs, and the collective span/byte telemetry reports
+       the dp-axis shard payload, not the full grad).
+    5. Attaches the :class:`TPShardingPlan` as ``program._tp_plan`` for
+       the Executor's GSPMD compile path.
+    """
+
+    name = "sharding_propagation"
+
+    def should_apply(self, program, ctx):
+        mesh = getattr(ctx, "mesh", None)
+        if mesh is None or "mp" not in getattr(mesh, "axis_names", ()):
+            return False
+        return has_tp_marks(program)
+
+    def apply(self, program, ctx):
+        import re
+
+        from ..monitor import stat_set
+
+        mesh = ctx.mesh
+        mp_degree = int(mesh.shape["mp"])
+        block = program.global_block
+        ops = block.ops
+
+        rules, want_degree = self._read_config(ops)
+        if want_degree and want_degree != mp_degree:
+            raise ValueError(
+                f"tensor_parallel_degree={want_degree} but the active "
+                f"mesh's 'mp' axis has {mp_degree} devices; rebuild the "
+                f"mesh (init_parallel_env(mesh_shape=(dp, {want_degree}), "
+                f"axis_names=('dp', 'mp'))) or unset the degree")
+        # a spec/anchor naming a mesh axis that does not exist would
+        # crash deep inside jax at trace time; any axis absent from
+        # THIS mesh (a pure-mp 1D mesh has no 'dp'; user rules may name
+        # arbitrary axes) degrades to None (replicated on that dim)
+        axes = set(mesh.axis_names)
+
+        def sanitize(spec):
+            return tuple(s if s in axes else None for s in spec)
+
+        compiled_rules = [(re.compile(pat), sanitize(decode_spec(enc)))
+                          for pat, enc in rules]
+
+        # -- 1. rule-match every var (params seed the state layout) ----
+        specs: Dict[str, tuple] = {}
+        n_sharded = n_fallback = 0
+        for name, var in block.vars.items():
+            spec = self._match(compiled_rules, name)
+            if spec is None:
+                continue
+            spec = self._fit(spec, var.shape)
+            if spec is None or not any(s == "mp" for s in spec):
+                continue
+            if not self._divisible(var.shape, spec, mp_degree):
+                n_fallback += 1
+                continue
+            specs[name] = spec
+            n_sharded += 1
+
+        # -- 2. optimizer slots inherit their param's spec -------------
+        self._inherit_slots(block, ops, specs, has_dp="dp" in axes)
+
+        # -- 3+4. propagate, stamp anchors and grad collectives --------
+        grad_reduce = self._propagate(block, ops, dict(specs), ctx,
+                                      mp_degree, has_dp="dp" in axes)
+
+        program._tp_plan = TPShardingPlan(
+            specs, mp_degree, grad_reduce=grad_reduce,
+            n_sharded=n_sharded, n_fallback=n_fallback)
+        program._bump()
+        stat_set("pass_tp_sharded_vars", n_sharded)
+        stat_set("pass_tp_fallback_replicated", n_fallback)
+        stat_set("pass_tp_mp_degree", mp_degree)
+        return True
+
+    # -- helpers -----------------------------------------------------------
+    @staticmethod
+    def _read_config(ops):
+        for op in ops:
+            enc = op.attr(TP_RULES_ATTR)
+            if enc:
+                rules = []
+                for ent in enc:
+                    pat, _, spec = ent.partition("\t")
+                    rules.append((pat, spec))
+                return rules, int(op.attr(TP_DEGREE_ATTR, 0) or 0)
+        return [], 0
+
+    @staticmethod
+    def _match(compiled_rules, name):
+        for rx, spec in compiled_rules:
+            if rx.search(name):
+                return spec
+        return None
+
+    @staticmethod
+    def _fit(spec, shape):
+        """Right-size a rule spec to the var's rank: a 2-dim rule on a
+        scalar/1-dim var keeps its TRAILING entries ("None,mp" applies
+        to a bias as "mp"); over-long specs never shard a var they
+        don't fit."""
+        rank = len(shape)
+        if rank == 0:
+            return None
+        if len(spec) > rank:
+            spec = spec[-rank:]
+        if len(spec) < rank:
+            spec = (None,) * (rank - len(spec)) + tuple(spec)
+        return tuple(spec)
+
+    @staticmethod
+    def _divisible(shape, spec, mp_degree):
+        for dim, s in zip(shape, spec):
+            if s == "mp" and int(dim) % mp_degree != 0:
+                return False
+        return True
+
+    @staticmethod
+    def _inherit_slots(block, ops, specs, has_dp=True):
+        """Optimizer accumulator slots (and param-shaped persistable
+        extras like MasterParam) inherit their Param's spec; ZeRO-1
+        ``__sharded_accumulators__`` of replicated params get P('dp')
+        on dim 0 instead (state memory still drops by the dp degree —
+        GSPMD layout sharding replaces the shard_map reducescatter
+        machinery, whose c_* ops lower to identity on this path)."""
+        # slot table lives with the optimizer-op knowledge in fleet;
+        # lazy import avoids a framework->fleet import cycle
+        from ..distributed.fleet.meta_optimizers import (
+            _OPTIMIZER_ACC_SLOTS, _OPTIMIZER_OP_TYPES)
+
+        for op in ops:
+            zero_accs = set(op.attr("__sharded_accumulators__", None) or ())
+            if op.type not in _OPTIMIZER_OP_TYPES and not zero_accs:
+                continue
+            pnames = op.inputs.get("Param", [])
+            # the ZeRO transpile rewires Param to "<name>@SHARD"; the
+            # rule matched the base param name
+            base = pnames[0][:-len("@SHARD")] \
+                if pnames and pnames[0].endswith("@SHARD") else \
+                (pnames[0] if pnames else None)
+            pspec = specs.get(base) if base else None
+            pvar = block._find_var_recursive(base) if base else None
+            acc_slots = _OPTIMIZER_ACC_SLOTS.get(op.type, ())
+            for slot, names in op.inputs.items():
+                if slot in ("Param", "Grad", "LearningRate"):
+                    continue
+                for nm in names:
+                    if nm in specs:
+                        continue
+                    var = block._find_var_recursive(nm)
+                    if var is None or not var.shape:
+                        continue
+                    param_shaped = (pvar is not None
+                                    and tuple(var.shape) == tuple(pvar.shape))
+                    if pspec is not None and (slot in acc_slots
+                                              or (param_shaped
+                                                  and var.persistable)
+                                              or nm in zero_accs):
+                        specs[nm] = pspec
+                    elif nm in zero_accs and has_dp:
+                        # ZeRO accumulator of a replicated param: keep
+                        # the optimizer-state-over-dp layout
+                        specs[nm] = ("dp",) + (None,) * (len(var.shape) - 1)
+
+    def _propagate(self, block, ops, known, ctx, mp_degree, has_dp=True):
+        """Forward spec walk over the op stream.  ``known`` maps var
+        name -> spec tuple (entries None|'dp'|'mp'); feeds seed 'dp' on
+        their batch dim (when the mesh has one).  Returns the per-grad
+        reduce accounting for grads riding a transpiler c_allreduce_sum."""
+        if has_dp:
+            for fname in ctx.feed_names:
+                var = block._find_var_recursive(fname)
+                if var is not None and len(var.shape) >= 1 \
+                        and fname not in known:
+                    known[fname] = ("dp",) + (None,) * (len(var.shape) - 1)
+
+        grad_reduce: Dict[str, dict] = {}
+        for op in ops:
+            if op.type in _TP_MATMUL_OPS:
+                self._prop_matmul(op, known)
+            elif op.type == "transpose" or op.type == "transpose2":
+                self._prop_transpose(op, known)
+            elif op.type.startswith("elementwise_") \
+                    and not op.type.endswith("_grad"):
+                self._prop_elementwise(op, known)
+            elif op.type in _TP_SPEC_PRESERVING:
+                xs = op.inputs.get("X", [])
+                spec = known.get(xs[0]) if len(xs) == 1 else None
+                for n in op.output_arg_names():
+                    if spec is not None and self._rank_ok(block, n, spec):
+                        known[n] = spec
+                    else:
+                        known.pop(n, None)
+            elif op.type == "c_allreduce_sum":
+                # transpiler grad collective: identity under GSPMD (the
+                # grad is already the global sum); stamp the grad's spec
+                # so fuse bucketing and telemetry stay shard-aware
+                g = op.inputs.get("X", [None])[0]
+                spec = known.get(g)
+                var = block._find_var_recursive(g) if g else None
+                if var is not None and var.shape \
+                        and all(int(s) > 0 for s in var.shape):
+                    try:
+                        nbytes = _numel(var.shape) * _itemsize(
+                            dtypes.to_str(var.dtype))
+                    except (KeyError, ValueError):
+                        continue
+                    if spec and "mp" in spec:
+                        nbytes //= mp_degree
+                        op.attrs[TP_SPEC_ATTR] = encode_spec(spec)
+                    grad_reduce[g] = {"axes": ("dp",), "bytes": nbytes}
+                continue
+            elif op.type.endswith("_grad"):
+                # the gradient of a var shares its var's layout (the
+                # Megatron memo: dW of a column-parallel W is itself
+                # column-parallel); unknown bases reset to unknown
+                for n in op.output_arg_names():
+                    base_spec = None
+                    if n.endswith(GRAD_SUFFIX_TP):
+                        base_spec = known.get(n[:-len(GRAD_SUFFIX_TP)])
+                    if base_spec is not None \
+                            and self._rank_ok(block, n, base_spec):
+                        known[n] = base_spec
+                    else:
+                        known.pop(n, None)
+            else:
+                for n in op.output_arg_names():
+                    known.pop(n, None)
+        return grad_reduce
+
+    @staticmethod
+    def _rank_ok(block, name, spec):
+        var = block._find_var_recursive(name)
+        return var is not None and len(var.shape) == len(spec)
+
+    def _prop_matmul(self, op, known):
+        """out spec = x row dims + y col dim; an mp-sharded contraction
+        makes the output a partial sum — anchoring a constraint on the
+        output (its non-contracted spec) makes XLA place the mp reduce
+        exactly here (Megatron's g operator)."""
+        xs, ys = op.inputs.get("X", []), op.inputs.get("Y", [])
+        outs = op.output_arg_names()
+        if len(xs) != 1 or len(ys) != 1 or len(outs) != 1:
+            return
+        xspec, yspec = known.get(xs[0]), known.get(ys[0])
+        if xspec is None and yspec is None:
+            known.pop(outs[0], None)
+            return
+        var = op.block._find_var_recursive(outs[0])
+        if var is None or not var.shape:
+            known.pop(outs[0], None)
+            return
+        rank = len(var.shape)
+        if op.type == "mul":
+            ncol = int(op.attr("x_num_col_dims", 1) or 1)
+            row = tuple(xspec[:ncol]) if xspec is not None \
+                else (None,) * ncol
+            col = (yspec[-1] if yspec is not None else None,)
+            spec = row + col
+            contracted = ((xspec is not None
+                           and any(s == "mp" for s in xspec[ncol:]))
+                          or (yspec is not None
+                              and any(s == "mp" for s in yspec[:-1])))
+        else:  # matmul / matmul_v2: batch dims ride through from X
+            tx = bool(op.attr("transpose_X", op.attr("trans_x", False)))
+            ty = bool(op.attr("transpose_Y", op.attr("trans_y", False)))
+            xrow = (xspec[-1] if tx else xspec[-2]) \
+                if xspec is not None and len(xspec) >= 2 else None
+            xk = (xspec[-2] if tx else xspec[-1]) \
+                if xspec is not None and len(xspec) >= 2 else None
+            ycol = (yspec[-2] if ty else yspec[-1]) \
+                if yspec is not None and len(yspec) >= 2 else None
+            yk = (yspec[-1] if ty else yspec[-2]) \
+                if yspec is not None and len(yspec) >= 2 else None
+            batch = tuple(xspec[:rank - 2]) if xspec is not None \
+                and len(xspec) == rank else (None,) * (rank - 2)
+            spec = batch + (xrow, ycol)
+            contracted = (xk == "mp") or (yk == "mp")
+        if len(spec) != rank:
+            known.pop(outs[0], None)
+            return
+        spec = tuple(s if s in (None, "dp", "mp") else None for s in spec)
+        known[outs[0]] = spec
+        if contracted or any(s == "mp" for s in spec):
+            # anchor: pin the output layout so the partial-sum reduce
+            # (or the sharded-activation layout) lands at this op
+            ents = list(op.attrs.get(TP_CONSTRAINT_ATTR, []) or [])
+            ents.append(f"{outs[0]}\t{encode_spec(spec)}")
+            op.attrs[TP_CONSTRAINT_ATTR] = ents
+
+    @staticmethod
+    def _prop_transpose(op, known):
+        xs = op.inputs.get("X", [])
+        outs = op.output_arg_names()
+        axes = [int(a) for a in (op.attr("axis", []) or [])]
+        spec = known.get(xs[0]) if len(xs) == 1 else None
+        if spec is None or len(axes) != len(spec) or not outs:
+            for n in outs:
+                known.pop(n, None)
+            return
+        known[outs[0]] = tuple(spec[a] for a in axes)
+
+    @staticmethod
+    def _prop_elementwise(op, known):
+        xs, ys = op.inputs.get("X", []), op.inputs.get("Y", [])
+        outs = op.output_arg_names()
+        if len(xs) != 1 or len(outs) != 1:
+            return
+        xspec = known.get(xs[0])
+        if xspec is not None:
+            known[outs[0]] = xspec  # Y broadcasts into X's layout
+        else:
+            known.pop(outs[0], None)
 
 
 @register_pass
@@ -240,6 +728,12 @@ class FuseAllReducePass(Pass):
                 "bytes": _numel(var.shape) * _itemsize(dtype),
                 "fp16": pre and post,
                 "ring_id": int(op.attr("ring_id", 0) or 0),
+                # tensor-parallel spec stamped by ShardingPropagationPass
+                # (runs first): joins the bucket key so differently-
+                # sharded grads NEVER share a fused buffer — a coalesce
+                # across layouts would force GSPMD to re-shard every
+                # member to one layout and back
+                "tp_spec": str(op.attr(TP_SPEC_ATTR, "") or ""),
                 "cap": float(op.attr(FUSE_SIZE_ATTR, DEFAULT_FUSE_MB))
                 * 1024.0 * 1024.0,
                 "anchor": anchor,
@@ -255,7 +749,7 @@ class FuseAllReducePass(Pass):
         buckets: List[dict] = []
         open_buckets: Dict[tuple, dict] = {}
         for e in entries:
-            key = (e["dtype"], e["ring_id"], e["fp16"])
+            key = (e["dtype"], e["ring_id"], e["fp16"], e["tp_spec"])
             if e["bytes"] > e["cap"]:
                 # an over-cap grad gets its own CLOSED bucket without
                 # evicting the key's open bucket — neighbors on either
@@ -276,7 +770,7 @@ class FuseAllReducePass(Pass):
     def _emit_bucket(block, bucket_idx: int, bucket: dict) -> List:
         from .program import Operator
 
-        dtype, ring_id, fp16 = bucket["key"]
+        dtype, ring_id, fp16, tp_spec = bucket["key"]
         grads = [e["grad"] for e in bucket["items"]]
         shapes = [e["shape"] for e in bucket["items"]]
         sections = [_numel(s) for s in shapes]
@@ -292,9 +786,14 @@ class FuseAllReducePass(Pass):
             seq.append(Operator(block, "cast", {"X": [fused]},
                                 {"Out": [fused]},
                                 {"out_dtype": dtypes.to_enum("bfloat16")}))
+        fused_attrs = {"ring_id": ring_id, "use_calc_stream": True}
+        if tp_spec:
+            # a homogeneous tp bucket keeps its members' spec visible to
+            # the collective span/byte telemetry (the fused 1-D buffer's
+            # dp payload is the member shards' sum, flagged 'mp'-sharded)
+            fused_attrs[TP_SPEC_ATTR] = tp_spec
         seq.append(Operator(block, "c_allreduce_sum", {"X": [fused]},
-                            {"Out": [fused]},
-                            {"ring_id": ring_id, "use_calc_stream": True}))
+                            {"Out": [fused]}, fused_attrs))
         if fp16:
             seq.append(Operator(block, "cast", {"X": [fused]},
                                 {"Out": [fused]},
@@ -507,10 +1006,10 @@ def default_pipeline() -> PassPipeline:
 
 
 def apply_passes(program, fetch_names: Sequence[str] = (),
-                 feed_names: Sequence[str] = (), scope=None):
+                 feed_names: Sequence[str] = (), scope=None, mesh=None):
     """One-shot convenience: run the default pipeline over ``program``
     (returns the rewritten clone, or ``program`` itself when nothing
     applied)."""
     return default_pipeline().apply(
         program, PassContext(fetch_names=fetch_names,
-                             feed_names=feed_names, scope=scope))
+                             feed_names=feed_names, scope=scope, mesh=mesh))

@@ -86,3 +86,48 @@ def _fresh_programs():
 @pytest.fixture
 def rng():
     return np.random.RandomState(1234)
+
+
+# ---------------------------------------------------------------------------
+# Shared mesh fixtures (the XLA_FLAGS 8-virtual-device setup above is THE
+# one copy; test files must not re-set it, and mesh construction for tp/dp
+# tests lives here instead of per-file duplicates).
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def mesh8():
+    """8-device 1D data-parallel mesh installed as the global parallel
+    env (what fleet.init would build); torn down after the test."""
+    from paddle_tpu.distributed.parallel_env import (init_parallel_env,
+                                                     reset_mesh)
+
+    reset_mesh()
+    mesh = init_parallel_env()
+    yield mesh
+    reset_mesh()
+
+
+@pytest.fixture
+def mesh_dp_mp():
+    """2×4 ('dp','mp') mesh for tensor-parallel tests, installed as the
+    global parallel env; torn down after the test."""
+    from paddle_tpu.distributed.parallel_env import (init_parallel_env,
+                                                     reset_mesh)
+
+    reset_mesh()
+    mesh = init_parallel_env(mesh_shape=[2, 4], axis_names=("dp", "mp"))
+    yield mesh
+    reset_mesh()
+
+
+@pytest.fixture
+def mesh_mp_only():
+    """1×8 ('dp','mp') mesh — pure tensor parallelism (dp degree 1)."""
+    from paddle_tpu.distributed.parallel_env import (init_parallel_env,
+                                                     reset_mesh)
+
+    reset_mesh()
+    mesh = init_parallel_env(mesh_shape=[1, 8], axis_names=("dp", "mp"))
+    yield mesh
+    reset_mesh()

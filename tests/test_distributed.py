@@ -17,11 +17,7 @@ from paddle_tpu.framework.program import Program, program_guard
 from paddle_tpu.distributed.parallel_env import init_parallel_env, reset_mesh
 
 
-@pytest.fixture
-def mesh8():
-    mesh = init_parallel_env()
-    yield mesh
-    reset_mesh()
+# mesh8 fixture: shared in tests/conftest.py
 
 
 def _build_mlp(lr=0.05, use_fleet=False, strategy=None):

@@ -17,11 +17,7 @@ from paddle_tpu.monitor import stat_get, stat_reset
 from paddle_tpu.distributed.parallel_env import init_parallel_env, reset_mesh
 
 
-@pytest.fixture
-def mesh8():
-    mesh = init_parallel_env()
-    yield mesh
-    reset_mesh()
+# mesh8 fixture: shared in tests/conftest.py
 
 
 def _mark(mb=32.0):

@@ -16,12 +16,7 @@ from paddle_tpu.framework import unique_name
 from paddle_tpu.framework.program import Program, program_guard
 
 
-@pytest.fixture
-def mesh8():
-    reset_mesh()
-    mesh = init_parallel_env()
-    yield mesh
-    reset_mesh()
+# mesh8 fixture: shared in tests/conftest.py
 
 
 def _build_sharded():

@@ -187,7 +187,7 @@ class TestGradientMergeStrategy:
 
 
 class TestUnsupportedStrategiesRejected:
-    @pytest.mark.parametrize("flag", ["a_sync", "tensor_parallel"])
+    @pytest.mark.parametrize("flag", ["a_sync", "sequence_parallel"])
     def test_flag_raises(self, flag):
         from paddle_tpu.distributed import fleet
 
