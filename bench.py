@@ -703,10 +703,23 @@ def main():
     # still measured by this harness's own outer timers.
     pt.set_flags({"FLAGS_benchmark": True})
 
+    from paddle_tpu.monitor import stat_get, stat_set
+
+    def reset_flagship_telemetry():
+        """Per-flagship baseline: step stats, the XLA compile-time
+        histogram, and the newest-executable-size gauge all reset so
+        the emitted keys attribute to THIS flagship's compiles."""
+        observe.reset_step_stats()
+        observe.histogram("compile_seconds").reset()
+        stat_set("executable_size_bytes", 0)
+
     def step_telemetry(prefix):
         """BENCH_* keys from the StepTimer the Executor fed during the
         flagship's timed calls: per-step p50/p95 (ms) + MFU estimate
-        (observe/step_stats.py; FLOPs from the program IR)."""
+        (observe/step_stats.py; FLOPs from the program IR), plus the
+        XLA introspection keys (observe/xla_stats.py) — total AOT
+        trace+compile wall time and executable size, the ROADMAP item 5
+        acceptance baseline the scan-over-layers PR must beat."""
         s = observe.step_timer().summary()
         hist = s.get("step_time_s", {})
         out = {}
@@ -722,6 +735,13 @@ def main():
         if "allreduce_bytes_per_step" in s:
             out[f"{prefix}_allreduce_bytes_per_step"] = \
                 s["allreduce_bytes_per_step"]
+        ch = observe.histogram("compile_seconds").summary()
+        if ch.get("count"):
+            out[f"{prefix}_compile_seconds"] = round(ch["sum"], 3)
+            out[f"{prefix}_compiles"] = int(ch["count"])
+        size = stat_get("executable_size_bytes")
+        if size:
+            out[f"{prefix}_executable_size_bytes"] = int(size)
         return out
 
     # Each flagship is isolated: one failure records its diagnostic and
@@ -741,13 +761,13 @@ def main():
     except Exception as e:
         errors["checkpoint"] = f"{type(e).__name__}: {e}"[:500]
     try:
-        observe.reset_step_stats()
+        reset_flagship_telemetry()
         ips = bench_resnet(pt, jax)
         result.update(step_telemetry("resnet50"))
     except Exception as e:
         errors["resnet50"] = f"{type(e).__name__}: {e}"[:500]
     try:
-        observe.reset_step_stats()
+        reset_flagship_telemetry()
         tps = bench_bert(pt, jax)
         result.update(step_telemetry("bert"))
     except Exception as e:

@@ -116,6 +116,11 @@ class _Compiled:
     # (hapi/model_stat.py accounting) and allreduce payload bytes
     flops_per_step: float = 0.0
     allreduce_bytes: int = 0
+    # XLA introspection (observe/xla_stats.py): the raw jax.jit callable
+    # for the AOT lower+compile at first dispatch, and the device the
+    # mesh-less path pins execution to (None when a mesh owns placement)
+    jit_fn: object = None
+    jit_device: object = None
 
 
 class _InflightStep:
@@ -982,8 +987,13 @@ class Executor:
             stat_add("executor_compile")
             # the backend is definitionally in use from here on: the
             # one safe point to flight-record the device topology
-            # (jax.devices() on a DEAD backend is the hang itself)
+            # (jax.devices() on a DEAD backend is the hang itself) —
+            # and to unlock the heartbeat's live HBM sampling for the
+            # same reason (observe/xla_stats.py)
             _flight.record_device_topology()
+            from ..observe import xla_stats as _xla_stats
+
+            _xla_stats.mark_backend_in_use()
             _flight.record("executor/compile",
                            fingerprint=program.fingerprint()[:16],
                            fetches=len(fetch_names),
@@ -1023,6 +1033,17 @@ class Executor:
         if pipelined:
             self._window.backpressure(max_inflight)
 
+        # examples/steps for the StepTimer; FLOPs/allreduce bytes are
+        # the compile-time static accounting on the entry
+        if multi_step:
+            n_steps = scan_steps
+            if n_steps is None and feed_arrays:
+                n_steps = int(np.shape(next(iter(feed_arrays.values())))[0])
+            n_steps = int(n_steps or 1)
+        else:
+            n_steps = 1
+        batch = next((s[0] for _, s, _ in spec if s), 0)
+
         # jit traces lazily: the FIRST call of a fresh entry is the real
         # trace+XLA-compile (the "executor/lowering" span and per-
         # collective spans nest inside it); later calls are pure execute
@@ -1034,6 +1055,15 @@ class Executor:
             _ACTIVE_COMPILES[threading.get_ident()] = t_exec0
         try:
             with outer:
+                if first_call:
+                    # XLA introspection (observe/xla_stats.py): AOT
+                    # lower+compile with telemetry, HBM accounting, and
+                    # the pre-dispatch budget gate — MemoryBudgetError
+                    # propagates from here with NOTHING dispatched
+                    self._introspect_first_compile(
+                        entry, program, mesh,
+                        (feed_vals, mut_vals, const_vals, rng),
+                        scope, spec, n_steps)
                 with otrace.span("executor/execute"):
                     fetches, new_state, new_rng = entry.fn(
                         feed_vals, mut_vals, const_vals, rng)
@@ -1045,17 +1075,6 @@ class Executor:
             if first_call:
                 _ACTIVE_COMPILES.pop(threading.get_ident(), None)
         entry.n_calls += 1
-
-        # examples/steps for the StepTimer; FLOPs/allreduce bytes are
-        # the compile-time static accounting on the entry
-        if multi_step:
-            n_steps = scan_steps
-            if n_steps is None and feed_arrays:
-                n_steps = int(np.shape(next(iter(feed_arrays.values())))[0])
-            n_steps = int(n_steps or 1)
-        else:
-            n_steps = 1
-        batch = next((s[0] for _, s, _ in spec if s), 0)
 
         for n, v in zip(entry.state_out, new_state):
             scope.set_var(n, v)
@@ -1119,6 +1138,91 @@ class Executor:
             fetches = fetches[:-1]
             _raise_on_nan(nan_flags, entry.nan_ops)
         return fetches, None
+
+    # ------------------------------------------------------------------
+    def _introspect_first_compile(self, entry, program, mesh, args, scope,
+                                  spec, n_steps):
+        """AOT-lower + compile the fresh entry BEFORE its first dispatch
+        (observe/xla_stats.py): compile wall time into the
+        ``compile_seconds`` histogram, executable size / HLO module
+        stats / per-chip HBM footprint (``compiled.memory_analysis``)
+        onto ``/metrics``, a ``compile_done`` flight event, the
+        TPShardingPlan-joined per-var attribution table, and the
+        ``FLAGS_hbm_budget_fraction`` gate — which raises
+        :class:`~..observe.xla_stats.MemoryBudgetError` with nothing
+        dispatched.  On success the compiled executable replaces the
+        entry's callable so the compile is paid once.
+
+        Everything short of a budget rejection is best-effort: a jax
+        without AOT stages (or a path ``lower()`` cannot handle) falls
+        back to the lazy first-call trace with the telemetry skipped."""
+        from . import flags
+
+        if entry.jit_fn is None or not flags.flag("xla_introspect"):
+            return
+        import contextlib
+        import time as _time
+
+        import jax
+
+        from ..monitor import stat_add
+        from ..observe import tracer as otrace
+        from ..observe import xla_stats
+
+        t0 = _time.perf_counter()
+        try:
+            ctx = jax.default_device(entry.jit_device) \
+                if entry.jit_device is not None else contextlib.nullcontext()
+            with otrace.span("executor/aot_compile"), ctx:
+                compiled = entry.jit_fn.lower(*args).compile()
+        except Exception as e:  # noqa: BLE001 — lazy path unchanged
+            stat_add("xla_introspect_unavailable")
+            logger.debug("XLA AOT introspection unavailable: %s", e)
+            return
+        seconds = _time.perf_counter() - t0
+
+        # per-var sizes for the attribution join: scope state (params,
+        # optimizer slots — the shardable bytes) + this call's feeds
+        size_entries = []
+        for name in entry.state_mut + entry.state_const:
+            v = scope.get_var(name)
+            if hasattr(v, "shape") and hasattr(v, "dtype"):
+                size_entries.append(
+                    (name, tuple(int(s) for s in v.shape), str(v.dtype),
+                     "state"))
+        for name, shape, dt in spec:
+            size_entries.append((name, tuple(shape), dt, "feed"))
+        device = entry.jit_device
+        if device is None and mesh is not None:
+            device = mesh.devices.flat[0]
+
+        rec = xla_stats.on_compile(
+            compiled, fingerprint=program.fingerprint(), seconds=seconds,
+            size_entries=size_entries,
+            plan=getattr(program, "_tp_plan", None), mesh=mesh,
+            n_steps=n_steps, program_flops=entry.flops_per_step,
+            device=device)
+        if rec.get("xla_flops_per_step"):
+            # MFU honesty: the hand-rolled IR count misprices fused ops
+            # (mfu_flops_mismatch counted in on_compile) — XLA's own
+            # per-chip number feeds the StepTimer from here on
+            entry.flops_per_step = float(rec["xla_flops_per_step"])
+
+        orig_fn = entry.fn
+
+        def run_compiled(feed_vals, mut_vals, const_vals, rng):
+            try:
+                return compiled(feed_vals, mut_vals, const_vals, rng)
+            except (TypeError, ValueError):
+                # an input aval/sharding drifted from the AOT signature
+                # (e.g. state restored from a checkpoint with another
+                # layout): the lazy jit path re-specializes, an AOT
+                # executable cannot — fall back permanently
+                stat_add("xla_aot_fallbacks")
+                entry.fn = orig_fn
+                return orig_fn(feed_vals, mut_vals, const_vals, rng)
+
+        entry.fn = run_compiled
 
     # ------------------------------------------------------------------
     def _apply_graph_passes(self, program, fetch_names, feed, scope):
@@ -1417,8 +1521,9 @@ class Executor:
                 p_out, fetch_names, pipe["loss_name"],
                 pipe["params_grads"], pipe["num_microbatches"],
                 pipe["bwd_end"], plan)
+            pipe_jfn = jax.jit(fn, donate_argnums=(1,))
             return _Compiled(
-                fn=jax.jit(fn, donate_argnums=(1,)),
+                fn=pipe_jfn,
                 feed_names=feed_names,
                 state_mut=p_mut,
                 state_const=p_const,
@@ -1428,6 +1533,7 @@ class Executor:
                 pipeline_pack=plan,
                 flops_per_step=flops_per_step,
                 allreduce_bytes=allreduce_bytes,
+                jit_fn=pipe_jfn,
             )
 
         globalize = None
@@ -1467,6 +1573,7 @@ class Executor:
                 state_out, fetch_names, trace_block, multi_step=multi_step,
                 scan_steps=scan_steps)
 
+        jit_device = None
         if tp_plan is None:
             # jit traces lazily on first call; donating the mutable
             # state gives in-place parameter-update memory behavior
@@ -1475,11 +1582,15 @@ class Executor:
             device = self.place.jax_device()
 
             if mesh is None:
+                jit_device = device
+
                 def run_on_device(feed_vals, mut_vals, const_vals, rng):
                     with jax.default_device(device):
                         return jfn(feed_vals, mut_vals, const_vals, rng)
             else:
                 run_on_device = jfn  # placement is the mesh's job
+        else:
+            jfn = run_on_device  # _build_gspmd_fn returned the jit callable
 
         compiled = _Compiled(
             fn=run_on_device,
@@ -1496,6 +1607,8 @@ class Executor:
             nan_scan=nan_scan,
             flops_per_step=flops_per_step,
             allreduce_bytes=allreduce_bytes,
+            jit_fn=jfn,
+            jit_device=jit_device,
         )
         return compiled
 

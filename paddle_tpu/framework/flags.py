@@ -179,6 +179,32 @@ define_flag("heartbeat_interval_s", 10.0,
             "each rank's HealthReporter heartbeat PUT to the fleet KV "
             "HTTP server; a rank is reported dead on /metrics/cluster "
             "after 3 missed intervals")
+define_flag("xla_introspect", True,
+            "XLA compile introspection (paddle_tpu.observe.xla_stats): "
+            "every Executor compile is AOT-lowered so its wall time "
+            "(compile_seconds histogram), executable size, and per-chip "
+            "HBM footprint (compiled.memory_analysis) are recorded "
+            "BEFORE the first dispatch — the footprint feeds the "
+            "FLAGS_hbm_budget_fraction gate.  Capability-guarded: a jax "
+            "without AOT stages falls back to the lazy first-call "
+            "compile with the telemetry skipped")
+define_flag("hbm_budget_fraction", 0.0,
+            "pre-dispatch memory budget gate: when > 0, a program whose "
+            "predicted per-chip HBM footprint (from "
+            "compiled.memory_analysis after lowering) exceeds this "
+            "fraction of the device's memory is rejected with a "
+            "MemoryBudgetError naming the largest vars and their "
+            "sharding specs — a readable report instead of an opaque "
+            "RESOURCE_EXHAUSTED mid-step.  0 = gate disabled")
+define_flag("hbm_bytes_per_device", 0,
+            "explicit per-device HBM capacity in bytes for the budget "
+            "gate; 0 = probe device.memory_stats()['bytes_limit'] "
+            "(unavailable on the CPU backend, where the gate then "
+            "capability-skips unless this override is set)")
+define_flag("hlo_dump_dir", "",
+            "save each compile's optimized HLO module text under this "
+            "directory (hlo_<fingerprint>_<n>.txt) beside the "
+            "postmortem bundles; empty = disabled")
 define_flag("compile_cache_dir", "",
             "persistent XLA compilation cache directory (sets jax's "
             "jax_compilation_cache_dir through framework/jax_compat.py "

@@ -65,6 +65,86 @@ def update_config(name, value) -> bool:
     return True
 
 
+# ---------------------------------------------------------------------------
+# AOT-compiled-executable introspection (observe/xla_stats.py).  All four
+# accessors are capability guards over jax's AOT stages API: the shapes
+# of compiled.memory_analysis()/cost_analysis()/runtime_executable()
+# vary across jax versions (and some builds lack them outright), so the
+# introspection layer reads through here and treats None/0 as "this jax
+# can't say" — never as an error.
+# ---------------------------------------------------------------------------
+
+
+def compiled_memory_stats(compiled):
+    """``compiled.memory_analysis()`` (the per-module XLA memory stats
+    object with ``argument/output/temp/alias/generated_code
+    _size_in_bytes`` attributes) or None when this jax/backend does not
+    expose it.  Under SPMD partitioning the module is the PER-DEVICE
+    partitioned program, so the sizes are per-chip."""
+    fn = getattr(compiled, "memory_analysis", None)
+    if fn is None:
+        return None
+    try:
+        return fn()
+    except Exception:  # noqa: BLE001 - introspection must never fail a run
+        return None
+
+
+def compiled_cost_analysis(compiled):
+    """``compiled.cost_analysis()`` flattened to one plain dict (older
+    jax returns a one-element list of mappings), or None."""
+    fn = getattr(compiled, "cost_analysis", None)
+    if fn is None:
+        return None
+    try:
+        c = fn()
+    except Exception:  # noqa: BLE001
+        return None
+    if isinstance(c, (list, tuple)):
+        c = c[0] if c else None
+    if c is None:
+        return None
+    try:
+        return dict(c)
+    except (TypeError, ValueError):
+        return None
+
+
+def executable_code_bytes(compiled) -> int:
+    """Size of the generated machine code, via the loaded executable;
+    0 when the backend does not report it (the CPU backend)."""
+    try:
+        return int(
+            compiled.runtime_executable().size_of_generated_code_in_bytes())
+    except Exception:  # noqa: BLE001
+        return 0
+
+
+def compiled_text(compiled):
+    """Optimized HLO module text (``compiled.as_text()``) or None."""
+    try:
+        t = compiled.as_text()
+    except Exception:  # noqa: BLE001
+        return None
+    return t if isinstance(t, str) else None
+
+
+def device_memory_stats(device=None):
+    """``device.memory_stats()`` as a plain dict (TPU/GPU report
+    ``bytes_in_use``/``bytes_limit``/``peak_bytes_in_use``; the CPU
+    backend returns None) — None when unavailable.  ``device`` defaults
+    to the first local device."""
+    try:
+        if device is None:
+            device = jax.local_devices()[0]
+        ms = device.memory_stats()
+    except Exception:  # noqa: BLE001 - a dead device must not raise here
+        return None
+    if not ms:
+        return None
+    return dict(ms)
+
+
 def axis_size(axis_name):
     """``lax.axis_size`` (newer jax); older jax constant-folds
     ``psum(1, axis)`` to the same static int inside shard_map."""

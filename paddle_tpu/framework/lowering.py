@@ -52,11 +52,17 @@ def apply_tp_constraints(env, op, mesh):
 
     from .passes import TP_CONSTRAINT_ATTR, decode_spec
 
+    from ..monitor import stat_add
+
     for ent in op.attr(TP_CONSTRAINT_ATTR, []) or []:
         name, _, enc = ent.partition("\t")
         v = env.get(name)
         spec = decode_spec(enc)
         if v is None or getattr(v, "ndim", None) != len(spec):
+            # visible on /metrics: a program rewrite that silently
+            # dropped an anchor shows up as a skip count, not as an
+            # unexplained mp-collective placement regression
+            stat_add("tp_constraint_skipped")
             continue
         env[name] = jax.lax.with_sharding_constraint(
             v, NamedSharding(mesh, PartitionSpec(*spec)))

@@ -185,6 +185,29 @@ class TPShardingPlan:
 
         return NamedSharding(mesh, self.partition_spec(name))
 
+    def shard_divisor(self, name: str, mesh=None) -> int:
+        """How many chips one copy of ``name`` is split over: the
+        product of the mesh-axis sizes in its spec (1 for replicated or
+        unknown vars).  The HBM-attribution join
+        (observe/xla_stats.py): per-chip bytes = global bytes / this."""
+        n = 1
+        for ax in self.specs.get(name, ()):
+            if ax is None:
+                continue
+            for a in (ax if isinstance(ax, (tuple, list)) else (ax,)):
+                if mesh is not None and a in mesh.axis_names:
+                    n *= int(mesh.shape[a])
+        return max(n, 1)
+
+    def spec_str(self, name: str) -> str:
+        """Human-readable spec for error messages / attribution tables:
+        ``P(None, 'mp')`` for sharded vars, ``replicated`` otherwise."""
+        spec = self.specs.get(name, ())
+        if not spec or all(ax is None for ax in spec):
+            return "replicated"
+        return "P(" + ", ".join(
+            "None" if ax is None else repr(ax) for ax in spec) + ")"
+
     def __repr__(self):
         return (f"TPShardingPlan(mp={self.mp_degree}, "
                 f"sharded={self.n_sharded}, fallback={self.n_fallback})")

@@ -26,8 +26,15 @@ This package is the middle:
   snapshot, flight tail, flags), crash/atexit hooks, and cluster-wide
   health telemetry (per-rank heartbeats over the fleet KV server +
   the aggregated ``/metrics/cluster`` route on rank 0).
+- ``xla_stats``  — XLA introspection: per-compile wall time
+  (``compile_seconds``), executable size, per-chip HBM footprint from
+  ``compiled.memory_analysis()`` joined with the tensor-parallel
+  sharding plan into a per-var attribution table, live
+  ``device.memory_stats()`` on the heartbeat, and the pre-dispatch
+  memory budget gate (``FLAGS_hbm_budget_fraction`` →
+  :class:`~.xla_stats.MemoryBudgetError` before dispatch).
 """
-from . import flight, health
+from . import flight, health, xla_stats
 from .flight import FlightRecorder, get_flight_recorder
 from .health import (HealthReporter, StallWatchdog, cluster_health,
                      dump_postmortem, executor_progress,
@@ -37,6 +44,9 @@ from .histogram import (Histogram, HistogramRegistry, export_histograms,
                         histogram, prometheus_text, stat_time)
 from .step_stats import (StepTimer, mfu_estimate, reset_step_stats,
                          step_timer)
+from .xla_stats import (MemoryBudgetError, check_hbm_budget,
+                        device_memory_stats, memory_breakdown,
+                        memory_report, var_attribution)
 from .tracer import (SpanRecord, Tracer, begin, clear, disable, enable,
                      enabled, end, get_tracer, set_span_args, snapshot,
                      span)
@@ -59,4 +69,8 @@ __all__ = [
     "health", "StallWatchdog", "HealthReporter", "executor_progress",
     "dump_postmortem", "start_watchdog", "stop_watchdog",
     "install_crash_handler", "cluster_health", "serve_cluster_health",
+    # XLA introspection
+    "xla_stats", "MemoryBudgetError", "memory_breakdown",
+    "var_attribution", "check_hbm_budget", "device_memory_stats",
+    "memory_report",
 ]

@@ -56,7 +56,7 @@ __all__ = ["StallWatchdog", "HealthReporter", "executor_progress",
 HEALTH_KEY_PREFIX = "health/rank/"
 
 _BUNDLE_FILES = ("meta.json", "stacks.txt", "trace.json", "metrics.prom",
-                 "flight.jsonl", "flags.json")
+                 "flight.jsonl", "flags.json", "memory.json")
 
 
 # ---------------------------------------------------------------------------
@@ -169,6 +169,9 @@ def dump_postmortem(reason: str, directory: Optional[str] = None,
     - ``metrics.prom`` Prometheus text exposition snapshot
     - ``flight.jsonl`` flight-recorder tail
     - ``flags.json``   FLAGS snapshot
+    - ``memory.json``  XLA compile records (per-chip HBM footprint
+      breakdown + per-var attribution + budget verdicts) and a live
+      per-device memory sample (observe/xla_stats.py)
     """
     directory = directory or _flags.flag("postmortem_dir") or "postmortem"
     safe = re.sub(r"[^A-Za-z0-9_.-]", "_", str(reason))[:48] or "unknown"
@@ -217,11 +220,18 @@ def dump_postmortem(reason: str, directory: Optional[str] = None,
             json.dump(_flags.flags_snapshot(), f, indent=2, sort_keys=True,
                       default=repr)
 
+    def _memory_json(p):
+        from . import xla_stats
+
+        with open(p, "w") as f:
+            json.dump(xla_stats.memory_report(), f, indent=2, default=repr)
+
     section("stacks.txt", _stacks)
     section("trace.json", _trace)
     section("metrics.prom", _metrics)
     section("flight.jsonl", _flight_tail)
     section("flags.json", _flags_json)
+    section("memory.json", _memory_json)
 
     meta = {
         "reason": str(reason),
@@ -521,6 +531,16 @@ def _default_rank_stats() -> Dict:
     if h.count:
         out["step_time_p50_s"] = round(h.percentile(50), 6)
         out["steps_timed"] = h.count
+    try:
+        # live per-chip HBM sample (observe/xla_stats.py): sets the
+        # hbm_free/used/limit gauges on /metrics and rides the heartbeat
+        # onto /metrics/cluster; {} where the backend has no memory
+        # stats (CPU) — the heartbeat itself must never die on a probe
+        from . import xla_stats
+
+        out.update(xla_stats.record_device_memory())
+    except Exception:  # noqa: BLE001
+        pass
     return out
 
 
@@ -670,6 +690,15 @@ def cluster_health(kv: Dict, world_size: Optional[int] = None,
         out["straggler_rank"] = max(p50s, key=p50s.get)
     else:
         out["step_time_skew"] = 0.0
+    # HBM headroom across the fleet (heartbeat fields fed by
+    # xla_stats.record_device_memory): the MIN free — the rank that
+    # OOMs first — is the number the budget gate and the sharding
+    # planner care about
+    frees = {r: int(ranks[r]["hbm_free_bytes"]) for r in alive
+             if ranks[r].get("hbm_free_bytes") is not None}
+    if frees:
+        out["min_hbm_free_bytes"] = min(frees.values())
+        out["min_hbm_free_rank"] = min(frees, key=frees.get)
 
     from ..monitor import stat_set
 
@@ -680,6 +709,8 @@ def cluster_health(kv: Dict, world_size: Optional[int] = None,
              int(out["step_time_skew"] * 1e6))
     stat_set("cluster_max_heartbeat_age_ms",
              int(out["max_heartbeat_age_s"] * 1e3))
+    if "min_hbm_free_bytes" in out:
+        stat_set("cluster_min_hbm_free_bytes", out["min_hbm_free_bytes"])
     return out
 
 

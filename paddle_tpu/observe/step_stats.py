@@ -126,6 +126,18 @@ class StepTimer:
             "execute_time_s": round(et, 6),
             "step_time_s": histogram(self._hist_name).summary(),
         }
+        # XLA introspection (observe/xla_stats.py): the AOT-measured
+        # trace+compile wall times and the newest executable's size —
+        # compile_time_s above is the first-CALL wall split, this is
+        # the compiler's own bill (ROADMAP item 5's acceptance metric)
+        ch = histogram("compile_seconds")
+        if ch.count:
+            out["xla_compile_seconds"] = ch.summary()
+        from ..monitor import stat_get
+
+        size = stat_get("executable_size_bytes")
+        if size:
+            out["executable_size_bytes"] = size
         if et > 0.0 and steps:
             out["steps_per_sec"] = round(steps / et, 3)
             if examples:

@@ -89,6 +89,70 @@ def rng():
 
 
 # ---------------------------------------------------------------------------
+# Shared jax capability probes (dedupe of the per-file version guards:
+# config entries, AOT stages, and compiled-executable introspection all
+# come and go across jax versions).  Module-level skips import this
+# directly — `from conftest import jax_capability` — which works under
+# pytest's default rootdir import mode (same mechanism as op_test).
+# ---------------------------------------------------------------------------
+
+_CAPABILITY_CACHE = {}
+
+
+def _probe_compiled():
+    """One tiny AOT lower+compile, cached: the probe object every
+    compiled-introspection capability reads."""
+    if "_compiled" not in _CAPABILITY_CACHE:
+        try:
+            compiled = jax.jit(lambda x: x + 1).lower(
+                np.ones((2,), "float32")).compile()
+        except Exception:  # noqa: BLE001 - no AOT stages on this jax
+            compiled = None
+        _CAPABILITY_CACHE["_compiled"] = compiled
+    return _CAPABILITY_CACHE["_compiled"]
+
+
+def jax_capability(name: str) -> bool:
+    """Does the installed jax support <name>?  Probes:
+
+    - ``cpu_collectives``: cross-process CPU collectives config
+      (``jax_cpu_collectives_implementation``) — the localhost fleet
+      federation tests need it.
+    - ``aot_stages``: ``jit(f).lower(...).compile()`` works.
+    - ``memory_analysis`` / ``cost_analysis``: AOT-compiled executables
+      expose per-module memory/cost introspection
+      (observe/xla_stats.py capability-skips without them).
+    """
+    if name not in _CAPABILITY_CACHE:
+        from paddle_tpu.framework import jax_compat
+
+        if name == "cpu_collectives":
+            ok = jax_compat.has_config("jax_cpu_collectives_implementation")
+        elif name == "aot_stages":
+            ok = _probe_compiled() is not None
+        elif name == "memory_analysis":
+            c = _probe_compiled()
+            ok = c is not None and \
+                jax_compat.compiled_memory_stats(c) is not None
+        elif name == "cost_analysis":
+            c = _probe_compiled()
+            ok = c is not None and \
+                jax_compat.compiled_cost_analysis(c) is not None
+        else:
+            raise KeyError(f"unknown jax capability probe {name!r}")
+        _CAPABILITY_CACHE[name] = ok
+    return _CAPABILITY_CACHE[name]
+
+
+@pytest.fixture
+def require_memory_analysis():
+    """Skip (don't fail) on jax builds whose AOT compiled objects lack
+    ``memory_analysis()`` — the HBM-accounting capability."""
+    if not jax_capability("memory_analysis"):
+        pytest.skip("installed jax exposes no compiled.memory_analysis()")
+
+
+# ---------------------------------------------------------------------------
 # Shared mesh fixtures (the XLA_FLAGS 8-virtual-device setup above is THE
 # one copy; test files must not re-set it, and mesh construction for tp/dp
 # tests lives here instead of per-file duplicates).

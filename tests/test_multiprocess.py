@@ -26,16 +26,17 @@ from paddle_tpu.framework.program import Program, program_guard
 
 TRAINER = os.path.join(os.path.dirname(__file__), "dist_trainer.py")
 
-# capability probe (framework/jax_compat.py): jax versions without the
+# capability probe (tests/conftest.py jax_capability, backed by
+# framework/jax_compat.py): jax versions without the
 # jax_cpu_collectives_implementation config have NO cross-process CPU
 # collectives — the XLA CPU client rejects multiprocess computations
 # outright ("Multiprocess computations aren't implemented on the CPU
 # backend"), so the localhost federation these tests ride cannot exist.
 # Before the guarded accessor this surfaced as an AttributeError inside
 # init_parallel_env; now it is an explicit environment skip.
-from paddle_tpu.framework.jax_compat import has_config  # noqa: E402
+from conftest import jax_capability  # noqa: E402
 
-if not has_config("jax_cpu_collectives_implementation"):
+if not jax_capability("cpu_collectives"):
     pytest.skip(
         "installed jax has no CPU cross-process collectives backend "
         "(jax_cpu_collectives_implementation config absent)",

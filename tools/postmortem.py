@@ -24,7 +24,14 @@ import sys
 from typing import List, Optional
 
 BUNDLE_FILES = ("meta.json", "stacks.txt", "trace.json", "metrics.prom",
-                "flight.jsonl", "flags.json")
+                "flight.jsonl", "flags.json", "memory.json")
+
+
+def _mb(nbytes) -> float:
+    try:
+        return round(int(nbytes) / 2 ** 20, 2)
+    except (TypeError, ValueError):
+        return 0.0
 
 
 def _is_bundle(path: str) -> bool:
@@ -132,6 +139,54 @@ def render(bundle: str, tail: int = 15, stacks: bool = False,
           f"(dropped {tr.get('otherData', {}).get('dropped_spans', 0)}); "
           f"load trace.json in Perfetto/chrome://tracing\n")
 
+    # -- XLA memory accounting (observe/xla_stats.py) ----------------------
+    mem = _read_json(os.path.join(bundle, "memory.json"))
+    if mem is not None:
+        comps = mem.get("compiles") or []
+        w(f"\nxla compiles recorded: {len(comps)}\n")
+        if comps:
+            c = comps[-1]
+            w(f"  last: fingerprint {c.get('fingerprint', '?')}  "
+              f"compile {c.get('compile_seconds', '?')}s  "
+              f"executable {_mb(c.get('executable_size_bytes'))} MB\n")
+            br = c.get("memory") or {}
+            if br:
+                w(f"  per-chip footprint: {_mb(br.get('total_bytes'))} MB "
+                  f"(args {_mb(br.get('arguments_bytes'))}"
+                  f" + outputs {_mb(br.get('outputs_bytes'))}"
+                  f" + temps {_mb(br.get('temporaries_bytes'))}"
+                  f" + code {_mb(br.get('generated_code_bytes'))}"
+                  f" - aliased {_mb(br.get('aliased_bytes'))})\n")
+            bud = c.get("budget") or {}
+            if bud.get("verdict"):
+                w(f"  budget gate: {bud['verdict']}")
+                if "budget_bytes" in bud:
+                    w(f"  (required {_mb(bud.get('required_bytes'))} MB"
+                      f" vs budget {_mb(bud.get('budget_bytes'))} MB)")
+                w("\n")
+            rows = c.get("attribution") or []
+            if rows:
+                width = max(len(str(r.get("name", "?"))) for r in rows)
+                w(f"  top vars ({len(rows)}):\n")
+                w(f"    {'var':<{width}}  {'per-chip MB':>12}  "
+                  f"{'global MB':>10}  {'kind':<5}  spec\n")
+                for r in rows:
+                    w(f"    {str(r.get('name', '?')):<{width}}  "
+                      f"{_mb(r.get('per_chip_bytes')):>12}  "
+                      f"{_mb(r.get('global_bytes')):>10}  "
+                      f"{str(r.get('kind', '?')):<5}  "
+                      f"{r.get('spec', '?')}\n")
+        for d in (mem.get("device_memory") or []):
+            w(f"  device {d.get('device', '?')}: "
+              f"{_mb(d.get('bytes_in_use'))} MB in use of "
+              f"{_mb(d.get('bytes_limit'))} MB\n")
+        g = mem.get("hbm_gauges") or {}
+        if any(g.values()):
+            w(f"  hbm gauges (last heartbeat sample): "
+              f"free {_mb(g.get('hbm_free_bytes'))} MB, "
+              f"used {_mb(g.get('hbm_used_bytes'))} MB, "
+              f"limit {_mb(g.get('hbm_limit_bytes'))} MB\n")
+
     # -- metrics -----------------------------------------------------------
     mt = _read_text(os.path.join(bundle, "metrics.prom"))
     if mt is not None:
@@ -142,7 +197,8 @@ def render(bundle: str, tail: int = 15, stacks: bool = False,
           f"{'' if metrics else ', --metrics for all'}):\n")
         keys = ("executor_steps_", "executor_inflight", "watchdog_",
                 "postmortem_", "cluster_", "ckpt_saves", "ckpt_save_f",
-                "health_")
+                "health_", "hbm_", "executable_size", "mfu_flops",
+                "compile_seconds_count")
         for ln in rows:
             if metrics or any(k in ln for k in keys):
                 w(f"  {ln}\n")
