@@ -444,6 +444,127 @@ def _fallback_reduced_run(result):
     return result
 
 
+# transformer-depth flagship (scan-over-layers acceptance): dims are
+# deliberately tiny — the quantity under test is trace+compile scaling
+# with DEPTH, not step throughput, and the deep unrolled compile is the
+# expensive half of the A-B
+DEPTH_SHALLOW = 8
+DEPTH_DEEP = 48
+DEPTH_BATCH = 4
+DEPTH_SEQ = 16
+DEPTH_VOCAB = 128
+DEPTH_HIDDEN = 32
+DEPTH_HEADS = 2
+DEPTH_FFN = 64
+DEPTH_PREDS = 2
+
+
+def bench_transformer_depth(pt, jax):
+    """Scan-over-layers acceptance flagship (ROADMAP item 5): compile
+    an 8- and a 48-layer transformer with FLAGS_layer_scan off and on
+    (A-B in one round) and report what XLA actually built — compile
+    wall seconds (the compile_seconds histogram the Executor feeds),
+    executable size, and optimized-HLO op count.
+    ``compile_speedup_vs_unrolled`` (48-layer unrolled/scan) is THE
+    acceptance number (>=5x); ``transformer48_executable_hlo_ops``
+    staying ~equal to the 8-layer count is the superlinear-shrink
+    evidence.  Loss parity between the four runs is reported, never
+    assumed."""
+    from paddle_tpu import observe
+    from paddle_tpu.framework import unique_name
+    from paddle_tpu.framework.place import _default_place
+    from paddle_tpu.framework.program import program_guard
+    from paddle_tpu.monitor import stat_get, stat_set
+    from paddle_tpu.text import bert_base_pretrain_program
+
+    B, S, V, P = DEPTH_BATCH, DEPTH_SEQ, DEPTH_VOCAB, DEPTH_PREDS
+
+    def build(n_layers):
+        with unique_name.guard():
+            main_p, startup, _, loss, opt = bert_base_pretrain_program(
+                batch_size=B, seq_len=S, vocab_size=V,
+                hidden=DEPTH_HIDDEN, n_layers=n_layers,
+                n_heads=DEPTH_HEADS, ffn_size=DEPTH_FFN,
+                max_preds_per_seq=P)
+            main_p.random_seed = 1
+            with program_guard(main_p, startup):
+                opt.minimize(loss)
+        return main_p, startup, loss
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, V, (B, S)).astype("int64")
+    flat_pos = np.concatenate(
+        [b * S + rng.choice(S, P, replace=False) for b in range(B)]
+    ).astype("int64")
+    labels = ids.reshape(-1)[flat_pos].reshape(-1, 1).astype("int64")
+    feed = {
+        "input_ids": ids,
+        "token_type_ids": np.zeros((B, S), "int64"),
+        "pos_ids": np.tile(np.arange(S, dtype="int64"), (B, 1)),
+        "input_mask": np.zeros((B, 1, 1, S), "float32"),
+        "masked_flat_pos": flat_pos,
+        "masked_labels": labels,
+        "masked_weights": np.ones((B * P, 1), "float32"),
+        "nsp_labels": rng.randint(0, 2, (B, 1)).astype("int64"),
+    }
+
+    def compile_once(n_layers, scan):
+        pt.set_flags({"FLAGS_layer_scan": scan})
+        main_p, startup, loss = build(n_layers)
+        exe = pt.Executor(_default_place())
+        scope = pt.framework.Scope()
+        exe.run(startup, scope=scope)
+        # reset AFTER startup so the histogram holds only the train
+        # step's trace+compile
+        observe.histogram("compile_seconds").reset()
+        stat_set("executable_size_bytes", 0)
+        stat_set("executable_hlo_ops", 0)
+        stat_set("pass_layer_scan_segments", 0)
+        out = exe.run(main_p, feed=feed, fetch_list=[loss], scope=scope)
+        loss_v = float(np.asarray(out[0]).item())
+        ch = observe.histogram("compile_seconds").summary()
+        rec = {
+            "compile_seconds": round(float(ch.get("sum") or 0.0), 3),
+            "executable_size_bytes": int(
+                stat_get("executable_size_bytes") or 0),
+            "executable_hlo_ops": int(stat_get("executable_hlo_ops") or 0),
+            "segments": int(stat_get("pass_layer_scan_segments") or 0),
+            "loss": loss_v,
+        }
+        exe.close()
+        return rec
+
+    try:
+        res = {(d, sc): compile_once(d, sc)
+               for d in (DEPTH_SHALLOW, DEPTH_DEEP)
+               for sc in (False, True)}
+    finally:
+        pt.set_flags({"FLAGS_layer_scan": False})
+
+    deep_off = res[(DEPTH_DEEP, False)]
+    deep_on = res[(DEPTH_DEEP, True)]
+    shallow_on = res[(DEPTH_SHALLOW, True)]
+    out = {
+        "transformer8_compile_seconds": shallow_on["compile_seconds"],
+        "transformer48_compile_seconds": deep_on["compile_seconds"],
+        "transformer48_compile_seconds_unrolled":
+            deep_off["compile_seconds"],
+        "transformer48_executable_size_bytes":
+            deep_on["executable_size_bytes"],
+        "transformer48_executable_hlo_ops": deep_on["executable_hlo_ops"],
+        "transformer48_executable_hlo_ops_unrolled":
+            deep_off["executable_hlo_ops"],
+        "transformer48_layer_scan_segments": deep_on["segments"],
+        "transformer_depth_loss_parity": bool(
+            deep_on["loss"] == deep_off["loss"]
+            and shallow_on["loss"] == res[(DEPTH_SHALLOW, False)]["loss"]),
+    }
+    if deep_on["compile_seconds"] > 0:
+        out["compile_speedup_vs_unrolled"] = round(
+            deep_off["compile_seconds"] / deep_on["compile_seconds"], 2)
+    return out
+
+
 SERVE_CLIENTS = 32
 SERVE_REQS = 256
 SERVE_FEAT = 64
@@ -777,6 +898,13 @@ def main():
         result.update(pipe_extras)
     except Exception as e:
         errors["resnet50_pipeline"] = f"{type(e).__name__}: {e}"[:500]
+    try:
+        # scan-over-layers A-B (compile-time flagship; ROADMAP item 5
+        # acceptance: compile_speedup_vs_unrolled >= 5 at depth 48)
+        reset_flagship_telemetry()
+        result.update(bench_transformer_depth(pt, jax))
+    except Exception as e:
+        errors["transformer_depth"] = f"{type(e).__name__}: {e}"[:500]
     try:
         serve = bench_serving(pt, jax)
     except Exception as e:

@@ -82,10 +82,50 @@ def _assemble_blocks(blocks, ndim):
     return stitch([], 0), tuple(s[0] for s in per_dim)
 
 
-def _host_value(v):
-    """One scope value -> np.ndarray | LocalShard | None (skip)."""
+def _host_value(v, _stack_cache=None):
+    """One scope value -> np.ndarray | LocalShard | None (skip).
+
+    ``_stack_cache``: per-snapshot {carrier name: gathered host array}
+    so the members of one layer stack share a single cross-process
+    gather instead of paying it once per layer."""
     if v is None:
         return None
+    # layer-scan per-layer view (framework/scope.py StackedParamRef):
+    # resolve the stacked carrier through the SAME machinery so a
+    # multi-process global carrier takes the gather path, then slice
+    # the layer out host-side.  A carrier this process cannot assemble
+    # in full must fail LOUDLY — np.asarray(view) on a non-addressable
+    # global array raises, and the generic except below would silently
+    # drop the parameter from the checkpoint.
+    from ..framework.scope import StackedParamRef
+
+    if isinstance(v, StackedParamRef):
+        buf = v._scope.get_var(v.stack_name)
+        if (hasattr(buf, "sharding")
+                and not getattr(buf, "is_fully_addressable", True)):
+            carrier = (_stack_cache.get(v.stack_name)
+                       if _stack_cache is not None else None)
+            if carrier is None:
+                carrier = _host_value(buf)
+                if not isinstance(carrier, np.ndarray):
+                    from .manager import CheckpointError
+
+                    raise CheckpointError(
+                        f"layer stack {v.stack_name!r} is not "
+                        f"host-assemblable in this process (got "
+                        f"{type(carrier).__name__}); cannot checkpoint "
+                        f"its per-layer view [{v.index}]")
+                if _stack_cache is not None:
+                    _stack_cache[v.stack_name] = carrier
+            arr = carrier[v.index].reshape(v.shape)
+            if arr.dtype != v.dtype:
+                arr = (arr.view(v.dtype)
+                       if arr.itemsize == v.dtype.itemsize
+                       else arr.astype(v.dtype))
+            return arr
+        # fully addressable: the view's __array__ transfers just the
+        # layer's device slice
+        return np.asarray(v)
     # jax array (duck-typed; see executor._is_jax_array)
     if hasattr(v, "sharding") and hasattr(v, "dtype"):
         if getattr(v, "is_fully_addressable", True):
@@ -137,10 +177,21 @@ def snapshot_scope(scope, var_names: Optional[Sequence[str]] = None
     except ImportError:  # pragma: no cover - partial installs
         pass
     if var_names is None:
-        var_names = [n for n in scope.local_var_names()]
+        # layer-scan stacked carriers (@LAYER_STACK@...) are a runtime
+        # layout artifact: their bytes are exactly the per-layer
+        # StackedParamRef views saved below, so writing both would
+        # double the checkpoint AND pin it to the scan flag.  Per-layer
+        # entries keep resume elastic: a restore writes concrete
+        # per-layer arrays and the next scanned run re-packs them.
+        from ..framework.passes import LAYER_STACK_PREFIX
+
+        var_names = [n for n in scope.local_var_names()
+                     if not n.startswith(LAYER_STACK_PREFIX)]
     out: Dict[str, object] = {}
+    stack_cache: Dict[str, np.ndarray] = {}
     for n in var_names:
-        hv = _host_value(scope.get_var(n) if scope.has_var(n) else None)
+        hv = _host_value(scope.get_var(n) if scope.has_var(n) else None,
+                         _stack_cache=stack_cache)
         if hv is not None:
             out[n] = hv
     return out

@@ -103,7 +103,6 @@ class DistributedStrategy:
     tensor_parallel = _bool_prop("tensor_parallel")
     sequence_parallel = _bool_prop("sequence_parallel")
 
-    recompute_configs = _config_prop("recompute_configs")
     amp_configs = _config_prop("amp_configs")
     localsgd_configs = _config_prop("localsgd_configs")
     gradient_merge_configs = _config_prop("gradient_merge_configs")
@@ -113,6 +112,37 @@ class DistributedStrategy:
     pipeline_configs = _config_prop("pipeline_configs")
     sharding_configs = _config_prop("sharding_configs")
     a_sync_configs = _config_prop("a_sync_configs")
+
+    # extra recompute config keys the proto cannot hold (the
+    # RecomputeConfig message carries only the checkpoint list):
+    # "policy" — XLA remat policy name wrapped around scanned layer
+    # blocks ('nothing_saveable' / 'dots_saveable' / 'save_anything');
+    # "scan_layers" — min isomorphic repeat count that turns the
+    # LayerScanPass on for this program (0 = follow FLAGS_layer_scan).
+    # Python-side only: they do NOT survive serialize_to_string, but DO
+    # survive program clone/proto round-trips once the
+    # RecomputeMetaOptimizer stamps them onto the optimizer ops.
+    _RC_EXTRA_KEYS = ("policy", "scan_layers")
+
+    @property
+    def recompute_configs(self):
+        out = _config_to_dict(self._proto.recompute_configs)
+        out.update(getattr(self, "_rc_extra", {}))
+        return out
+
+    @recompute_configs.setter
+    def recompute_configs(self, configs):
+        extra = {}
+        proto_cfg = {}
+        for k, v in (configs or {}).items():
+            if k in self._RC_EXTRA_KEYS:
+                extra[k] = v
+            else:
+                proto_cfg[k] = v
+        _dict_to_config(self._proto.recompute_configs, proto_cfg)
+        if not hasattr(self, "_rc_extra"):
+            self._rc_extra = {}
+        self._rc_extra.update(extra)
 
     # extra tensor_parallel config keys the proto cannot hold (the
     # TensorParallelConfig message carries only degree + seed):

@@ -159,23 +159,62 @@ class RecomputeMetaOptimizer(MetaOptimizerBase):
     backward.py:689): user-marked checkpoint vars partition the forward;
     append_backward re-emits each segment behind a `recompute_barrier`
     (lax.optimization_barrier CSE fence) so XLA recomputes activations in
-    the backward instead of keeping them alive."""
+    the backward instead of keeping them alive.
+
+    Scan-over-layers extras (recompute_configs ``policy`` /
+    ``scan_layers``): stamped AFTER the inner minimize onto the
+    program's optimizer ops (``__layer_scan__`` /
+    ``__layer_scan_policy__`` — attrs, so the contract survives
+    clone/proto round-trips AND re-keys every executor cache via the
+    fingerprint).  They turn the executor-side LayerScanPass on for
+    this program and pick the ``jax.checkpoint`` remat policy its scan
+    bodies are wrapped in — extending the barrier-based recompute
+    support to XLA rematerialization choices per repeated block."""
 
     def _can_apply(self):
         return self.user_strategy.recompute
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):
-        ckpts = list(self.user_strategy.recompute_configs.get(
-            "checkpoints", []))
-        if not ckpts:
+        from ...framework.passes import (LAYER_SCAN_ATTR,
+                                         LAYER_SCAN_POLICY_ATTR)
+        from ...framework.jax_compat import REMAT_POLICIES
+
+        cfg = self.user_strategy.recompute_configs
+        ckpts = list(cfg.get("checkpoints", []))
+        policy = str(cfg.get("policy") or "")
+        scan_layers = int(cfg.get("scan_layers") or 0)
+        if policy and policy not in REMAT_POLICIES:
             raise ValueError(
-                "strategy.recompute=True needs "
-                "strategy.recompute_configs={'checkpoints': [var_names]}")
+                f"recompute_configs['policy'] must be one of "
+                f"{sorted(REMAT_POLICIES)}, got {policy!r}")
+        if not ckpts and not (policy or scan_layers):
+            raise ValueError(
+                "strategy.recompute=True needs recompute_configs with "
+                "'checkpoints': [var_names] (barrier-based recompute), "
+                "'scan_layers': N and/or 'policy': <remat policy> "
+                "(scan-over-layers), or both")
         prog = loss.block.program
-        prog._recompute_checkpoints = ckpts
-        return self.inner_opt.minimize(loss, startup_program, parameter_list,
-                                       no_grad_set)
+        if ckpts:
+            prog._recompute_checkpoints = ckpts
+        ret = self.inner_opt.minimize(loss, startup_program, parameter_list,
+                                      no_grad_set)
+        if policy or scan_layers:
+            stamped = False
+            for op in prog.global_block.ops:
+                if op.type in _OPTIMIZER_OP_TYPES:
+                    if scan_layers:
+                        op.attrs[LAYER_SCAN_ATTR] = scan_layers
+                    if policy:
+                        op.attrs[LAYER_SCAN_POLICY_ATTR] = policy
+                    stamped = True
+            if not stamped:
+                raise ValueError(
+                    "recompute_configs scan_layers/policy found no "
+                    "optimizer ops to stamp; minimize() must build the "
+                    "training program first")
+            prog._bump()
+        return ret
 
 
 class GradientMergeMetaOptimizer(MetaOptimizerBase):

@@ -39,7 +39,7 @@ from . import dtypes
 from .lowering import PSEUDO_OPS, LoweringContext, get_lowering
 from .place import CPUPlace, Place, _default_place
 from .program import Program, Variable, default_main_program
-from .scope import (PackedParamRef, Scope, global_scope,
+from .scope import (PackedParamRef, Scope, StackedParamRef, global_scope,
                     is_device_array as _is_device_array)
 
 logger = logging.getLogger(__name__)
@@ -557,7 +557,12 @@ def _collective_span_args(env, op, mesh=None):
 
 def _program_allreduce_bytes(block, op_list) -> int:
     """Static allreduce payload per step, from the post-pass op stream
-    (so fused buckets count once at their coalesced size)."""
+    (so fused buckets count once at their coalesced size).  A
+    LayerScanPass-stacked collective moves ``__layer_stack__`` x its
+    var's declared per-layer bytes — the stack axis is a runtime
+    artifact the var metadata does not carry."""
+    from .passes import LAYER_STACK_ATTR
+
     total = 0
     for op in op_list:
         if op.type not in _ALLREDUCE_OPS:
@@ -573,7 +578,7 @@ def _program_allreduce_bytes(block, op_list) -> int:
         n = 1
         for s in var.shape:
             n *= int(s)
-        total += n * itemsize
+        total += n * itemsize * max(int(op.attr(LAYER_STACK_ATTR, 0) or 0), 1)
     return total
 
 
@@ -931,6 +936,17 @@ class Executor:
             program = self._apply_graph_passes(program, fetch_names, feed,
                                                scope)
 
+        # scan-over-layers stacker (LayerScanPass): per-layer weight
+        # families ride the compiled step as ONE stacked carrier array
+        # each; the scope keeps serving per-layer names through
+        # StackedParamRef views.  Runs on EVERY compile path (single-
+        # device, shard_map dp, GSPMD tp, run_steps) BEFORE state
+        # analysis — the analysis reads the carrier names and must find
+        # them in the scope.  Steady state is a no-op per dispatch.
+        lplan = getattr(program, "_layer_plan", None)
+        if lplan is not None:
+            lplan.ensure_stacked(scope)
+
         ops = None
         if use_prune and fetch_names:
             pkey = (program.fingerprint(), fetch_names)
@@ -957,7 +973,8 @@ class Executor:
             self._analysis_cache[akey] = (state_in, state_out)
         def _svspec(n):
             v = scope.get_var(n)
-            if isinstance(v, PackedParamRef) or _is_jax_array(v):
+            if isinstance(v, (PackedParamRef, StackedParamRef)) \
+                    or _is_jax_array(v):
                 return (n, tuple(v.shape), str(v.dtype))
             return (n, tuple(np.shape(v)), str(np.asarray(v).dtype))
 
@@ -1015,9 +1032,19 @@ class Executor:
         if entry.pipeline_pack is not None:
             entry.pipeline_pack.ensure_packed(scope, mesh)
 
+        def _state_value(n):
+            # a per-layer member an unrolled edge op still reads
+            # individually (a trimmed layer-scan run) lives as a
+            # StackedParamRef view: hand jit the live device SLICE of
+            # its carrier, not the view object
+            v = scope.get_var(n)
+            if isinstance(v, StackedParamRef):
+                return v.device_value()
+            return v
+
         feed_vals = tuple(feed_arrays[n] for n in entry.feed_names)
-        mut_vals = tuple(scope.get_var(n) for n in entry.state_mut)
-        const_vals = tuple(scope.get_var(n) for n in entry.state_const)
+        mut_vals = tuple(_state_value(n) for n in entry.state_mut)
+        const_vals = tuple(_state_value(n) for n in entry.state_const)
         rng = scope.get_var(RNG_VAR)
 
         if entry.globalize is not None:
@@ -1238,22 +1265,35 @@ class Executor:
         if getattr(program, "_pipeline", None) is not None:
             return program  # the pipeline executor owns its own rewrite
         if not flags.flag("fuse_passes"):
-            # FLAGS_fuse_passes gates the OPTIMIZATION passes only; a
-            # tensor-parallel program still needs its sharding plan (the
-            # dp loss-grad scale was removed at transpile time, so
-            # running it un-sharded would be numerically wrong, not
-            # just slow) — apply the sharding pass alone
-            if not passes_mod.has_tp_marks(program):
+            # FLAGS_fuse_passes gates the OPTIMIZATION passes only.  Two
+            # passes answer to their own switches and still run: a
+            # tensor-parallel program needs its sharding plan (the dp
+            # loss-grad scale was removed at transpile time, so running
+            # it un-sharded would be numerically wrong, not just slow),
+            # and scan-over-layers was asked for explicitly via
+            # FLAGS_layer_scan / recompute_configs scan stamps — its
+            # own gate, not the fusion flag, decides it
+            reduced = []
+            if passes_mod.has_tp_marks(program):
+                reduced.append(passes_mod.ShardingPropagationPass())
+            if passes_mod.LayerScanPass._config(program)[0]:
+                reduced.append(passes_mod.LayerScanPass())
+            if not reduced:
                 return program
-            pipeline = passes_mod.PassPipeline(
-                [passes_mod.ShardingPropagationPass()])
+            pipeline = passes_mod.PassPipeline(reduced)
         else:
             pipeline = passes_mod.default_pipeline()
         from ..monitor import stat_add
 
         mesh = self._active_mesh()
+        # flags read at PASS time (FLAGS_layer_scan and friends decide
+        # whether/how programs are rewritten) must key the pass cache
+        # exactly like they key the compile cache — flipping the scan
+        # flag or the remat policy between runs must re-run the
+        # pipeline, not serve the stale rewrite
         key = (program.fingerprint(), pipeline.config_key(), fetch_names,
-               frozenset(feed), scope.serial, id(mesh))
+               frozenset(feed), scope.serial, id(mesh),
+               flags.lowering_key())
         cached = self._pass_cache.get(key)
         if cached is not None:
             stat_add("executor_pass_cache_hit")

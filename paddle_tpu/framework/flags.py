@@ -205,6 +205,41 @@ define_flag("hlo_dump_dir", "",
             "save each compile's optimized HLO module text under this "
             "directory (hlo_<fingerprint>_<n>.txt) beside the "
             "postmortem bundles; empty = disabled")
+define_flag("layer_scan", False,
+            "scan-over-layers compile-time optimization (framework/"
+            "passes.py LayerScanPass): detect maximal runs of isomorphic "
+            "repeated op segments (the forward/backward/optimizer "
+            "regions a repeated-layer model builder emits), stack their "
+            "per-layer weights on a leading num_layers axis, and lower "
+            "each run to ONE jax.lax.scan — trace+compile time and "
+            "executable size become ~constant in depth instead of "
+            "linear, with bitwise-identical step numerics.  Also "
+            "enabled per-program by DistributedStrategy."
+            "recompute_configs={'scan_layers': N}; non-matching "
+            "programs are left untouched (pass_layer_scan_skipped "
+            "counters name why)",
+            affects_lowering=True)
+define_flag("layer_scan_min_layers", 4,
+            "minimum isomorphic segment repeat count before "
+            "LayerScanPass rewrites a run (shorter runs gain nothing "
+            "and shallow nets keep their unrolled executables); "
+            "recompute_configs={'scan_layers': N} overrides per program",
+            affects_lowering=True)
+define_flag("layer_scan_policy", "",
+            "XLA rematerialization policy wrapped around the layer_scan "
+            "body via jax.checkpoint: '' (no wrap), 'nothing_saveable', "
+            "'dots_saveable', or 'save_anything' (= jax "
+            "everything_saveable) — extends the program-level "
+            "recompute_barrier support to XLA remat choices per scanned "
+            "block.  A jax without checkpoint_policies degrades to "
+            "plain jax.checkpoint (counter remat_policy_unavailable)",
+            affects_lowering=True)
+define_flag("layer_scan_unroll", 1,
+            "lax.scan unroll= factor for layer_scan regions (>1 trades "
+            "compile time back for per-step dispatch overhead on very "
+            "cheap bodies); dropped silently on a jax whose lax.scan "
+            "lacks the knob",
+            affects_lowering=True)
 define_flag("compile_cache_dir", "",
             "persistent XLA compilation cache directory (sets jax's "
             "jax_compilation_cache_dir through framework/jax_compat.py "

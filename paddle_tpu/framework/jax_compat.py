@@ -11,6 +11,7 @@ jax.
 from __future__ import annotations
 
 import inspect
+from typing import Optional
 
 import jax
 
@@ -154,3 +155,92 @@ def axis_size(axis_name):
     if fn is not None:
         return fn(axis_name)
     return lax.psum(1, axis_name)
+
+
+# ---------------------------------------------------------------------------
+# Scan-over-layers support (framework/passes.py LayerScanPass +
+# ops/layer_scan.py).  ``jax.checkpoint_policies`` and lax.scan's
+# ``unroll=`` both arrived mid-0.x: a jax without the policy namespace
+# degrades to plain ``jax.checkpoint`` (counted once per degraded wrap
+# as ``remat_policy_unavailable`` so the telemetry says WHY a policy
+# flag had no effect), and a lax.scan without ``unroll`` simply drops
+# the knob.  Mirrors the PR 8 AOT-stages capability pattern: probe the
+# installed jax, never version-compare strings.
+# ---------------------------------------------------------------------------
+
+# framework-facing policy names -> jax.checkpoint_policies attr names
+# ("save_anything" is this framework's spelling of "do not recompute
+# anything the body produced" == everything_saveable)
+_CHECKPOINT_POLICY_NAMES = {
+    "nothing_saveable": "nothing_saveable",
+    "dots_saveable": "dots_saveable",
+    "checkpoint_dots": "dots_saveable",  # historical jax alias
+    "save_anything": "everything_saveable",
+    "everything_saveable": "everything_saveable",
+    "dots_with_no_batch_dims_saveable": "dots_with_no_batch_dims_saveable",
+}
+
+REMAT_POLICIES = tuple(_CHECKPOINT_POLICY_NAMES)
+
+
+def checkpoint_policy(name):
+    """Resolve a policy name to the ``jax.checkpoint_policies`` callable,
+    or None when this jax lacks the namespace / the specific policy
+    (the caller decides whether that degrades or fails)."""
+    if not name:
+        return None
+    pols = getattr(jax, "checkpoint_policies", None)
+    if pols is None:
+        return None
+    return getattr(pols, _CHECKPOINT_POLICY_NAMES.get(name, str(name)), None)
+
+
+def wrap_checkpoint(fn, policy_name: str = ""):
+    """``jax.checkpoint(fn, policy=<resolved>)`` with capability
+    degradation: no policy support -> plain ``jax.checkpoint`` (counter
+    ``remat_policy_unavailable``); no checkpoint at all (exotic builds)
+    -> ``fn`` unchanged.  With ``policy_name`` empty the wrap is skipped
+    entirely — primal values are bitwise-identical either way, so the
+    un-wrapped body stays the cheapest default."""
+    if not policy_name:
+        return fn
+    ckpt = getattr(jax, "checkpoint", None) or getattr(jax, "remat", None)
+    if ckpt is None:
+        return fn
+    pol = checkpoint_policy(policy_name)
+    if pol is None:
+        from ..monitor import stat_add
+
+        stat_add("remat_policy_unavailable")
+        return ckpt(fn)
+    try:
+        return ckpt(fn, policy=pol)
+    except TypeError:  # jax.checkpoint without the policy= kwarg
+        from ..monitor import stat_add
+
+        stat_add("remat_policy_unavailable")
+        return ckpt(fn)
+
+
+_scan_unroll_supported: Optional[bool] = None
+
+
+def scan(body, init, xs, length=None, reverse=False, unroll=1):
+    """``lax.scan`` with the ``unroll=`` knob applied only where the
+    installed jax has it (probed once); ``unroll<=1`` never passes the
+    kwarg, so the default path is identical on every jax."""
+    global _scan_unroll_supported
+
+    from jax import lax
+
+    kw = {}
+    if unroll and int(unroll) > 1:
+        if _scan_unroll_supported is None:
+            try:
+                _scan_unroll_supported = (
+                    "unroll" in inspect.signature(lax.scan).parameters)
+            except (TypeError, ValueError):
+                _scan_unroll_supported = False
+        if _scan_unroll_supported:
+            kw["unroll"] = int(unroll)
+    return lax.scan(body, init, xs, length=length, reverse=reverse, **kw)

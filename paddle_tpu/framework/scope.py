@@ -95,6 +95,47 @@ class PackedParamRef:
                 f"dtype={self.dtype})")
 
 
+class StackedParamRef:
+    """Lazy per-layer view into a layer-stacked state array.
+
+    The LayerScanPass (framework/passes.py) stacks per-layer weights,
+    optimizer slots, and their gradients into one leading-axis
+    ``(num_layers, *shape)`` scope array per weight family so the whole
+    repeated-layer region compiles as a single ``jax.lax.scan``.  The
+    scope keeps serving the PER-LAYER names through this view: reading
+    it (``np.asarray`` — checkpoints, paddle.save, tests, attribution)
+    slices layer ``index`` out of the stacked carrier; writing a
+    concrete array over it (checkpoint restore, paddle.load) signals
+    ``LayerScanPlan.ensure_stacked`` to re-pack before the next step —
+    so checkpoints stay per-layer and elastic across the scan flag.
+    """
+
+    __slots__ = ("_scope", "stack_name", "index", "shape", "dtype")
+
+    def __init__(self, scope, stack_name, index, shape, dtype):
+        self._scope = scope
+        self.stack_name = stack_name
+        self.index = int(index)
+        self.shape = tuple(int(d) for d in shape)
+        self.dtype = np.dtype(dtype)
+
+    def __array__(self, dtype=None, copy=None):
+        buf = self._scope.get_var(self.stack_name)
+        arr = np.asarray(buf[self.index]).reshape(self.shape)
+        if arr.dtype != self.dtype:
+            arr = arr.view(self.dtype) if arr.itemsize == self.dtype.itemsize \
+                else arr.astype(self.dtype)
+        return arr.astype(dtype) if dtype is not None else arr
+
+    def device_value(self):
+        """The layer's slice as a (device) array — no host transfer."""
+        return self._scope.get_var(self.stack_name)[self.index]
+
+    def __repr__(self):
+        return (f"StackedParamRef({self.stack_name!r}[{self.index}], "
+                f"shape={self.shape}, dtype={self.dtype})")
+
+
 _scope_serial = itertools.count()
 
 

@@ -14,6 +14,7 @@ from . import (  # noqa: F401
     fused,
     grad_generic,
     interp_ops,
+    layer_scan,
     linalg_ops,
     loss_ops,
     math_ops,

@@ -198,7 +198,8 @@ def render(bundle: str, tail: int = 15, stacks: bool = False,
         keys = ("executor_steps_", "executor_inflight", "watchdog_",
                 "postmortem_", "cluster_", "ckpt_saves", "ckpt_save_f",
                 "health_", "hbm_", "executable_size", "mfu_flops",
-                "compile_seconds_count")
+                "compile_seconds_count", "executable_hlo_ops",
+                "pass_layer_scan")
         for ln in rows:
             if metrics or any(k in ln for k in keys):
                 w(f"  {ln}\n")
