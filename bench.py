@@ -22,6 +22,7 @@ carries the BERT numbers as extra keys; vs_baseline is the MIN of the
 two ratios so the driver's single number only passes when both do.
 """
 import json
+import math
 import time
 
 import numpy as np
@@ -441,6 +442,19 @@ def _fallback_reduced_run(result):
             })
     except Exception as e:  # noqa: BLE001 — the record must still print
         result["fallback_error"] = f"{type(e).__name__}: {e}"[:500]
+        return result
+    try:
+        # the decode engine runs its step loop on whatever backend is
+        # live, so the generative-serving keys (and the continuous-vs-
+        # one-shot A/B, which is a RATIO — host-comparable) still land
+        # on a chip-less round
+        import jax
+
+        import paddle_tpu as pt
+
+        result.update(bench_decode(pt, jax))
+    except Exception as e:  # noqa: BLE001
+        result["fallback_decode_error"] = f"{type(e).__name__}: {e}"[:500]
     return result
 
 
@@ -650,6 +664,121 @@ def bench_serving(pt, jax):
         return srv_rps, seq_rps
     finally:
         shutil.rmtree(d, ignore_errors=True)
+
+
+DECODE_SLOTS = 8
+DECODE_REQS = 32
+DECODE_VOCAB = 128
+DECODE_MAX_SEQ = 64
+DECODE_PAGE = 8
+DECODE_MEAN_GAP_S = 0.001  # Poisson open-loop mean inter-arrival
+
+
+def bench_decode(pt, jax):
+    """Generative serving (paddle_tpu.serving.decode): one Poisson
+    open-loop request stream run A-B through the SAME decode engine in
+    continuous-batching mode vs one-shot group mode (the static
+    bucket-batcher baseline: a new group only starts when every slot is
+    free).  Emits decode_tokens_per_sec / ttft_ms_p99 / tpot_ms_p50 for
+    the continuous engine, the one-shot counterparts, and the speedups
+    — continuous batching must win BOTH throughput and tail TTFT.
+
+    Also measures per-token throughput at 16 vs 128 generated tokens
+    (8x) on an idle engine and ASSERTS the long run stays within 2x of
+    the short one: a prefix-recompute engine would be ~8x slower per
+    token at the long length, so this refutes recompute while leaving
+    room for host timing noise (the in-test oracle pins bitwise cache
+    correctness separately)."""
+    from paddle_tpu.observe.histogram import histogram
+    from paddle_tpu.serving.decode import (DecodeConfig, DecodeEngine,
+                                           TransformerLM)
+
+    model = TransformerLM(vocab_size=DECODE_VOCAB, d_model=64,
+                          num_layers=2, num_heads=2, max_seq_len=256)
+    weights = model.init_weights(jax.random.PRNGKey(0))
+    cfg = DecodeConfig(slots=DECODE_SLOTS, max_seq_len=DECODE_MAX_SEQ,
+                       page_size=DECODE_PAGE, max_queue=DECODE_REQS + 8)
+
+    # one arrival schedule shared verbatim by both modes: (prompt,
+    # new-token budget, inter-arrival gap) per request
+    rs = np.random.RandomState(17)
+    # high-variance generation budgets (8..48) are what one-shot group
+    # admission pads away: the group runs to its LONGEST member while
+    # finished slots sit idle
+    schedule = [
+        (list(rs.randint(1, DECODE_VOCAB, rs.randint(1, 13))),
+         int(rs.randint(8, 49)),
+         float(rs.exponential(DECODE_MEAN_GAP_S)))
+        for _ in range(DECODE_REQS)
+    ]
+
+    def run_phase(continuous):
+        eng = DecodeEngine(model, weights, cfg,
+                           continuous=continuous).start()
+        try:
+            for plen in (4, 12):  # warm both prefill buckets + the step
+                eng.generate(list(range(1, plen + 1)), max_new_tokens=2)
+            histogram("tpot_seconds").reset()
+            reqs = []
+            t0 = time.perf_counter()
+            for i, (prompt, n_new, gap) in enumerate(schedule):
+                time.sleep(gap)  # open loop: arrivals don't wait
+                reqs.append(eng.submit(prompt, max_new_tokens=n_new,
+                                       seed=i))
+            outs = [r.result(timeout=600) for r in reqs]
+            wall = time.perf_counter() - t0
+            toks = sum(len(o) for o in outs)
+            ttfts = sorted(r.t_first_token - r.t_enqueue for r in reqs)
+            tpot = histogram("tpot_seconds").summary()
+        finally:
+            eng.stop()
+        return {
+            "tokens_per_sec": toks / wall,
+            "ttft_ms_p99": 1e3 * ttfts[
+                min(len(ttfts) - 1, int(math.ceil(0.99 * len(ttfts))))],
+            "tpot_ms_p50": 1e3 * tpot.get("p50", 0.0),
+        }
+
+    cont = run_phase(continuous=True)
+    oneshot = run_phase(continuous=False)
+
+    # cache-vs-recompute: per-token cost at 16 vs 128 (8x) generated
+    # tokens on an idle single-slot engine
+    eng = DecodeEngine(model, weights,
+                       DecodeConfig(slots=1, max_seq_len=256,
+                                    page_size=DECODE_PAGE)).start()
+    try:
+        eng.generate([1, 2], max_new_tokens=130)  # warm the long path
+        t0 = time.perf_counter()
+        for _ in range(4):
+            eng.generate([1, 2], max_new_tokens=16)
+        short_tps = 64 / (time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        eng.generate([1, 2], max_new_tokens=128)
+        long_tps = 128 / (time.perf_counter() - t0)
+    finally:
+        eng.stop()
+    ratio = long_tps / short_tps
+    if ratio < 0.5:
+        raise RuntimeError(
+            f"decode throughput fell {1 / ratio:.2f}x when the "
+            f"generated length grew 8x ({short_tps:.0f} -> "
+            f"{long_tps:.0f} tok/s) — the KV cache is not being "
+            f"reused (prefix recompute)")
+
+    return {
+        "decode_tokens_per_sec": round(cont["tokens_per_sec"], 1),
+        "ttft_ms_p99": round(cont["ttft_ms_p99"], 3),
+        "tpot_ms_p50": round(cont["tpot_ms_p50"], 3),
+        "decode_oneshot_tokens_per_sec": round(
+            oneshot["tokens_per_sec"], 1),
+        "decode_oneshot_ttft_ms_p99": round(oneshot["ttft_ms_p99"], 3),
+        "decode_continuous_speedup": round(
+            cont["tokens_per_sec"] / oneshot["tokens_per_sec"], 3),
+        "decode_ttft_p99_improvement": round(
+            oneshot["ttft_ms_p99"] / cont["ttft_ms_p99"], 3),
+        "decode_seqlen8x_throughput_ratio": round(ratio, 3),
+    }
 
 
 CKPT_ARRAYS = 16
@@ -909,6 +1038,12 @@ def main():
         serve = bench_serving(pt, jax)
     except Exception as e:
         errors["serving"] = f"{type(e).__name__}: {e}"[:500]
+    try:
+        # generative serving: Poisson open-loop A-B (continuous vs
+        # one-shot group batching) + the cache-not-recompute ratio
+        result.update(bench_decode(pt, jax))
+    except Exception as e:
+        errors["decode"] = f"{type(e).__name__}: {e}"[:500]
     # tensor-parallel flagship (dp×mp mesh) — only where a mesh exists;
     # single-chip rounds skip it silently (the MULTICHIP dryrun's tp
     # leg covers the 8-virtual-device case every round)

@@ -900,6 +900,55 @@ class Executor:
         return list(fetches)
 
     # ------------------------------------------------------------------
+    def run_persistent(
+        self,
+        fn,
+        state_names: Sequence[str],
+        args: Sequence = (),
+        scope: Optional[Scope] = None,
+    ):
+        """Run one step of a pre-jitted function whose PERSISTENT state
+        lives in ``scope`` as device arrays — the ``run_steps``-style
+        entry for externally-built steps (the serving decode engine's
+        KV cache rides this: the cache tensors never round-trip to
+        host between steps).
+
+        ``fn(state_tuple, *args) -> (outputs, new_state_tuple)`` where
+        ``state_tuple`` is the current device value of every name in
+        ``state_names`` (in order).  The caller owns jitting — jit with
+        ``donate_argnums=(0,)`` so each step updates the state buffers
+        in place on TPU/GPU.  After the call the scope holds the new
+        state, so checkpoint/inspection paths (``np.asarray`` on the
+        var) keep working, and the executor's dispatch/drain counters
+        move so the stall watchdog and health plane see decode progress
+        like any other step.
+        """
+        from ..monitor import stat_add
+        from ..observe import tracer as otrace
+
+        scope = scope if scope is not None else global_scope()
+        missing = [n for n in state_names if not scope.has_var(n)]
+        if missing:
+            raise KeyError(
+                f"run_persistent state vars not in scope: {missing}")
+        state = tuple(scope.get_var(n) for n in state_names)
+        with otrace.span("executor/persistent", state=len(state)):
+            outputs, new_state = fn(state, *args)
+        if len(new_state) != len(state):
+            raise ValueError(
+                f"run_persistent fn returned {len(new_state)} state "
+                f"values for {len(state)} state vars")
+        for n, v in zip(state_names, new_state):
+            scope.set_var(n, v)
+        # persistent steps are synchronous from the window's point of
+        # view (the caller reads the outputs immediately): count them
+        # dispatched AND drained so progress telemetry stays truthful
+        stat_add("executor_run")
+        stat_add("executor_steps_dispatched")
+        stat_add("executor_steps_drained")
+        return outputs
+
+    # ------------------------------------------------------------------
     def _dispatch(self, program, feed, feed_arrays, spec, fetch_names, scope,
                   multi_step, scan_steps, use_prune=False):
         """Shared run/run_steps tail: state analysis, compile-cache lookup,

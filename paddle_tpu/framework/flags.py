@@ -247,3 +247,23 @@ define_flag("compile_cache_dir", "",
             "compiled executables instead of re-tracing + re-compiling; "
             "empty = disabled.  Applied when an Executor is constructed; "
             "counted once as executor_compile_cache_dir_set")
+define_flag("decode_slots", 8,
+            "decode engine (paddle_tpu.serving.decode): fixed slot-batch "
+            "capacity of one DecodeEngine replica — the number of "
+            "requests decoding JOINTLY in each compiled step; new "
+            "requests claim free slots at step boundaries (continuous "
+            "batching), finished/expired slots free immediately")
+define_flag("decode_max_seq_len", 256,
+            "decode engine: per-slot sequence capacity (prompt + "
+            "generated), and the width of the paged KV cache's per-slot "
+            "page table; must be a multiple of FLAGS_decode_page_size")
+define_flag("decode_page_size", 16,
+            "decode engine: positions per KV-cache page "
+            "(serving/kv_cache.py) — pages are the allocation grain, "
+            "reserved at admission and freed the moment a request "
+            "finishes; also the per-grid-step DMA size of the Pallas "
+            "paged decode-attention kernel")
+define_flag("decode_max_new_tokens", 64,
+            "decode engine: default generation budget when a request "
+            "does not pass max_new_tokens; admission reserves cache "
+            "pages for prompt + this many positions")

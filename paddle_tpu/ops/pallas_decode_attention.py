@@ -1,0 +1,179 @@
+"""Pallas TPU paged decode-attention kernel (one query token per slot).
+
+Role parity: the decode-phase half of the fused attention story
+(`ops/pallas_attention.py` covers training/prefill flash attention).
+Autoregressive serving holds each slot's K/V history in fixed-size
+pages (`serving/kv_cache.py`); at decode each slot contributes exactly
+ONE query token that must attend over its own live history:
+
+    q          : [S, H, D]            one token per slot
+    k/v_pages  : [P, page, H, D]      the shared page pool (one layer)
+    page_table : [S, pps]  int32      slot -> ordered page ids
+    lengths    : [S]       int32      live positions per slot
+
+The Pallas kernel iterates grid (slot, page) with the page table and
+lengths as SCALAR-PREFETCH operands: the page id is known before the
+body runs, so each (slot, page) step DMAs exactly one page of K and V
+from the pool — HBM traffic is O(sum(live pages)), never
+O(S * max_seq).  Pages at or past the slot's length are skipped
+entirely (`pl.when`), and the partial page at the tail is masked by
+position.  Online softmax (running max / denominator in VMEM scratch)
+accumulates across pages exactly like the prefill flash kernel.
+
+``decode_attention_reference`` is the pure-jnp oracle — gather the
+page table (O(S * max_seq) materialization) and do masked attention.
+It is also the CPU-backend default so tier-1 stays green without
+Mosaic; ``interpret=True`` runs the real kernel on CPU for tests.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG_INF = -1e30
+_LANES = 128  # TPU vector lane width; row stats broadcast across lanes
+
+
+def decode_attention_reference(q, k, v, lengths, *, sm_scale=None):
+    """Masked single-token attention over full-width K/V.
+
+    q: [S, H, D]; k/v: [S, T, H, D] (slot-major, any width T >= max
+    length); lengths: [S] — position t of slot s participates iff
+    t < lengths[s].  This exact formulation (mask -> -1e30, softmax
+    over the full width) is shared by the decode fallback AND the
+    prefill path in serving/decode.py, which is what makes
+    decode-with-cache logits bitwise-comparable to a full recompute.
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    s = jnp.einsum("shd,sthd->sht", qf, kf) * sm_scale      # [S, H, T]
+    t = jnp.arange(k.shape[1], dtype=jnp.int32)
+    mask = t[None, None, :] < lengths[:, None, None]
+    s = jnp.where(mask, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("sht,sthd->shd", p, vf)
+    return out.astype(q.dtype)
+
+
+def _decode_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                   m_scr, l_scr, acc_scr, *, sm_scale, page, n_pages):
+    import jax.experimental.pallas as pl
+
+    s_idx = pl.program_id(0)
+    p_idx = pl.program_id(1)
+
+    @pl.when(p_idx == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    length = len_ref[s_idx]
+    # pages wholly past the live length contribute nothing — skip the
+    # compute (the DMA still landed, clamped to a valid pool index)
+    @pl.when(p_idx * page < length)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)              # (H, D)
+        k = k_ref[0].astype(jnp.float32)              # (page, H, D)
+        v = v_ref[0].astype(jnp.float32)
+        # scores per head over this page's positions: (H, page)
+        s = lax.dot_general(
+            q, k, (((1,), (2,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32) * sm_scale
+        pos = p_idx * page + lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        s = jnp.where(pos < length, s, _NEG_INF)
+
+        m_prev = m_scr[:, :1]                          # (H, 1)
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)                         # (H, page)
+        l_new = alpha * l_scr[:, :1] + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[...] = acc_scr[...] * alpha + lax.dot_general(
+            p, v, (((1,), (0,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32)
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(p_idx == n_pages - 1)
+    def _flush():
+        l = l_scr[:, :1]
+        o_ref[0] = (acc_scr[...] / jnp.where(l == 0.0, 1.0, l)).astype(
+            o_ref.dtype)
+
+
+def _paged_call(q, k_pages, v_pages, page_table, lengths, sm_scale,
+                interpret):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n_slots, h, d = q.shape
+    pps = page_table.shape[1]
+    page = k_pages.shape[1]
+    flat_table = page_table.reshape(-1).astype(jnp.int32)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # (flat page table, lengths)
+        grid=(n_slots, pps),
+        in_specs=[
+            pl.BlockSpec((1, h, d), lambda s, p, pt, ln: (s, 0, 0)),
+            # THE paged-attention move: the K/V block index is read out
+            # of the prefetched page table, so each grid step DMAs one
+            # pool page — no gather materialization
+            pl.BlockSpec((1, page, h, d),
+                         lambda s, p, pt, ln: (pt[s * pps + p], 0, 0, 0)),
+            pl.BlockSpec((1, page, h, d),
+                         lambda s, p, pt, ln: (pt[s * pps + p], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, h, d), lambda s, p, pt, ln: (s, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((h, _LANES), jnp.float32),   # running max
+            pltpu.VMEM((h, _LANES), jnp.float32),   # running denom
+            pltpu.VMEM((h, d), jnp.float32),        # output accumulator
+        ],
+    )
+    kern = functools.partial(_decode_kernel, sm_scale=sm_scale,
+                             page=page, n_pages=pps)
+    return pl.pallas_call(
+        kern,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((n_slots, h, d), q.dtype),
+        interpret=interpret,
+    )(flat_table, lengths.astype(jnp.int32), q, k_pages, v_pages)
+
+
+def paged_decode_attention(q, k_pages, v_pages, page_table, lengths, *,
+                           sm_scale=None, use_pallas="auto",
+                           interpret=False):
+    """Decode attention straight off the page pool.
+
+    q [S,H,D]; k/v_pages [P,page,H,D] (ONE layer's pool); page_table
+    [S,pps] i32; lengths [S] i32.  ``use_pallas``: 'auto' engages the
+    Pallas kernel on the TPU backend only (CPU gets the gather+mask
+    reference, keeping tier-1 Mosaic-free), 'always' forces it
+    (combine with interpret=True off-TPU), 'never' forces the
+    reference.
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    if use_pallas == "auto":
+        use_pallas = "always" if jax.default_backend() == "tpu" \
+            else "never"
+    if use_pallas == "always":
+        return _paged_call(q, k_pages, v_pages, page_table, lengths,
+                           float(sm_scale), interpret)
+    # reference: gather the page table to full width, then mask
+    s, pps = page_table.shape
+    page = k_pages.shape[1]
+    k = k_pages[page_table].reshape(s, pps * page, *k_pages.shape[2:])
+    v = v_pages[page_table].reshape(s, pps * page, *v_pages.shape[2:])
+    return decode_attention_reference(q, k, v, lengths,
+                                      sm_scale=sm_scale)

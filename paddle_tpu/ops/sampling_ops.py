@@ -1,8 +1,18 @@
-"""Sampling-based ops: NCE, sample_logits, correlation cost volume.
+"""Sampling-based ops: NCE, sample_logits, correlation cost volume —
+plus the jit-safe token samplers (greedy / top-k / top-p) the decode
+engine (serving/decode.py) runs INSIDE its compiled step.
 
 Reference parity: operators/nce_op.{cc,h} (noise-contrastive estimation
 with uniform/log-uniform samplers), operators/sample_logits_op.cc, and
 operators/correlation_op.cu (FlowNet cost volume).
+
+Token-sampler contract: every draw takes an EXPLICIT PRNG key (the
+engine derives one per request from its seed via fold_in, so a
+request's token stream is independent of which slot or replica served
+it, and — with ``jax_threefry_partitionable`` enabled process-wide at
+Executor construction since PR 7 — independent of how XLA shards the
+batch).  ``tests/test_decode_engine.py`` pins two replicas given the same
+seed emitting identical tokens.
 """
 from __future__ import annotations
 
@@ -201,3 +211,51 @@ def _correlation(ctx, op):
             else:
                 outs.append(prod[:, base_y[:, None], base_x[None, :]])
     ctx.set_out(op, "Output", jnp.stack(outs, axis=1))
+
+
+# -- decode-time token samplers (serving/decode.py) -----------------------
+
+
+def greedy_sample(logits):
+    """argmax over the vocab axis -> int32 token ids."""
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+
+def filter_top_k_top_p(logits, top_k, top_p):
+    """Mask logits outside the per-row top-k / nucleus-p sets to -inf.
+
+    Fully jit-safe with DYNAMIC per-row knobs: ``top_k`` [..] int32
+    (<= 0 disables) and ``top_p`` [..] float (>= 1.0 disables) are
+    data, not static arguments, so one compiled step serves any mix of
+    per-slot sampling configs.  Ties at the threshold logit are kept
+    (the standard sorted-threshold caveat).
+    """
+    v = logits.shape[-1]
+    desc = jnp.flip(jnp.sort(logits, axis=-1), axis=-1)
+    # top-k: keep logits >= the k-th largest (k clipped into [1, V])
+    k_idx = jnp.clip(top_k - 1, 0, v - 1)
+    thresh_k = jnp.take_along_axis(desc, k_idx[..., None], axis=-1)
+    keep_k = (top_k <= 0)[..., None] | (logits >= thresh_k)
+    # top-p: over the sorted distribution keep the minimal prefix whose
+    # mass reaches p (the first token is always kept: cum - prob < p)
+    probs = jax.nn.softmax(desc, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep_sorted = (cum - probs) < top_p[..., None]
+    thresh_p = jnp.min(jnp.where(keep_sorted, desc, jnp.inf), axis=-1,
+                       keepdims=True)
+    keep_p = (top_p >= 1.0)[..., None] | (logits >= thresh_p)
+    return jnp.where(keep_k & keep_p, logits, -jnp.inf)
+
+
+def sample_tokens(keys, logits, temperature, top_k, top_p):
+    """One token per row: greedy when temperature <= 0, else a
+    categorical draw over the temperature-scaled, top-k/top-p-filtered
+    distribution.  ``keys`` [S, 2] uint32 (one PRNGKey per row — the
+    explicit key thread), logits [S, V]; temperature/top_k/top_p [S].
+    """
+    greedy = temperature <= 0.0
+    t = jnp.where(greedy, 1.0, temperature)
+    filt = filter_top_k_top_p(logits / t[..., None], top_k, top_p)
+    drawn = jax.vmap(jax.random.categorical)(keys, filt)
+    return jnp.where(greedy, greedy_sample(logits),
+                     drawn.astype(jnp.int32))

@@ -10,9 +10,16 @@ deployment story, rebuilt TPU-native over the compile-once Predictor:
   per-request deadlines;
 - ``Server`` (server.py) AOT-warms every bucket at start, serves
   ``/stats`` + ``/health`` over the fleet KV HTTP server, and drains
-  gracefully on stop.
+  gracefully on stop;
+- the GENERATIVE path (decode.py + kv_cache.py): ``DecodeEngine``
+  runs autoregressive decode over a fixed slot batch with a paged,
+  device-resident KV cache (Pallas paged-attention kernel on TPU),
+  continuous batching at step boundaries, streaming token replies,
+  and deadline reaping mid-decode; ``DecodeServer`` replicates N
+  engines behind one least-loaded admission point with per-replica
+  ``/stats``.
 """
-from .batcher import Batcher, InferenceRequest  # noqa: F401
+from .batcher import Batcher, InferenceRequest, RequestBase  # noqa: F401
 from .buckets import (  # noqa: F401
     BucketSpec,
     DeadlineExceededError,
@@ -20,11 +27,28 @@ from .buckets import (  # noqa: F401
     RequestTooLargeError,
     ServerClosedError,
     ServingError,
+    prefill_bucket_grid,
 )
-from .server import Server, ServingConfig  # noqa: F401
+from .decode import (  # noqa: F401
+    DecodeConfig,
+    DecodeEngine,
+    DecodeRequest,
+    TransformerLM,
+)
+from .kv_cache import (  # noqa: F401
+    CacheConfig,
+    CacheExhaustedError,
+    PagedKVCache,
+    PageAllocator,
+)
+from .server import DecodeServer, Server, ServingConfig  # noqa: F401
 
 __all__ = [
-    "Batcher", "BucketSpec", "DeadlineExceededError", "InferenceRequest",
-    "QueueFullError", "RequestTooLargeError", "Server", "ServerClosedError",
-    "ServingConfig", "ServingError",
+    "Batcher", "BucketSpec", "CacheConfig", "CacheExhaustedError",
+    "DeadlineExceededError", "DecodeConfig", "DecodeEngine",
+    "DecodeRequest", "DecodeServer", "InferenceRequest", "PageAllocator",
+    "PagedKVCache", "QueueFullError", "RequestBase",
+    "RequestTooLargeError", "Server", "ServerClosedError",
+    "ServingConfig", "ServingError", "TransformerLM",
+    "prefill_bucket_grid",
 ]

@@ -47,16 +47,24 @@ class _Unset:
 _UNSET = _Unset()
 
 
-class InferenceRequest:
-    """Future-like handle for one in-flight request."""
+class RequestBase:
+    """Future-like completion/deadline machinery shared by every
+    serving request kind: the bucket batcher's ``InferenceRequest``
+    below and the decode engine's streaming ``DecodeRequest``
+    (serving/decode.py).  The deadline contract is one rule applied at
+    EVERY stage a request can sit in: reaped at dequeue, reaped during
+    the coalescing window, reaped MID-DECODE at each step boundary
+    (the decode scheduler frees the slot so a stalled client cannot
+    pin it for the full max_new_tokens), and self-reaped on the
+    client's own ``result()`` wait — whichever fires first wins the
+    ``_complete`` race."""
 
-    __slots__ = ("feeds", "nrows", "key", "deadline", "t_enqueue",
-                 "_event", "_lock", "_result", "_error")
+    __slots__ = ("deadline", "t_enqueue", "_event", "_lock", "_result",
+                 "_error")
 
-    def __init__(self, feeds, nrows, key, deadline):
-        self.feeds = feeds
-        self.nrows = nrows
-        self.key = key
+    _deadline_stat = "serving_deadline_exceeded"
+
+    def __init__(self, deadline):
         self.deadline = deadline  # absolute monotonic seconds, or None
         self.t_enqueue = time.monotonic()
         self._event = threading.Event()
@@ -100,13 +108,25 @@ class InferenceRequest:
                 if self._complete(error=DeadlineExceededError(
                         f"deadline exceeded after "
                         f"{time.monotonic() - self.t_enqueue:.3f}s "
-                        f"(queued, never executed)")):
-                    stat_add("serving_deadline_exceeded")
+                        f"(never completed)")):
+                    stat_add(self._deadline_stat)
         if not self._event.wait(timeout):
             raise TimeoutError("request not completed within timeout")
         if self._error is not None:
             raise self._error
         return self._result
+
+
+class InferenceRequest(RequestBase):
+    """Future-like handle for one in-flight bucket-batcher request."""
+
+    __slots__ = ("feeds", "nrows", "key")
+
+    def __init__(self, feeds, nrows, key, deadline):
+        super().__init__(deadline)
+        self.feeds = feeds
+        self.nrows = nrows
+        self.key = key
 
 
 class Batcher:

@@ -232,3 +232,17 @@ def bucket_feed_specs(plans: Dict[str, tuple], spec: BucketSpec):
                 seen.add(fp)
                 specs.append(fs)
     return specs, False
+
+
+def prefill_bucket_grid(max_seq_len: int, page_size: int):
+    """Prompt-length buckets for the decode engine's prefill compiles
+    (serving/decode.py): page-multiple powers of two capped at
+    max_seq_len, so the prefill executable universe stays
+    O(log(max_seq/page)) and every bucket scatters whole KV pages."""
+    out = []
+    b = int(page_size)
+    while b < max_seq_len:
+        out.append(b)
+        b *= 2
+    out.append(int(max_seq_len))
+    return tuple(out)

@@ -1,0 +1,823 @@
+"""KV-cache autoregressive decode engine with continuous batching.
+
+Role parity: the generative-serving half of Paddle Serving / the
+reference's inference deployment story — the piece the PR-1 one-shot
+bucket batcher cannot cover, because autoregressive decode re-enters
+the model once PER TOKEN.  Recomputing the prefix every token is
+O(len^2) per request; waiting for a shape bucket adds whole-batch
+latency to every new arrival.  This engine is the TPU-native fix:
+
+- **Persistent per-slot KV cache** (`kv_cache.py`): each of the
+  ``slots`` concurrent requests owns paged key/value blocks inside two
+  device-resident pool arrays.  The pools ride
+  ``Executor.run_persistent`` with donation, so the cache NEVER
+  round-trips to host between steps — per-token work is O(1) in the
+  prefix length.
+- **Continuous batching** (Orca's iteration-level scheduling): one
+  jitted step decodes every live slot jointly; new requests claim free
+  slots at step boundaries (prefill fills the slot's pages, decode
+  proceeds with the batch that's already in flight), and a slot whose
+  request finishes — EOS, token budget, or deadline — frees
+  IMMEDIATELY instead of padding to the longest neighbor.
+- **Deadline reap mid-decode**: a lapsed deadline is honored at every
+  step boundary (not just at dequeue), so a stalled client cannot pin
+  a slot for the full max_new_tokens.
+- **Streaming replies**: each sampled token is pushed to the request's
+  stream the step it is produced — consume via the ``tokens()``
+  generator or an ``on_token`` callback; ``result()`` blocks for the
+  full sequence.
+- **Deterministic sampling** (`ops/sampling_ops.py`): greedy / top-k /
+  top-p run INSIDE the compiled step with an explicit per-request PRNG
+  key (seed + fold_in(token index)), so a request's tokens are
+  independent of slot assignment, batch composition, and replica —
+  the property multi-replica scale-out (serving/server.py
+  ``DecodeServer``) relies on.
+
+Attention reads the page pool through
+``ops/pallas_decode_attention.py``: the Pallas kernel on TPU (page
+table as scalar-prefetch operands — one page DMA per grid step), the
+pure-jnp gather+mask reference on CPU so tier-1 stays green.  Prefill
+and decode share one masked-softmax formulation at one width
+(max_seq_len), which is what makes decode-with-cache logits
+bitwise-equal to a full recompute (`tests/test_decode_engine.py` pins it at
+every step).
+
+Observability: ``decode_*`` counters/gauges plus ``ttft_seconds`` /
+``tpot_seconds`` / ``decode_step_seconds`` histograms — all on
+``/metrics`` wherever a fleet KV HTTP server runs.
+"""
+from __future__ import annotations
+
+import collections
+import math
+import queue as _queue
+import threading
+import time
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from ..monitor import stat_add, stat_max, stat_set
+from ..observe import tracer as otrace
+from ..observe.histogram import stat_time
+from .batcher import _UNSET, RequestBase
+from .buckets import (BucketSpec, DeadlineExceededError, QueueFullError,
+                      RequestTooLargeError, ServerClosedError,
+                      prefill_bucket_grid)
+from . import kv_cache
+from .kv_cache import CacheConfig, PagedKVCache, K_PAGES_VAR, V_PAGES_VAR
+
+_STATE_VARS = (K_PAGES_VAR, V_PAGES_VAR)
+_DONE = object()  # stream sentinel
+
+
+# ---------------------------------------------------------------------------
+# model
+
+
+class TransformerLM:
+    """A decoder-only transformer sized by constructor args — the
+    engine's reference model (bench, tests, demos).  Any model works
+    with the engine if it exposes this class's surface: ``num_layers``
+    / ``num_heads`` / ``head_dim`` / ``vocab_size`` plus the pure
+    per-row pieces below, which prefill and decode COMPOSE IDENTICALLY
+    so cached decode stays bitwise-comparable to a full recompute
+    (layer norm, QKV/out projections, MLP are all row-independent)."""
+
+    def __init__(self, vocab_size: int, d_model: int = 64,
+                 num_layers: int = 2, num_heads: int = 2,
+                 ffn_dim: Optional[int] = None, max_seq_len: int = 256):
+        self.vocab_size = int(vocab_size)
+        self.d_model = int(d_model)
+        self.num_layers = int(num_layers)
+        self.num_heads = int(num_heads)
+        if d_model % num_heads:
+            raise ValueError("d_model must divide by num_heads")
+        self.head_dim = self.d_model // self.num_heads
+        self.ffn_dim = int(ffn_dim) if ffn_dim else 4 * self.d_model
+        self.max_seq_len = int(max_seq_len)
+
+    def init_weights(self, key):
+        import jax
+        import jax.numpy as jnp
+
+        dm, f, v = self.d_model, self.ffn_dim, self.vocab_size
+        n_per_layer = 6
+        keys = jax.random.split(key, 3 + self.num_layers * n_per_layer)
+
+        def dense(k, shape, scale=None):
+            scale = scale if scale is not None else 1.0 / math.sqrt(shape[0])
+            return (jax.random.normal(k, shape) * scale).astype(jnp.float32)
+
+        w = {
+            "tok_emb": dense(keys[0], (v, dm), 0.02),
+            "pos_emb": dense(keys[1], (self.max_seq_len, dm), 0.02),
+            "lm_head": dense(keys[2], (dm, v)),
+            "lnf_g": jnp.ones((dm,), jnp.float32),
+            "lnf_b": jnp.zeros((dm,), jnp.float32),
+            "layers": [],
+        }
+        for i in range(self.num_layers):
+            k = keys[3 + i * n_per_layer: 3 + (i + 1) * n_per_layer]
+            w["layers"].append({
+                "ln1_g": jnp.ones((dm,), jnp.float32),
+                "ln1_b": jnp.zeros((dm,), jnp.float32),
+                "wq": dense(k[0], (dm, dm)),
+                "wk": dense(k[1], (dm, dm)),
+                "wv": dense(k[2], (dm, dm)),
+                "wo": dense(k[3], (dm, dm)),
+                "ln2_g": jnp.ones((dm,), jnp.float32),
+                "ln2_b": jnp.zeros((dm,), jnp.float32),
+                "w1": dense(k[4], (dm, f)),
+                "w2": dense(k[5], (f, dm)),
+            })
+        return w
+
+    # -- pure per-row pieces (shared verbatim by prefill and decode) ------
+    @staticmethod
+    def _ln(x, g, b):
+        import jax.numpy as jnp
+
+        mu = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.mean(jnp.square(x - mu), axis=-1, keepdims=True)
+        return (x - mu) / jnp.sqrt(var + 1e-5) * g + b
+
+    def _embed(self, w, tokens, positions):
+        return w["tok_emb"][tokens] + w["pos_emb"][positions]
+
+    def _qkv(self, lw, h):
+        n, d = self.num_heads, self.head_dim
+        q = (h @ lw["wq"]).reshape(*h.shape[:-1], n, d)
+        k = (h @ lw["wk"]).reshape(*h.shape[:-1], n, d)
+        v = (h @ lw["wv"]).reshape(*h.shape[:-1], n, d)
+        return q, k, v
+
+    def _attn_out(self, lw, ctx):
+        return ctx.reshape(*ctx.shape[:-2], self.d_model) @ lw["wo"]
+
+    def _mlp(self, lw, h):
+        import jax
+
+        return jax.nn.gelu(h @ lw["w1"]) @ lw["w2"]
+
+    def _head(self, w, x):
+        return self._ln(x, w["lnf_g"], w["lnf_b"]) @ w["lm_head"]
+
+
+# ---------------------------------------------------------------------------
+# requests
+
+
+class DecodeRequest(RequestBase):
+    """Streaming future for one generation request.
+
+    Tokens arrive on an internal stream as the engine produces them:
+    iterate ``tokens()`` for a generator, pass ``on_token=`` for a
+    callback (called from the engine thread — keep it cheap), or call
+    ``result()`` for the completed id list.  ``generated`` always
+    holds the ids produced so far (partial output survives a deadline
+    reap)."""
+
+    __slots__ = ("prompt", "max_new_tokens", "temperature", "top_k",
+                 "top_p", "seed", "on_token", "generated", "_stream",
+                 "t_first_token", "record_logits", "logits_trace")
+
+    _deadline_stat = "decode_deadline_exceeded"
+
+    def __init__(self, prompt, max_new_tokens, deadline, temperature,
+                 top_k, top_p, seed, on_token, record_logits=False):
+        super().__init__(deadline)
+        self.prompt = list(prompt)
+        self.max_new_tokens = int(max_new_tokens)
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        self.seed = int(seed)
+        self.on_token = on_token
+        self.generated: List[int] = []
+        self._stream: _queue.Queue = _queue.Queue()
+        self.t_first_token: Optional[float] = None
+        self.record_logits = bool(record_logits)
+        self.logits_trace: List[np.ndarray] = []
+
+    # engine side ---------------------------------------------------------
+    def _emit(self, token: int) -> None:
+        if self.t_first_token is None:
+            self.t_first_token = time.monotonic()
+            stat_time("ttft_seconds", self.t_first_token - self.t_enqueue)
+        self.generated.append(int(token))
+        self._stream.put(int(token))
+        if self.on_token is not None:
+            try:
+                self.on_token(int(token))
+            except Exception:  # noqa: BLE001 — user callback, isolate
+                stat_add("decode_callback_errors")
+
+    def _finish(self, error=None) -> bool:
+        won = self._complete(result=list(self.generated), error=error)
+        self._stream.put(_DONE)  # always: a racing client-side reap
+        # must still terminate a tokens() reader
+        return won
+
+    # client side ---------------------------------------------------------
+    def tokens(self, timeout: Optional[float] = None):
+        """Generator over streamed token ids; raises the request's
+        error (after yielding everything produced) if it failed."""
+        while True:
+            budget = timeout
+            if self.deadline is not None:
+                # the engine reaps at the next step boundary; the small
+                # grace covers its in-flight step
+                rem = max(self.deadline - time.monotonic(), 0.0) + 1.0
+                budget = rem if budget is None else min(budget, rem)
+            try:
+                item = self._stream.get(timeout=budget)
+            except _queue.Empty:
+                raise TimeoutError(
+                    "no token within the wait budget") from None
+            if item is _DONE:
+                break
+            yield item
+        if self._error is not None:
+            raise self._error
+
+
+class _SlotState:
+    __slots__ = ("req", "base_key", "n_generated", "last_token", "t_last")
+
+    def __init__(self, req, base_key):
+        self.req = req
+        self.base_key = base_key
+        self.n_generated = 0
+        self.last_token = 0
+        self.t_last = time.monotonic()
+
+
+# ---------------------------------------------------------------------------
+# engine
+
+
+class DecodeConfig:
+    """Engine knobs; defaults come from the ``FLAGS_decode_*`` flags."""
+
+    def __init__(self, slots: Optional[int] = None,
+                 max_seq_len: Optional[int] = None,
+                 page_size: Optional[int] = None,
+                 num_pages: Optional[int] = None,
+                 max_new_tokens: Optional[int] = None,
+                 eos_id: Optional[int] = None,
+                 max_queue: int = 256,
+                 default_deadline_ms: Optional[float] = None,
+                 use_pallas: str = "auto",
+                 interpret: bool = False,
+                 cache_dtype="float32"):
+        from ..framework import flags
+
+        self.slots = int(slots if slots is not None
+                         else flags.flag("decode_slots"))
+        self.max_seq_len = int(max_seq_len if max_seq_len is not None
+                               else flags.flag("decode_max_seq_len"))
+        self.page_size = int(page_size if page_size is not None
+                             else flags.flag("decode_page_size"))
+        self.num_pages = num_pages
+        self.max_new_tokens = int(
+            max_new_tokens if max_new_tokens is not None
+            else flags.flag("decode_max_new_tokens"))
+        self.eos_id = eos_id
+        self.max_queue = int(max_queue)
+        self.default_deadline_ms = default_deadline_ms
+        self.use_pallas = use_pallas
+        self.interpret = bool(interpret)
+        self.cache_dtype = cache_dtype
+
+
+class DecodeEngine:
+    """One decode replica: a slot batch, its paged KV cache, and the
+    consumer thread that runs admission -> prefill -> joint decode
+    step, forever.  ``continuous=False`` degrades admission to the
+    one-shot group mode (a new group only starts when EVERY slot is
+    free) — the static-batching baseline bench.py's A/B uses."""
+
+    def __init__(self, model, weights, config: Optional[DecodeConfig] = None,
+                 place=None, name: str = "replica-0", continuous: bool = True):
+        import jax
+
+        from ..framework.executor import Executor
+        from ..framework.scope import Scope
+
+        self.model = model
+        self.config = config or DecodeConfig()
+        self.name = name
+        self._continuous = bool(continuous)
+        c = self.config
+        if c.max_seq_len > model.max_seq_len:
+            raise ValueError(
+                f"DecodeConfig.max_seq_len {c.max_seq_len} exceeds the "
+                f"model's positional table ({model.max_seq_len})")
+        self._scope = Scope()
+        self._exe = Executor(place)
+        self._cache = PagedKVCache(
+            CacheConfig(model.num_layers, model.num_heads, model.head_dim,
+                        c.slots, c.max_seq_len, c.page_size,
+                        num_pages=c.num_pages, dtype=c.cache_dtype),
+            self._scope)
+        self.weights = jax.tree_util.tree_map(jax.numpy.asarray, weights)
+        self._buckets = BucketSpec(
+            (1,), prefill_bucket_grid(c.max_seq_len, c.page_size))
+        self._step_fn = self._build_step_fn()
+        self._prefill_fns = {}
+        self._slots: List[Optional[_SlotState]] = [None] * c.slots
+        self._queue = collections.deque()
+        self._cond = threading.Condition()
+        self._closing = False
+        self._abort = False
+        self._thread = None
+        self._seq = 0  # default-seed counter
+        self.tokens_total = 0
+
+    # -- jitted step builders --------------------------------------------
+    def _attend(self, q, k_pages, v_pages, layer, page_table, lengths):
+        from ..ops.pallas_decode_attention import paged_decode_attention
+
+        # all backend dispatch (auto/always/never, Pallas vs the
+        # gather+mask reference) lives in ONE place: the op itself
+        return paged_decode_attention(
+            q, k_pages[layer], v_pages[layer], page_table, lengths,
+            use_pallas=self.config.use_pallas,
+            interpret=self.config.interpret)
+
+    def _build_step_fn(self):
+        import jax
+        import jax.numpy as jnp
+
+        from ..ops.sampling_ops import sample_tokens
+
+        model = self.model
+
+        def step(state, weights, tokens, positions, live, page_table,
+                 write_page, write_off, base_keys, counters, temp, top_k,
+                 top_p):
+            k_pages, v_pages = state
+            x = model._embed(weights, tokens, positions)       # [S, Dm]
+            lengths = positions + 1  # the token written THIS step included
+            for l in range(model.num_layers):
+                lw = weights["layers"][l]
+                h = model._ln(x, lw["ln1_g"], lw["ln1_b"])
+                q, k, v = model._qkv(lw, h)                    # [S, H, D]
+                k_pages = kv_cache.scatter_token_layer(
+                    k_pages, l, k, write_page, write_off)
+                v_pages = kv_cache.scatter_token_layer(
+                    v_pages, l, v, write_page, write_off)
+                ctx = self._attend(q, k_pages, v_pages, l, page_table,
+                                   lengths)
+                x = x + model._attn_out(lw, ctx)
+                x = x + model._mlp(
+                    lw, model._ln(x, lw["ln2_g"], lw["ln2_b"]))
+            logits = model._head(weights, x)                   # [S, V]
+            keys = jax.vmap(jax.random.fold_in)(base_keys, counters)
+            nxt = sample_tokens(keys, logits, temp, top_k, top_p)
+            nxt = jnp.where(live, nxt, 0)
+            return (nxt, logits), (k_pages, v_pages)
+
+        return jax.jit(step, donate_argnums=(0,))
+
+    def _build_prefill_fn(self, t_pad: int):
+        import jax
+        import jax.numpy as jnp
+
+        from ..ops.pallas_decode_attention import \
+            decode_attention_reference
+        from ..ops.sampling_ops import sample_tokens
+
+        model = self.model
+        cc = self._cache.config
+        t_max = cc.max_seq_len
+        n_bp = t_pad // cc.page_size
+        cdt = cc.dtype
+
+        def prefill(state, weights, tokens, length, pages, base_key,
+                    temp, top_k, top_p):
+            k_pages, v_pages = state
+            positions = jnp.arange(t_pad, dtype=jnp.int32)
+            x = model._embed(weights, tokens, positions)    # [T_pad, Dm]
+            row_lengths = positions + 1
+            for l in range(model.num_layers):
+                lw = weights["layers"][l]
+                h = model._ln(x, lw["ln1_g"], lw["ln1_b"])
+                q, k, v = model._qkv(lw, h)                 # [T_pad, H, D]
+                k_pages = kv_cache.scatter_prompt_layer(
+                    k_pages, l, k, pages[:n_bp])
+                v_pages = kv_cache.scatter_prompt_layer(
+                    v_pages, l, v, pages[:n_bp])
+                # attention at FULL cache width through the SAME cache
+                # dtype the pages store — each row's numerics are the
+                # ones decode will reproduce from the pages, which is
+                # the bitwise prefix-cache contract
+                shape = (t_max, model.num_heads, model.head_dim)
+                kf = jnp.zeros(shape, cdt).at[:t_pad].set(k.astype(cdt))
+                vf = jnp.zeros(shape, cdt).at[:t_pad].set(v.astype(cdt))
+                ctx = decode_attention_reference(
+                    q, jnp.broadcast_to(kf[None], (t_pad,) + shape),
+                    jnp.broadcast_to(vf[None], (t_pad,) + shape),
+                    row_lengths)
+                x = x + model._attn_out(lw, ctx)
+                x = x + model._mlp(
+                    lw, model._ln(x, lw["ln2_g"], lw["ln2_b"]))
+            logits = model._head(weights, x)                # [T_pad, V]
+            last = jax.lax.dynamic_index_in_dim(
+                logits, length - 1, 0, keepdims=False)
+            key0 = jax.random.fold_in(base_key, 0)
+            tok = sample_tokens(key0[None], last[None], temp[None],
+                                top_k[None], top_p[None])[0]
+            return (tok, last), (k_pages, v_pages)
+
+        return jax.jit(prefill, donate_argnums=(0,))
+
+    def _prefill_fn(self, t_pad: int):
+        fn = self._prefill_fns.get(t_pad)
+        if fn is None:
+            fn = self._prefill_fns[t_pad] = self._build_prefill_fn(t_pad)
+            stat_add("decode_prefill_compiles")
+        return fn
+
+    # -- client side ------------------------------------------------------
+    def submit(self, prompt: Sequence[int], max_new_tokens=None,
+               deadline_ms=_UNSET, temperature: float = 0.0,
+               top_k: int = 0, top_p: float = 1.0,
+               seed: Optional[int] = None,
+               on_token: Optional[Callable[[int], None]] = None,
+               record_logits: bool = False) -> DecodeRequest:
+        c = self.config
+        prompt = [int(t) for t in prompt]
+        if not prompt:
+            raise ValueError("prompt must hold at least one token id")
+        if max_new_tokens is None:
+            max_new_tokens = c.max_new_tokens
+        if len(prompt) + int(max_new_tokens) > c.max_seq_len:
+            raise RequestTooLargeError(
+                f"prompt ({len(prompt)}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds the slot capacity "
+                f"({c.max_seq_len}); raise FLAGS_decode_max_seq_len or "
+                f"shorten the request")
+        cc = self._cache.config
+        need = cc.pages_for(len(prompt) + int(max_new_tokens))
+        if need > cc.num_pages - 1:  # page 0 is trash, never allocatable
+            # an unsatisfiable reservation must be rejected HERE: queued
+            # it would head-of-line-block the engine forever (no finish
+            # can ever free enough pages)
+            raise RequestTooLargeError(
+                f"request needs {need} cache pages but the pool only "
+                f"has {cc.num_pages - 1}; raise num_pages or shorten "
+                f"the request")
+        self._buckets.seq_bucket(len(prompt))  # raises RequestTooLarge
+        if deadline_ms is _UNSET:
+            deadline_ms = c.default_deadline_ms
+        deadline = None if deadline_ms is None \
+            else time.monotonic() + float(deadline_ms) / 1e3
+        with self._cond:
+            if self._closing:
+                raise ServerClosedError("decode engine is stopping")
+            if len(self._queue) >= c.max_queue:
+                stat_add("decode_rejected_queue_full")
+                raise QueueFullError(
+                    f"decode queue is at capacity ({c.max_queue})")
+            if seed is None:
+                seed = self._seq
+            self._seq += 1
+            req = DecodeRequest(prompt, max_new_tokens, deadline,
+                                temperature, top_k, top_p, seed,
+                                on_token, record_logits=record_logits)
+            self._queue.append(req)
+            stat_add("decode_requests")
+            stat_set("decode_queue_depth", len(self._queue))
+            self._cond.notify_all()
+        return req
+
+    def generate(self, prompt, **kw) -> List[int]:
+        return self.submit(prompt, **kw).result()
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self) -> "DecodeEngine":
+        with self._cond:
+            if self._thread is not None:
+                return self
+            self._closing = self._abort = False
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True,
+                name=f"decode-{self.name}")
+            self._thread.start()
+        from ..observe import flight as _flight
+
+        _flight.record("serving/decode_start", name=self.name,
+                       slots=self.config.slots,
+                       max_seq_len=self.config.max_seq_len,
+                       page_size=self.config.page_size)
+        return self
+
+    def stop(self, drain: bool = True):
+        with self._cond:
+            self._closing = True
+            if not drain:
+                self._abort = True
+                while self._queue:
+                    req = self._queue.popleft()
+                    if req._finish(error=ServerClosedError(
+                            "engine stopped before the request ran")):
+                        stat_add("decode_cancelled")
+                stat_set("decode_queue_depth", 0)
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        from ..observe import flight as _flight
+
+        _flight.record("serving/decode_stop", name=self.name,
+                       drain=bool(drain))
+
+    def __enter__(self) -> "DecodeEngine":
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop(drain=exc[0] is None)
+        return False
+
+    # -- scheduler --------------------------------------------------------
+    @property
+    def live_slots(self) -> int:
+        return sum(1 for s in self._slots if s is not None)
+
+    @property
+    def free_slots(self) -> int:
+        return self.config.slots - self.live_slots
+
+    @property
+    def queue_depth(self) -> int:
+        with self._cond:
+            return len(self._queue)
+
+    def _expire(self, req, where: str) -> None:
+        if req._finish(error=DeadlineExceededError(
+                f"deadline exceeded {where}")):
+            stat_add("decode_deadline_exceeded")
+
+    def _reap_queue_locked(self):
+        now = time.monotonic()
+        live = []
+        for r in self._queue:
+            if r.done():
+                continue
+            if r.expired(now):
+                self._expire(r, "while queued")
+                continue
+            live.append(r)
+        if len(live) != len(self._queue):
+            self._queue = collections.deque(live)
+            stat_set("decode_queue_depth", len(self._queue))
+
+    def _admit_locked(self):
+        import jax
+
+        if not self._continuous and self.live_slots:
+            return []  # one-shot baseline: groups never mix
+        admitted = []
+        while self._queue:
+            free = [i for i, s in enumerate(self._slots) if s is None]
+            if not free:
+                break
+            req = self._queue[0]
+            if req.done():
+                self._queue.popleft()
+                continue
+            if req.expired():
+                self._queue.popleft()
+                self._expire(req, "while queued")
+                continue
+            # conservative reservation: pages for the worst case, so a
+            # decode step can never die on cache exhaustion mid-flight
+            need = len(req.prompt) + req.max_new_tokens
+            if not self._cache.claim(free[0], need):
+                stat_add("decode_admission_blocked_pages")
+                break  # FIFO head-of-line: wait for pages to free
+            self._queue.popleft()
+            slot = free[0]
+            self._slots[slot] = _SlotState(
+                req, jax.random.PRNGKey(req.seed))
+            admitted.append((slot, req))
+        stat_set("decode_queue_depth", len(self._queue))
+        return admitted
+
+    def _release(self, slot: int):
+        self._slots[slot] = None
+        self._cache.release(slot)
+        stat_set("decode_free_pages", self._cache.allocator.num_free)
+
+    def _finish_slot(self, slot: int, error=None):
+        st = self._slots[slot]
+        if error is None:
+            if st.req._finish():
+                stat_add("decode_completed")
+        else:
+            if st.req._finish(error=error):
+                stat_add("decode_failed")
+        self._release(slot)
+
+    def _reap_live(self):
+        """The mid-decode deadline reap: runs at EVERY step boundary so
+        a stalled/abandoned client frees its slot now, not after
+        max_new_tokens."""
+        now = time.monotonic()
+        for i, st in enumerate(self._slots):
+            if st is None:
+                continue
+            if st.req.done():  # client-side reap/abandon won the race
+                stat_add("decode_abandoned")
+                self._release(i)
+            elif st.req.expired(now):
+                self._expire(st.req, "mid-decode (slot freed)")
+                self._release(i)
+
+    def _loop(self):
+        while True:
+            with self._cond:
+                if self._abort:
+                    for i, st in enumerate(self._slots):
+                        if st is not None:
+                            self._finish_slot(i, ServerClosedError(
+                                "engine stopped mid-generation"))
+                    return
+                self._reap_queue_locked()
+                admitted = self._admit_locked()
+                if not admitted and not self.live_slots:
+                    if self._closing and not self._queue:
+                        return
+                    # short cap keeps queued deadlines (and a pages-
+                    # blocked head) honest while idle
+                    self._cond.wait(0.05 if self._queue else None)
+                    continue
+            for slot, req in admitted:
+                self._run_prefill(slot, req)
+            self._reap_live()
+            if self.live_slots:
+                self._run_step()
+
+    # -- device work ------------------------------------------------------
+    def _run_prefill(self, slot: int, req: DecodeRequest):
+        import jax.numpy as jnp
+
+        st = self._slots[slot]
+        try:
+            t_pad = self._buckets.seq_bucket(len(req.prompt))
+            tokens = np.zeros((t_pad,), np.int32)
+            tokens[:len(req.prompt)] = req.prompt
+            t0 = time.monotonic()
+            with otrace.span("serving/decode_prefill", slot=slot,
+                             bucket=t_pad):
+                tok, last = self._exe.run_persistent(
+                    self._prefill_fn(t_pad), _STATE_VARS,
+                    args=(self.weights, jnp.asarray(tokens),
+                          np.int32(len(req.prompt)),
+                          jnp.asarray(self._cache.page_table[slot]),
+                          st.base_key,
+                          np.float32(req.temperature),
+                          np.int32(req.top_k),
+                          np.float32(req.top_p)),
+                    scope=self._scope)
+            stat_time("decode_prefill_seconds", time.monotonic() - t0)
+            stat_add("decode_prefills")
+            self._cache.lengths[slot] = len(req.prompt)
+            if req.record_logits:
+                req.logits_trace.append(np.asarray(last))
+            self._deliver(slot, int(np.asarray(tok)))
+        except Exception as e:  # noqa: BLE001 — fault isolation per req
+            stat_add("decode_prefill_errors")
+            self._finish_slot(slot, e)
+
+    def _deliver(self, slot: int, token: int):
+        """Account one sampled token for a live slot; finish + free the
+        slot the moment its request is done."""
+        st = self._slots[slot]
+        now = time.monotonic()
+        if st.n_generated > 0:
+            stat_time("tpot_seconds", now - st.t_last)
+        st.t_last = now
+        st.n_generated += 1
+        st.last_token = token
+        self.tokens_total += 1
+        stat_add("decode_tokens_total")
+        st.req._emit(token)
+        eos = self.config.eos_id
+        if (eos is not None and token == eos) \
+                or st.n_generated >= st.req.max_new_tokens:
+            self._finish_slot(slot)
+
+    def _run_step(self):
+        import jax.numpy as jnp
+
+        c = self._cache.config
+        s = c.num_slots
+        live_idx = [i for i, st in enumerate(self._slots)
+                    if st is not None]
+        if not live_idx:
+            return
+        tokens = np.zeros((s,), np.int32)
+        positions = np.zeros((s,), np.int32)
+        live = np.zeros((s,), bool)
+        write_page = np.zeros((s,), np.int32)
+        write_off = np.zeros((s,), np.int32)
+        counters = np.zeros((s,), np.int32)
+        temp = np.zeros((s,), np.float32)
+        top_k = np.zeros((s,), np.int32)
+        top_p = np.ones((s,), np.float32)
+        base_keys = np.zeros((s, 2), np.uint32)
+        for i in live_idx:
+            st = self._slots[i]
+            tokens[i] = st.last_token
+            positions[i] = self._cache.lengths[i]
+            live[i] = True
+            write_page[i], write_off[i] = self._cache.write_coords(i)
+            counters[i] = st.n_generated
+            temp[i] = st.req.temperature
+            top_k[i] = st.req.top_k
+            top_p[i] = st.req.top_p
+            base_keys[i] = np.asarray(st.base_key)
+        t0 = time.monotonic()
+        try:
+            with otrace.span("serving/decode_step", live=len(live_idx)):
+                nxt, logits = self._exe.run_persistent(
+                    self._step_fn, _STATE_VARS,
+                    args=(self.weights, jnp.asarray(tokens),
+                          jnp.asarray(positions), jnp.asarray(live),
+                          jnp.asarray(self._cache.page_table),
+                          jnp.asarray(write_page),
+                          jnp.asarray(write_off),
+                          jnp.asarray(base_keys), jnp.asarray(counters),
+                          jnp.asarray(temp), jnp.asarray(top_k),
+                          jnp.asarray(top_p)),
+                    scope=self._scope)
+                nxt = np.asarray(nxt)  # THE per-step sync point
+        except Exception as e:  # noqa: BLE001 — fail the batch loudly,
+            # free every slot, keep the consumer thread alive
+            stat_add("decode_step_errors")
+            for i in live_idx:
+                self._finish_slot(i, e)
+            return
+        stat_time("decode_step_seconds", time.monotonic() - t0)
+        logits_np = None
+        for i in live_idx:
+            st = self._slots[i]
+            self._cache.lengths[i] += 1
+            if st.req.record_logits:
+                if logits_np is None:
+                    logits_np = np.asarray(logits)
+                st.req.logits_trace.append(logits_np[i].copy())
+            self._deliver(i, int(nxt[i]))
+        occ = self.live_slots
+        stat_set("decode_slot_occupancy", occ)
+        stat_max("decode_slot_occupancy_max", len(live_idx))
+        stat_add("decode_steps")
+
+    # -- oracle / observability ------------------------------------------
+    def recompute_logits(self, tokens: Sequence[int]) -> np.ndarray:
+        """Full-recompute oracle: run the ENTIRE sequence through the
+        prefill path from scratch (no cache reuse) and return the last
+        position's logits.  Runs on THROWAWAY page pools — the prefill
+        body only ever WRITES pages (its attention reads the locally
+        built K/V, so fresh zero pools are numerically identical), and
+        touching the live pools would race the engine thread's donating
+        step.  Safe to call while the engine is serving.
+        ``tests/test_decode_engine.py`` compares this bitwise against
+        the streamed decode logits at every step."""
+        import jax
+        import jax.numpy as jnp
+
+        tokens = [int(t) for t in tokens]
+        t_pad = self._buckets.seq_bucket(len(tokens))
+        arr = np.zeros((t_pad,), np.int32)
+        arr[:len(tokens)] = tokens
+        cc = self._cache.config
+        shape = (cc.num_layers, cc.num_pages, cc.page_size, cc.num_heads,
+                 cc.head_dim)
+        scratch = (jnp.zeros(shape, cc.dtype), jnp.zeros(shape, cc.dtype))
+        (tok, last), _ = self._prefill_fn(t_pad)(
+            scratch, self.weights, jnp.asarray(arr),
+            np.int32(len(tokens)),
+            jnp.zeros((cc.pages_per_slot,), jnp.int32),
+            jax.random.PRNGKey(0), np.float32(0.0), np.int32(0),
+            np.float32(1.0))
+        return np.asarray(last)
+
+    def stats(self) -> dict:
+        with self._cond:
+            depth = len(self._queue)
+        return {
+            "name": self.name,
+            "slots": self.config.slots,
+            "live_slots": self.live_slots,
+            "free_slots": self.free_slots,
+            "queue_depth": depth,
+            "tokens_total": self.tokens_total,
+            "free_pages": self._cache.allocator.num_free,
+            "num_pages": self._cache.config.num_pages,
+            "cache_bytes": self._cache.config.cache_bytes(),
+            "continuous": self._continuous,
+        }

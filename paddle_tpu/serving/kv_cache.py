@@ -1,0 +1,190 @@
+"""Paged per-slot KV cache for autoregressive decode (serving/decode.py).
+
+Layout (the vLLM PagedAttention idea, TPU-native): all keys/values for
+every serving slot live in TWO device arrays of fixed-size pages
+
+    k_pages, v_pages : [num_layers, num_pages, page_size, heads, head_dim]
+
+and each slot owns an ordered list of page ids (its *page table*).  A
+slot's logical sequence position ``t`` maps to page ``table[t // page]``
+offset ``t % page``.  Pages are allocated from a host-side free list at
+admission and returned the moment a request finishes — a finished slot
+frees its memory immediately instead of padding to the longest request
+in a batch.
+
+Page 0 is the TRASH page: it is never allocated, dead slots' per-step
+writes land there, and an empty page-table entry points at it.  Reads
+are always masked by the slot's live length, so trash contents are
+never observable.
+
+The device arrays themselves are registered in a ``framework.Scope``
+and threaded through ``Executor.run_persistent`` with donation — the
+cache never round-trips to host between steps.
+
+Admission is conservative: a request reserves
+``ceil((prompt_len + max_new_tokens) / page_size)`` pages up front, so
+a decode step can never fail on cache exhaustion mid-generation (the
+price is vLLM-style optimistic over-commit is out of scope; the
+allocator still shares one pool across slots, so short requests leave
+room for more concurrent long ones than a dense [slots, max_seq] layout
+would).
+"""
+from __future__ import annotations
+
+import math
+import threading
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+K_PAGES_VAR = "__decode_k_pages__"
+V_PAGES_VAR = "__decode_v_pages__"
+
+
+class CacheExhaustedError(RuntimeError):
+    """The page pool cannot cover a request's worst-case reservation."""
+
+
+class CacheConfig:
+    """Geometry of the paged cache (everything static / compile-time)."""
+
+    def __init__(self, num_layers: int, num_heads: int, head_dim: int,
+                 num_slots: int, max_seq_len: int, page_size: int,
+                 num_pages: Optional[int] = None, dtype="float32"):
+        if max_seq_len % page_size:
+            raise ValueError(
+                f"max_seq_len ({max_seq_len}) must be a multiple of "
+                f"page_size ({page_size})")
+        self.num_layers = int(num_layers)
+        self.num_heads = int(num_heads)
+        self.head_dim = int(head_dim)
+        self.num_slots = int(num_slots)
+        self.max_seq_len = int(max_seq_len)
+        self.page_size = int(page_size)
+        self.pages_per_slot = self.max_seq_len // self.page_size
+        # default pool: every slot can hold a max-length sequence, plus
+        # the reserved trash page — admission then only ever blocks on
+        # free SLOTS, never pages.  A smaller explicit pool exercises
+        # real paging pressure (admission waits for pages).
+        self.num_pages = int(num_pages) if num_pages is not None \
+            else self.num_slots * self.pages_per_slot + 1
+        if self.num_pages < 2:
+            raise ValueError("num_pages must be >= 2 (page 0 is trash)")
+        self.dtype = np.dtype(dtype)
+
+    def pages_for(self, seq_len: int) -> int:
+        return max(1, math.ceil(int(seq_len) / self.page_size))
+
+    def page_bytes(self) -> int:
+        return (self.page_size * self.num_heads * self.head_dim
+                * self.dtype.itemsize)
+
+    def cache_bytes(self) -> int:
+        """Total device bytes of BOTH page arrays (k + v)."""
+        return 2 * self.num_layers * self.num_pages * self.page_bytes()
+
+
+class PageAllocator:
+    """Host-side free list over page ids 1..num_pages-1 (0 is trash)."""
+
+    def __init__(self, num_pages: int):
+        self._free: List[int] = list(range(num_pages - 1, 0, -1))
+        self._lock = threading.Lock()
+
+    @property
+    def num_free(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """Take n pages, or None (atomically nothing) when the pool
+        cannot cover the request."""
+        with self._lock:
+            if n > len(self._free):
+                return None
+            taken = self._free[-n:]
+            del self._free[-n:]
+            return list(reversed(taken))
+
+    def free(self, pages: Sequence[int]) -> None:
+        with self._lock:
+            for p in pages:
+                if p != 0:
+                    self._free.append(int(p))
+
+
+class PagedKVCache:
+    """Host bookkeeping (page tables, lengths, allocator) + the device
+    page arrays, which live in ``scope`` so Executor.run_persistent can
+    donate them through each decode step."""
+
+    def __init__(self, config: CacheConfig, scope):
+        import jax.numpy as jnp
+
+        self.config = config
+        self.scope = scope
+        self.allocator = PageAllocator(config.num_pages)
+        c = config
+        # per-slot host mirrors: the scheduler reads/writes these; the
+        # device sees them as small per-step i32 feeds
+        self.page_table = np.zeros((c.num_slots, c.pages_per_slot),
+                                   np.int32)
+        self.lengths = np.zeros((c.num_slots,), np.int32)
+        self._slot_pages: List[List[int]] = [[] for _ in range(c.num_slots)]
+        shape = (c.num_layers, c.num_pages, c.page_size, c.num_heads,
+                 c.head_dim)
+        scope.set_var(K_PAGES_VAR, jnp.zeros(shape, c.dtype))
+        scope.set_var(V_PAGES_VAR, jnp.zeros(shape, c.dtype))
+
+    # -- slot lifecycle ---------------------------------------------------
+    def claim(self, slot: int, reserve_tokens: int) -> bool:
+        """Reserve pages covering ``reserve_tokens`` positions for the
+        slot; False when the pool can't cover it (caller retries later)."""
+        n = self.config.pages_for(reserve_tokens)
+        pages = self.allocator.alloc(n)
+        if pages is None:
+            return False
+        self._slot_pages[slot] = pages
+        row = np.zeros((self.config.pages_per_slot,), np.int32)
+        row[:n] = pages
+        self.page_table[slot] = row
+        self.lengths[slot] = 0
+        return True
+
+    def release(self, slot: int) -> None:
+        self.allocator.free(self._slot_pages[slot])
+        self._slot_pages[slot] = []
+        self.page_table[slot] = 0
+        self.lengths[slot] = 0
+
+    def slot_pages(self, slot: int) -> List[int]:
+        return list(self._slot_pages[slot])
+
+    def write_coords(self, slot: int):
+        """(page_id, offset) for the NEXT position of the slot."""
+        t = int(self.lengths[slot])
+        return (int(self.page_table[slot][t // self.config.page_size]),
+                t % self.config.page_size)
+
+    def arrays(self):
+        return (self.scope.get_var(K_PAGES_VAR),
+                self.scope.get_var(V_PAGES_VAR))
+
+
+# -- pure jit-side helpers (operate on the page arrays functionally) ------
+
+def scatter_token_layer(pages, layer: int, val, page_id, offset):
+    """Write one new position per slot: val [S, H, D] lands at
+    (layer, page_id[s], offset[s]) — dead slots pass page 0 (trash)."""
+    return pages.at[layer, page_id, offset].set(
+        val.astype(pages.dtype))
+
+
+def scatter_prompt_layer(pages, layer: int, val, page_ids):
+    """Write a whole prompt's positions for one slot: val
+    [n_pages*page, H, D] (padded to a page multiple) is stored page-
+    wholesale into ``page_ids`` [n_pages]."""
+    n = page_ids.shape[0]
+    page = pages.shape[2]
+    v = val.reshape(n, page, val.shape[1], val.shape[2])
+    return pages.at[layer, page_ids].set(v.astype(pages.dtype))

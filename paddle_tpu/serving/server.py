@@ -200,3 +200,123 @@ class Server:
             "buckets": self._config.bucket_spec.n_buckets(),
             "compiles": stat_get("executor_compile"),
         }
+
+
+class DecodeServer:
+    """N replicated decode engines (serving/decode.py) behind ONE
+    admission point with least-loaded dispatch — the generative
+    counterpart of ``Server``.
+
+    Every replica is a full ``DecodeEngine``: its own Executor, slot
+    batch, and paged KV cache, all fed from the shared (read-only)
+    weight arrays.  ``submit`` routes each request to the replica with
+    the most free slots (ties: shortest queue), falling back across
+    replicas when one's queue is full.  Per-request sampling is keyed
+    by the request's own seed, so WHICH replica serves a request never
+    changes its tokens (tests/test_decode_engine.py pins 2-replica parity).
+
+    ``http_port`` serves GET ``/stats`` (aggregate + one entry per
+    replica), ``/health``, and ``/metrics`` (Prometheus; includes
+    decode_tokens_total, decode_slot_occupancy, ttft_seconds /
+    tpot_seconds histograms)."""
+
+    def __init__(self, model, weights, config=None, replicas: int = 1,
+                 http_port: Optional[int] = None):
+        from .decode import DecodeConfig, DecodeEngine
+
+        if replicas < 1:
+            raise ValueError("replicas must be >= 1")
+        self._config = config or DecodeConfig()
+        self._engines = [
+            DecodeEngine(model, weights, self._config,
+                         name=f"replica-{i}")
+            for i in range(replicas)
+        ]
+        self._http_port = http_port
+        self._kv = None
+        self._t_start = None
+        self._started = False
+
+    @property
+    def replicas(self):
+        return list(self._engines)
+
+    # -- request path ----------------------------------------------------
+    def _pick(self):
+        """Least-loaded dispatch order: most free slots first, then
+        shortest queue (a replica with a free slot starts the request
+        at the NEXT step boundary; one with a queue adds wait)."""
+        return sorted(self._engines,
+                      key=lambda e: (-e.free_slots, e.queue_depth))
+
+    def submit(self, prompt, **kw):
+        from .buckets import QueueFullError
+
+        last_err = None
+        for eng in self._pick():
+            try:
+                return eng.submit(prompt, **kw)
+            except QueueFullError as e:
+                last_err = e
+        raise last_err
+
+    def generate(self, prompt, **kw):
+        return self.submit(prompt, **kw).result()
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> "DecodeServer":
+        if self._started:
+            return self
+        for eng in self._engines:
+            eng.start()
+        if self._http_port is not None:
+            from ..distributed.fleet.utils.http_server import KVServer
+
+            self._kv = KVServer(self._http_port,
+                                routes={"/stats": self.stats,
+                                        "/health": self.health})
+            self._kv.start()
+        self._t_start = time.monotonic()
+        self._started = True
+        return self
+
+    def stop(self, drain: bool = True):
+        for eng in self._engines:
+            eng.stop(drain=drain)
+        if self._kv is not None:
+            self._kv.stop()
+            self._kv = None
+        self._started = False
+
+    def __enter__(self) -> "DecodeServer":
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop(drain=exc[0] is None)
+        return False
+
+    # -- observability ---------------------------------------------------
+    @property
+    def http_port(self) -> Optional[int]:
+        return self._kv.port if self._kv is not None else None
+
+    def stats(self) -> Dict:
+        per = [e.stats() for e in self._engines]
+        return {
+            "replicas": per,
+            "n_replicas": len(per),
+            "tokens_total": sum(p["tokens_total"] for p in per),
+            "live_slots": sum(p["live_slots"] for p in per),
+            "free_slots": sum(p["free_slots"] for p in per),
+            "queue_depth": sum(p["queue_depth"] for p in per),
+        }
+
+    def health(self) -> Dict:
+        return {
+            "status": "ok" if self._started else "stopped",
+            "replicas": len(self._engines),
+            "free_slots": sum(e.free_slots for e in self._engines),
+            "queue_depth": sum(e.queue_depth for e in self._engines),
+            "uptime_s": round(time.monotonic() - self._t_start, 3)
+            if self._t_start is not None else 0.0,
+        }

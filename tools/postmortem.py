@@ -199,7 +199,7 @@ def render(bundle: str, tail: int = 15, stacks: bool = False,
                 "postmortem_", "cluster_", "ckpt_saves", "ckpt_save_f",
                 "health_", "hbm_", "executable_size", "mfu_flops",
                 "compile_seconds_count", "executable_hlo_ops",
-                "pass_layer_scan")
+                "pass_layer_scan", "decode_", "ttft_", "tpot_")
         for ln in rows:
             if metrics or any(k in ln for k in keys):
                 w(f"  {ln}\n")

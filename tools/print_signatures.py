@@ -52,6 +52,8 @@ MODULES = [
     "paddle_tpu.ckpt",
     "paddle_tpu.framework.passes",
     "paddle_tpu.serving",
+    "paddle_tpu.serving.decode",
+    "paddle_tpu.serving.kv_cache",
     "paddle_tpu.utils",
     "paddle_tpu.nn.utils",
     "paddle_tpu.nn.initializer",
