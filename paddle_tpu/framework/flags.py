@@ -267,3 +267,26 @@ define_flag("decode_max_new_tokens", 64,
             "decode engine: default generation budget when a request "
             "does not pass max_new_tokens; admission reserves cache "
             "pages for prompt + this many positions")
+define_flag("decode_prefix_cache", True,
+            "decode engine: share KV-cache pages across requests whose "
+            "prompts open with the same token prefix "
+            "(serving/kv_cache.py PrefixIndex) — admission skips both "
+            "the HBM reservation AND the prefill compute for hit "
+            "pages, with refcounts + copy-on-write at the first "
+            "divergent token; finished requests register their pages "
+            "for future hits (evicted LRU under pool pressure)")
+define_flag("decode_prefill_chunk_pages", 0,
+            "decode engine: chunked prefill — a prompt longer than "
+            "this many cache pages fills them across SEVERAL step "
+            "boundaries instead of stalling the whole slot batch on "
+            "one long prefill dispatch (protects ttft_ms_p99 for the "
+            "slots already decoding); 0 = off (one prefill dispatch "
+            "per request)")
+define_flag("decode_spec_k", 0,
+            "decode engine: speculative decoding window — a draft "
+            "model (DecodeEngine(draft_model=, draft_weights=)) "
+            "proposes this many tokens per round and the target model "
+            "verifies them in ONE batched step; greedy output stays "
+            "bitwise-identical to non-speculative decode (rejected "
+            "proposals fall back to the target's own token); 0 = off, "
+            "ignored unless a draft model is configured")

@@ -24,6 +24,12 @@ accumulates across pages exactly like the prefill flash kernel.
 page table (O(S * max_seq) materialization) and do masked attention.
 It is also the CPU-backend default so tier-1 stays green without
 Mosaic; ``interpret=True`` runs the real kernel on CPU for tests.
+
+``paged_chunk_attention`` generalizes the kernel to R query rows per
+slot with per-row causal lengths over one shared page table — the
+attention shape of chunked/suffix prefill and speculative verification
+(serving/decode.py), where shared and partially-filled pages need no
+special casing beyond the mask.
 """
 from __future__ import annotations
 
@@ -177,3 +183,145 @@ def paged_decode_attention(q, k_pages, v_pages, page_table, lengths, *,
     v = v_pages[page_table].reshape(s, pps * page, *v_pages.shape[2:])
     return decode_attention_reference(q, k, v, lengths,
                                       sm_scale=sm_scale)
+
+
+# -- multi-row variant: chunked prefill + speculative verify --------------
+
+
+def _chunk_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                  m_scr, l_scr, acc_scr, *, sm_scale, page, n_pages,
+                  n_rows):
+    """The decode kernel generalized to R query rows per slot (a
+    prefill chunk or a speculative t0+draft window).  Row r of slot s
+    attends positions ``t < len_ref[s*R + r]`` — per-row causal masks
+    over one shared page table, so shared and partially-filled pages
+    need no special casing beyond the mask."""
+    import jax.experimental.pallas as pl
+
+    s_idx = pl.program_id(0)
+    p_idx = pl.program_id(1)
+
+    @pl.when(p_idx == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    # the widest row bounds whether this page matters at all — taken
+    # over ALL rows, so the contract holds for arbitrary (not just
+    # ascending) per-row lengths
+    row_len = jnp.stack(
+        [len_ref[s_idx * n_rows + r] for r in range(n_rows)])
+    max_len = jnp.max(row_len)
+
+    @pl.when(p_idx * page < max_len)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)              # (R, H, D)
+        k = k_ref[0].astype(jnp.float32)              # (page, H, D)
+        v = v_ref[0].astype(jnp.float32)
+        # scores per head per row over this page: (H, R, page)
+        s = lax.dot_general(
+            q, k, (((2,), (2,)), ((1,), (1,))),
+            preferred_element_type=jnp.float32) * sm_scale
+        pos = p_idx * page + lax.broadcasted_iota(jnp.int32, s.shape, 2)
+        s = jnp.where(pos < row_len[None, :, None], s, _NEG_INF)
+
+        m_prev = m_scr[:, :, :1]                       # (H, R, 1)
+        m_cur = jnp.max(s, axis=2, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)                         # (H, R, page)
+        l_new = alpha * l_scr[:, :, :1] \
+            + jnp.sum(p, axis=2, keepdims=True)
+        acc_scr[...] = acc_scr[...] * alpha + lax.dot_general(
+            p, v, (((2,), (0,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32)        # (H, R, D)
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(p_idx == n_pages - 1)
+    def _flush():
+        l = l_scr[:, :, :1]
+        out = acc_scr[...] / jnp.where(l == 0.0, 1.0, l)  # (H, R, D)
+        o_ref[0] = out.transpose(1, 0, 2).astype(o_ref.dtype)
+
+
+def _chunk_call(q, k_pages, v_pages, page_table, row_lengths, sm_scale,
+                interpret):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n_slots, n_rows, h, d = q.shape
+    pps = page_table.shape[1]
+    page = k_pages.shape[1]
+    flat_table = page_table.reshape(-1).astype(jnp.int32)
+    flat_lengths = row_lengths.reshape(-1).astype(jnp.int32)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # (flat page table, flat row lengths)
+        grid=(n_slots, pps),
+        in_specs=[
+            pl.BlockSpec((1, n_rows, h, d),
+                         lambda s, p, pt, ln: (s, 0, 0, 0)),
+            pl.BlockSpec((1, page, h, d),
+                         lambda s, p, pt, ln: (pt[s * pps + p], 0, 0, 0)),
+            pl.BlockSpec((1, page, h, d),
+                         lambda s, p, pt, ln: (pt[s * pps + p], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, n_rows, h, d),
+                               lambda s, p, pt, ln: (s, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((h, n_rows, _LANES), jnp.float32),  # running max
+            pltpu.VMEM((h, n_rows, _LANES), jnp.float32),  # denominator
+            pltpu.VMEM((h, n_rows, d), jnp.float32),       # accumulator
+        ],
+    )
+    kern = functools.partial(_chunk_kernel, sm_scale=sm_scale,
+                             page=page, n_pages=pps, n_rows=n_rows)
+    return pl.pallas_call(
+        kern,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((n_slots, n_rows, h, d), q.dtype),
+        interpret=interpret,
+    )(flat_table, flat_lengths, q, k_pages, v_pages)
+
+
+def paged_chunk_attention(q, k_pages, v_pages, page_table, row_lengths,
+                          *, sm_scale=None, use_pallas="auto",
+                          interpret=False):
+    """Multi-row attention off the page pool — R query rows per slot.
+
+    q [S,R,H,D]; k/v_pages [P,page,H,D] (ONE layer's pool); page_table
+    [S,pps] i32; row_lengths [S,R] i32 — row r of slot s attends
+    positions ``t < row_lengths[s, r]``.  Serves both tentpole callers
+    in serving/decode.py: chunked prefill (R = chunk rows, one slot at
+    a time) and speculative-decode verification (R = 1 + draft window,
+    every slot jointly).  The reference path broadcasts each slot's
+    gathered K/V across its rows and reuses
+    ``decode_attention_reference`` VERBATIM — the single masked-softmax
+    formulation at one width that keeps every cache path bitwise-equal
+    to the full-recompute oracle.  ``use_pallas`` dispatch matches
+    ``paged_decode_attention``.
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    if use_pallas == "auto":
+        use_pallas = "always" if jax.default_backend() == "tpu" \
+            else "never"
+    if use_pallas == "always":
+        return _chunk_call(q, k_pages, v_pages, page_table, row_lengths,
+                           float(sm_scale), interpret)
+    s, r = q.shape[:2]
+    pps = page_table.shape[1]
+    page = k_pages.shape[1]
+    t = pps * page
+    k = k_pages[page_table].reshape(s, t, *k_pages.shape[2:])
+    v = v_pages[page_table].reshape(s, t, *v_pages.shape[2:])
+    kr = jnp.broadcast_to(k[:, None], (s, r) + k.shape[1:]) \
+        .reshape(s * r, *k.shape[1:])
+    vr = jnp.broadcast_to(v[:, None], (s, r) + v.shape[1:]) \
+        .reshape(s * r, *v.shape[1:])
+    out = decode_attention_reference(
+        q.reshape((s * r,) + q.shape[2:]), kr, vr,
+        row_lengths.reshape(-1), sm_scale=sm_scale)
+    return out.reshape(q.shape)

@@ -13,11 +13,16 @@ deployment story, rebuilt TPU-native over the compile-once Predictor:
   gracefully on stop;
 - the GENERATIVE path (decode.py + kv_cache.py): ``DecodeEngine``
   runs autoregressive decode over a fixed slot batch with a paged,
-  device-resident KV cache (Pallas paged-attention kernel on TPU),
+  device-resident KV cache (Pallas paged-attention kernels on TPU),
   continuous batching at step boundaries, streaming token replies,
-  and deadline reaping mid-decode; ``DecodeServer`` replicates N
-  engines behind one least-loaded admission point with per-replica
-  ``/stats``.
+  and deadline reaping mid-decode; prefix-cache page sharing
+  (``PrefixIndex`` refcounts + copy-on-write) lets same-prefix
+  prompts skip both HBM and prefill compute, chunked prefill keeps
+  long prompts from stalling the slot batch, and speculative
+  decoding (draft model + one batched verify) multiplies greedy
+  tokens-per-dispatch bitwise-losslessly; ``DecodeServer``
+  replicates N engines behind one least-loaded admission point with
+  per-replica ``/stats``.
 """
 from .batcher import Batcher, InferenceRequest, RequestBase  # noqa: F401
 from .buckets import (  # noqa: F401
@@ -40,6 +45,7 @@ from .kv_cache import (  # noqa: F401
     CacheExhaustedError,
     PagedKVCache,
     PageAllocator,
+    PrefixIndex,
 )
 from .server import DecodeServer, Server, ServingConfig  # noqa: F401
 
@@ -47,7 +53,7 @@ __all__ = [
     "Batcher", "BucketSpec", "CacheConfig", "CacheExhaustedError",
     "DeadlineExceededError", "DecodeConfig", "DecodeEngine",
     "DecodeRequest", "DecodeServer", "InferenceRequest", "PageAllocator",
-    "PagedKVCache", "QueueFullError", "RequestBase",
+    "PagedKVCache", "PrefixIndex", "QueueFullError", "RequestBase",
     "RequestTooLargeError", "Server", "ServerClosedError",
     "ServingConfig", "ServingError", "TransformerLM",
     "prefill_bucket_grid",

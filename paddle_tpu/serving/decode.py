@@ -1,4 +1,5 @@
-"""KV-cache autoregressive decode engine with continuous batching.
+"""KV-cache autoregressive decode engine with continuous batching,
+prefix-cache page sharing, chunked prefill, and speculative decoding.
 
 Role parity: the generative-serving half of Paddle Serving / the
 reference's inference deployment story — the piece the PR-1 one-shot
@@ -13,12 +14,33 @@ latency to every new arrival.  This engine is the TPU-native fix:
   ``Executor.run_persistent`` with donation, so the cache NEVER
   round-trips to host between steps — per-token work is O(1) in the
   prefix length.
+- **Prefix sharing** (``FLAGS_decode_prefix_cache``, default on): at
+  millions of users most prompts open with the same system/template
+  prefix.  Finished requests register their pages in an exact-content
+  trie; admission shares every matched page into the new slot's table
+  with a refcount bump — skipping both the HBM reservation AND the
+  prefill compute for hit pages (an exactly-matched prompt skips
+  prefill entirely: the first token comes out of the first decode
+  step).  A borrowed partial tail page is copy-on-written at the first
+  divergent token, from a spare reserved at admission so a decode step
+  still can never die on cache exhaustion.
+- **Chunked prefill** (``FLAGS_decode_prefill_chunk_pages``): a long
+  prompt fills its pages across SEVERAL step boundaries (one chunk per
+  engine-loop iteration) instead of stalling the whole slot batch on
+  one long prefill dispatch — the slots already decoding keep emitting
+  tokens, protecting ``ttft_ms_p99`` for everyone else.
+- **Speculative decoding** (``FLAGS_decode_spec_k`` + a draft model):
+  a small draft proposes k tokens in ONE device dispatch (its own page
+  pools share the target's page ids, so prefix sharing and CoW cover
+  it for free) and the target verifies all k+1 positions in ONE
+  batched step.  Greedy output is BITWISE-identical to non-speculative
+  decode: every emitted token is the target's own argmax, proposals
+  only decide how many arrive per dispatch.
 - **Continuous batching** (Orca's iteration-level scheduling): one
   jitted step decodes every live slot jointly; new requests claim free
-  slots at step boundaries (prefill fills the slot's pages, decode
-  proceeds with the batch that's already in flight), and a slot whose
-  request finishes — EOS, token budget, or deadline — frees
-  IMMEDIATELY instead of padding to the longest neighbor.
+  slots at step boundaries, and a slot whose request finishes — EOS,
+  token budget, or deadline — frees IMMEDIATELY instead of padding to
+  the longest neighbor.
 - **Deadline reap mid-decode**: a lapsed deadline is honored at every
   step boundary (not just at dequeue), so a stalled client cannot pin
   a slot for the full max_new_tokens.
@@ -34,17 +56,20 @@ latency to every new arrival.  This engine is the TPU-native fix:
   ``DecodeServer``) relies on.
 
 Attention reads the page pool through
-``ops/pallas_decode_attention.py``: the Pallas kernel on TPU (page
+``ops/pallas_decode_attention.py``: the Pallas kernels on TPU (page
 table as scalar-prefetch operands — one page DMA per grid step), the
-pure-jnp gather+mask reference on CPU so tier-1 stays green.  Prefill
-and decode share one masked-softmax formulation at one width
-(max_seq_len), which is what makes decode-with-cache logits
-bitwise-equal to a full recompute (`tests/test_decode_engine.py` pins it at
-every step).
+pure-jnp gather+mask reference on CPU so tier-1 stays green.  Every
+path — prefill, chunked prefill, decode, speculative verify — shares
+ONE masked-softmax formulation at one width, which is what makes
+decode-with-cache logits bitwise-equal to a full recompute
+(`tests/test_decode_engine.py` + `tests/test_decode_prefix_spec.py`
+pin it at every step on every path).
 
-Observability: ``decode_*`` counters/gauges plus ``ttft_seconds`` /
-``tpot_seconds`` / ``decode_step_seconds`` histograms — all on
-``/metrics`` wherever a fleet KV HTTP server runs.
+Observability: ``decode_*`` counters/gauges (``decode_cache_hit_rate``,
+``decode_shared_pages``, ``decode_cow_copies``, ``spec_accept_rate``,
+``prefill_chunks``, ...) plus ``ttft_seconds`` / ``tpot_seconds`` /
+``decode_step_seconds`` histograms — all on ``/metrics`` wherever a
+fleet KV HTTP server runs.
 """
 from __future__ import annotations
 
@@ -57,7 +82,7 @@ from typing import Callable, List, Optional, Sequence
 
 import numpy as np
 
-from ..monitor import stat_add, stat_max, stat_set
+from ..monitor import stat_add, stat_get, stat_max, stat_set
 from ..observe import tracer as otrace
 from ..observe.histogram import stat_time
 from .batcher import _UNSET, RequestBase
@@ -67,7 +92,11 @@ from .buckets import (BucketSpec, DeadlineExceededError, QueueFullError,
 from . import kv_cache
 from .kv_cache import CacheConfig, PagedKVCache, K_PAGES_VAR, V_PAGES_VAR
 
+DRAFT_K_PAGES_VAR = "__decode_draft_k_pages__"
+DRAFT_V_PAGES_VAR = "__decode_draft_v_pages__"
+
 _STATE_VARS = (K_PAGES_VAR, V_PAGES_VAR)
+_DRAFT_VARS = (DRAFT_K_PAGES_VAR, DRAFT_V_PAGES_VAR)
 _DONE = object()  # stream sentinel
 
 
@@ -180,12 +209,14 @@ class DecodeRequest(RequestBase):
 
     __slots__ = ("prompt", "max_new_tokens", "temperature", "top_k",
                  "top_p", "seed", "on_token", "generated", "_stream",
-                 "t_first_token", "record_logits", "logits_trace")
+                 "t_first_token", "record_logits", "logits_trace",
+                 "speculative")
 
     _deadline_stat = "decode_deadline_exceeded"
 
     def __init__(self, prompt, max_new_tokens, deadline, temperature,
-                 top_k, top_p, seed, on_token, record_logits=False):
+                 top_k, top_p, seed, on_token, record_logits=False,
+                 speculative=None):
         super().__init__(deadline)
         self.prompt = list(prompt)
         self.max_new_tokens = int(max_new_tokens)
@@ -199,6 +230,7 @@ class DecodeRequest(RequestBase):
         self.t_first_token: Optional[float] = None
         self.record_logits = bool(record_logits)
         self.logits_trace: List[np.ndarray] = []
+        self.speculative = speculative  # None=auto, False=opt out
 
     # engine side ---------------------------------------------------------
     def _emit(self, token: int) -> None:
@@ -243,7 +275,9 @@ class DecodeRequest(RequestBase):
 
 
 class _SlotState:
-    __slots__ = ("req", "base_key", "n_generated", "last_token", "t_last")
+    __slots__ = ("req", "base_key", "n_generated", "last_token", "t_last",
+                 "phase", "prefill_pos", "write_trash_once", "spec",
+                 "draft_lag")
 
     def __init__(self, req, base_key):
         self.req = req
@@ -251,6 +285,14 @@ class _SlotState:
         self.n_generated = 0
         self.last_token = 0
         self.t_last = time.monotonic()
+        self.phase = "prefill"      # "prefill" -> "decode"
+        self.prefill_pos = 0        # next prompt position to prefill
+        self.write_trash_once = False  # cache-hit path: first decode
+        # write re-derives a position the shared pages already hold
+        self.spec = False           # speculative-decode eligible
+        self.draft_lag = 0          # trailing positions written by the
+        # normal step (target-only) on a spec slot — the draft pool is
+        # stale there, so registration excludes them
 
 
 # ---------------------------------------------------------------------------
@@ -270,7 +312,10 @@ class DecodeConfig:
                  default_deadline_ms: Optional[float] = None,
                  use_pallas: str = "auto",
                  interpret: bool = False,
-                 cache_dtype="float32"):
+                 cache_dtype="float32",
+                 prefix_cache: Optional[bool] = None,
+                 prefill_chunk_pages: Optional[int] = None,
+                 spec_k: Optional[int] = None):
         from ..framework import flags
 
         self.slots = int(slots if slots is not None
@@ -289,6 +334,14 @@ class DecodeConfig:
         self.use_pallas = use_pallas
         self.interpret = bool(interpret)
         self.cache_dtype = cache_dtype
+        self.prefix_cache = bool(
+            prefix_cache if prefix_cache is not None
+            else flags.flag("decode_prefix_cache"))
+        self.prefill_chunk_pages = int(
+            prefill_chunk_pages if prefill_chunk_pages is not None
+            else flags.flag("decode_prefill_chunk_pages"))
+        self.spec_k = int(spec_k if spec_k is not None
+                          else flags.flag("decode_spec_k"))
 
 
 class DecodeEngine:
@@ -296,11 +349,18 @@ class DecodeEngine:
     consumer thread that runs admission -> prefill -> joint decode
     step, forever.  ``continuous=False`` degrades admission to the
     one-shot group mode (a new group only starts when EVERY slot is
-    free) — the static-batching baseline bench.py's A/B uses."""
+    free) — the static-batching baseline bench.py's A/B uses.
+
+    ``draft_model``/``draft_weights`` arm speculative decoding (with
+    ``spec_k > 0``): the draft's page pools are indexed by the SAME
+    page ids as the target's, so prefix sharing, reservation
+    accounting, and copy-on-write cover both for free."""
 
     def __init__(self, model, weights, config: Optional[DecodeConfig] = None,
-                 place=None, name: str = "replica-0", continuous: bool = True):
+                 place=None, name: str = "replica-0", continuous: bool = True,
+                 draft_model=None, draft_weights=None):
         import jax
+        import jax.numpy as jnp
 
         from ..framework.executor import Executor
         from ..framework.scope import Scope
@@ -314,18 +374,50 @@ class DecodeEngine:
             raise ValueError(
                 f"DecodeConfig.max_seq_len {c.max_seq_len} exceeds the "
                 f"model's positional table ({model.max_seq_len})")
+        self._draft_model = draft_model
+        if draft_model is not None:
+            if draft_weights is None:
+                raise ValueError(
+                    "draft_model needs draft_weights for speculative "
+                    "decoding")
+            if int(draft_model.vocab_size) != int(model.vocab_size):
+                raise ValueError(
+                    f"speculative draft/target vocab mismatch: draft "
+                    f"{draft_model.vocab_size} vs target "
+                    f"{model.vocab_size} — the draft's proposals would "
+                    f"index a different token space; re-export the "
+                    f"draft with the target's vocabulary")
+            if int(draft_model.max_seq_len) < c.max_seq_len:
+                raise ValueError(
+                    f"draft positional table ({draft_model.max_seq_len})"
+                    f" is shorter than max_seq_len ({c.max_seq_len})")
         self._scope = Scope()
         self._exe = Executor(place)
         self._cache = PagedKVCache(
             CacheConfig(model.num_layers, model.num_heads, model.head_dim,
                         c.slots, c.max_seq_len, c.page_size,
                         num_pages=c.num_pages, dtype=c.cache_dtype),
-            self._scope)
+            self._scope, prefix_cache=c.prefix_cache)
         self.weights = jax.tree_util.tree_map(jax.numpy.asarray, weights)
+        if draft_model is not None:
+            self.draft_weights = jax.tree_util.tree_map(
+                jax.numpy.asarray, draft_weights)
+            cc = self._cache.config
+            dshape = (draft_model.num_layers, cc.num_pages, cc.page_size,
+                      draft_model.num_heads, draft_model.head_dim)
+            self._scope.set_var(DRAFT_K_PAGES_VAR,
+                                jnp.zeros(dshape, cc.dtype))
+            self._scope.set_var(DRAFT_V_PAGES_VAR,
+                                jnp.zeros(dshape, cc.dtype))
         self._buckets = BucketSpec(
             (1,), prefill_bucket_grid(c.max_seq_len, c.page_size))
-        self._step_fn = self._build_step_fn()
-        self._prefill_fns = {}
+        self._step_fn = self._build_step_fn(model)
+        self._prefill_fns = {}   # (t_pad, which) -> jitted prefill
+        self._rows_fns = {}      # (rows, slots, which) -> jitted multirow
+        self._propose_fn = None  # draft k-token burst (lazy)
+        self._cow_fn = None      # page copy across every pool (lazy)
+        self._cow_state = _STATE_VARS + (
+            _DRAFT_VARS if draft_model is not None else ())
         self._slots: List[Optional[_SlotState]] = [None] * c.slots
         self._queue = collections.deque()
         self._cond = threading.Condition()
@@ -333,7 +425,19 @@ class DecodeEngine:
         self._abort = False
         self._thread = None
         self._seq = 0  # default-seed counter
+        self._prefill_rr = 0  # chunked-prefill round-robin cursor
         self.tokens_total = 0
+        # per-replica tentpole accounting (stats()/DecodeServer /stats)
+        self._hit_pages = 0
+        self._prompt_pages = 0
+        self._cow_copies = 0
+        self._prefill_chunk_count = 0
+        self._spec_proposed = 0
+        self._spec_accepted = 0
+
+    @property
+    def spec_enabled(self) -> bool:
+        return self._draft_model is not None and self.config.spec_k > 0
 
     # -- jitted step builders --------------------------------------------
     def _attend(self, q, k_pages, v_pages, layer, page_table, lengths):
@@ -346,34 +450,44 @@ class DecodeEngine:
             use_pallas=self.config.use_pallas,
             interpret=self.config.interpret)
 
-    def _build_step_fn(self):
+    def _token_step_body(self, model, weights, k_pages, v_pages, tokens,
+                         positions, page_table, write_page, write_off):
+        """One single-token step of ``model`` over the page pools:
+        embed -> per-layer (write K/V at (write_page, write_off),
+        attend over the slot's live history) -> logits.  Shared
+        VERBATIM by the target step and the draft proposal burst so
+        both read the cache through the one formulation."""
+        x = model._embed(weights, tokens, positions)       # [S, Dm]
+        lengths = positions + 1  # the token written THIS step included
+        for l in range(model.num_layers):
+            lw = weights["layers"][l]
+            h = model._ln(x, lw["ln1_g"], lw["ln1_b"])
+            q, k, v = model._qkv(lw, h)                    # [S, H, D]
+            k_pages = kv_cache.scatter_token_layer(
+                k_pages, l, k, write_page, write_off)
+            v_pages = kv_cache.scatter_token_layer(
+                v_pages, l, v, write_page, write_off)
+            ctx = self._attend(q, k_pages, v_pages, l, page_table,
+                               lengths)
+            x = x + model._attn_out(lw, ctx)
+            x = x + model._mlp(
+                lw, model._ln(x, lw["ln2_g"], lw["ln2_b"]))
+        logits = model._head(weights, x)                   # [S, V]
+        return logits, k_pages, v_pages
+
+    def _build_step_fn(self, model):
         import jax
         import jax.numpy as jnp
 
         from ..ops.sampling_ops import sample_tokens
 
-        model = self.model
-
         def step(state, weights, tokens, positions, live, page_table,
                  write_page, write_off, base_keys, counters, temp, top_k,
                  top_p):
             k_pages, v_pages = state
-            x = model._embed(weights, tokens, positions)       # [S, Dm]
-            lengths = positions + 1  # the token written THIS step included
-            for l in range(model.num_layers):
-                lw = weights["layers"][l]
-                h = model._ln(x, lw["ln1_g"], lw["ln1_b"])
-                q, k, v = model._qkv(lw, h)                    # [S, H, D]
-                k_pages = kv_cache.scatter_token_layer(
-                    k_pages, l, k, write_page, write_off)
-                v_pages = kv_cache.scatter_token_layer(
-                    v_pages, l, v, write_page, write_off)
-                ctx = self._attend(q, k_pages, v_pages, l, page_table,
-                                   lengths)
-                x = x + model._attn_out(lw, ctx)
-                x = x + model._mlp(
-                    lw, model._ln(x, lw["ln2_g"], lw["ln2_b"]))
-            logits = model._head(weights, x)                   # [S, V]
+            logits, k_pages, v_pages = self._token_step_body(
+                model, weights, k_pages, v_pages, tokens, positions,
+                page_table, write_page, write_off)
             keys = jax.vmap(jax.random.fold_in)(base_keys, counters)
             nxt = sample_tokens(keys, logits, temp, top_k, top_p)
             nxt = jnp.where(live, nxt, 0)
@@ -381,7 +495,7 @@ class DecodeEngine:
 
         return jax.jit(step, donate_argnums=(0,))
 
-    def _build_prefill_fn(self, t_pad: int):
+    def _build_prefill_fn(self, t_pad: int, model):
         import jax
         import jax.numpy as jnp
 
@@ -389,7 +503,6 @@ class DecodeEngine:
             decode_attention_reference
         from ..ops.sampling_ops import sample_tokens
 
-        model = self.model
         cc = self._cache.config
         t_max = cc.max_seq_len
         n_bp = t_pad // cc.page_size
@@ -433,10 +546,131 @@ class DecodeEngine:
 
         return jax.jit(prefill, donate_argnums=(0,))
 
-    def _prefill_fn(self, t_pad: int):
-        fn = self._prefill_fns.get(t_pad)
+    def _build_rows_fn(self, n_rows: int, n_slots: int, model):
+        """Multi-row step: R query rows per slot written at explicit
+        (page, offset) coords, attending over the slot's page table
+        with per-row causal lengths.  ONE executable family serves
+        chunked/suffix prefill (S=1, R=chunk rows) AND speculative
+        verification (S=slots, R=spec_k+1): both are 'rows of a
+        sequence extended through the cache', which is what keeps
+        their logits bitwise-equal to the decode step and the
+        full-recompute oracle."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..ops.pallas_decode_attention import paged_chunk_attention
+        from ..ops.sampling_ops import greedy_sample, sample_tokens
+
+        R, S = n_rows, n_slots
+
+        def rows_fn(state, weights, tokens, start, last_row, page_table,
+                    write_page, write_off, base_keys, counters, temp,
+                    top_k, top_p):
+            k_pages, v_pages = state
+            positions = start[:, None] \
+                + jnp.arange(R, dtype=jnp.int32)[None, :]   # [S, R]
+            # clip keeps padded/dead rows inside the positional table;
+            # live rows are in range by the reservation accounting
+            pos_c = jnp.clip(positions, 0, model.max_seq_len - 1)
+            x = model._embed(weights, tokens, pos_c)        # [S, R, Dm]
+            row_lengths = positions + 1
+            for l in range(model.num_layers):
+                lw = weights["layers"][l]
+                h = model._ln(x, lw["ln1_g"], lw["ln1_b"])
+                q, k, v = model._qkv(lw, h)                 # [S, R, H, D]
+                flat = (S * R, model.num_heads, model.head_dim)
+                k_pages = kv_cache.scatter_token_layer(
+                    k_pages, l, k.reshape(flat),
+                    write_page.reshape(-1), write_off.reshape(-1))
+                v_pages = kv_cache.scatter_token_layer(
+                    v_pages, l, v.reshape(flat),
+                    write_page.reshape(-1), write_off.reshape(-1))
+                ctx = paged_chunk_attention(
+                    q, k_pages[l], v_pages[l], page_table, row_lengths,
+                    use_pallas=self.config.use_pallas,
+                    interpret=self.config.interpret)
+                x = x + model._attn_out(lw, ctx)
+                x = x + model._mlp(
+                    lw, model._ln(x, lw["ln2_g"], lw["ln2_b"]))
+            logits = model._head(weights, x)                # [S, R, V]
+            greedy = greedy_sample(logits)                  # [S, R]
+            last = jnp.take_along_axis(
+                logits, last_row[:, None, None], axis=1)[:, 0]  # [S, V]
+            keys = jax.vmap(jax.random.fold_in)(base_keys, counters)
+            tok = sample_tokens(keys, last, temp, top_k, top_p)
+            return (tok, greedy, logits), (k_pages, v_pages)
+
+        return jax.jit(rows_fn, donate_argnums=(0,))
+
+    def _build_propose_fn(self, k_steps: int):
+        """Draft proposal burst: k_steps+1 sequential draft-model steps
+        in ONE dispatch (the +1 keeps the draft's own cache synced
+        through the bonus position when every proposal is accepted).
+        Write coords come from the page table in-fn; dead slots and
+        out-of-range positions aim at the trash page."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..ops.sampling_ops import greedy_sample
+
+        model = self._draft_model
+        cc = self._cache.config
+        p = cc.page_size
+        pps = cc.pages_per_slot
+
+        def propose(state, weights, tok0, start, live, trash_first,
+                    page_table):
+            dk, dv = state
+            cur = tok0
+            props = []
+            for j in range(k_steps + 1):
+                pos = start + j                              # [S]
+                idx = jnp.clip(pos // p, 0, pps - 1)
+                pid = jnp.take_along_axis(
+                    page_table, idx[:, None], axis=1)[:, 0]
+                pid = jnp.where(live & (pos < cc.max_seq_len), pid, 0)
+                if j == 0:
+                    pid = jnp.where(trash_first, 0, pid)
+                off = pos % p
+                logits, dk, dv = self._token_step_body(
+                    model, weights, dk, dv, cur,
+                    jnp.clip(pos, 0, model.max_seq_len - 1),
+                    page_table, pid, off)
+                cur = greedy_sample(logits)                  # [S]
+                props.append(cur)
+            return (jnp.stack(props, axis=1),), (dk, dv)
+
+        return jax.jit(propose, donate_argnums=(0,))
+
+    def _build_cow_fn(self):
+        """Copy page ``src`` onto page ``dst`` across EVERY pool (all
+        layers; target K/V + draft K/V when present) — the device half
+        of copy-on-write."""
+        import jax
+
+        def cow(state, src, dst):
+            return ((), tuple(pool.at[:, dst].set(pool[:, src])
+                              for pool in state))
+
+        return jax.jit(cow, donate_argnums=(0,))
+
+    def _prefill_fn(self, t_pad: int, which: str = "target"):
+        key = (t_pad, which)
+        fn = self._prefill_fns.get(key)
         if fn is None:
-            fn = self._prefill_fns[t_pad] = self._build_prefill_fn(t_pad)
+            model = self.model if which == "target" else self._draft_model
+            fn = self._prefill_fns[key] = self._build_prefill_fn(
+                t_pad, model)
+            stat_add("decode_prefill_compiles")
+        return fn
+
+    def _rows_fn(self, n_rows: int, n_slots: int, which: str = "target"):
+        key = (n_rows, n_slots, which)
+        fn = self._rows_fns.get(key)
+        if fn is None:
+            model = self.model if which == "target" else self._draft_model
+            fn = self._rows_fns[key] = self._build_rows_fn(
+                n_rows, n_slots, model)
             stat_add("decode_prefill_compiles")
         return fn
 
@@ -446,11 +680,28 @@ class DecodeEngine:
                top_k: int = 0, top_p: float = 1.0,
                seed: Optional[int] = None,
                on_token: Optional[Callable[[int], None]] = None,
-               record_logits: bool = False) -> DecodeRequest:
+               record_logits: bool = False,
+               speculative: Optional[bool] = None) -> DecodeRequest:
         c = self.config
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise ValueError("prompt must hold at least one token id")
+        if speculative:
+            # loud submit-time rejection: a request that ASKS for
+            # speculative decoding must get it or fail, never silently
+            # degrade
+            if self._draft_model is None:
+                raise ValueError(
+                    "speculative=True but the engine has no draft "
+                    "model (DecodeEngine(draft_model=, draft_weights=))")
+            if c.spec_k <= 0:
+                raise ValueError(
+                    "speculative=True but FLAGS_decode_spec_k / "
+                    "DecodeConfig.spec_k is 0")
+            if float(temperature) > 0.0:
+                raise ValueError(
+                    "speculative decoding is greedy-only (bitwise "
+                    "acceptance); submit with temperature=0")
         if max_new_tokens is None:
             max_new_tokens = c.max_new_tokens
         if len(prompt) + int(max_new_tokens) > c.max_seq_len:
@@ -486,7 +737,8 @@ class DecodeEngine:
             self._seq += 1
             req = DecodeRequest(prompt, max_new_tokens, deadline,
                                 temperature, top_k, top_p, seed,
-                                on_token, record_logits=record_logits)
+                                on_token, record_logits=record_logits,
+                                speculative=speculative)
             self._queue.append(req)
             stat_add("decode_requests")
             stat_set("decode_queue_depth", len(self._queue))
@@ -511,7 +763,10 @@ class DecodeEngine:
         _flight.record("serving/decode_start", name=self.name,
                        slots=self.config.slots,
                        max_seq_len=self.config.max_seq_len,
-                       page_size=self.config.page_size)
+                       page_size=self.config.page_size,
+                       prefix_cache=self.config.prefix_cache,
+                       spec_k=self.config.spec_k
+                       if self.spec_enabled else 0)
         return self
 
     def stop(self, drain: bool = True):
@@ -592,24 +847,82 @@ class DecodeEngine:
                 self._queue.popleft()
                 self._expire(req, "while queued")
                 continue
-            # conservative reservation: pages for the worst case, so a
-            # decode step can never die on cache exhaustion mid-flight
+            # shared-aware worst-case reservation: pages for prompt +
+            # max_new minus every prefix-cache hit, with a CoW spare
+            # held back for a borrowed partial page — a decode step can
+            # still never die on cache exhaustion mid-flight
+            slot = free[0]
             need = len(req.prompt) + req.max_new_tokens
-            if not self._cache.claim(free[0], need):
+            info = self._cache.claim(slot, need, prompt=req.prompt)
+            if info is None:
                 stat_add("decode_admission_blocked_pages")
                 break  # FIFO head-of-line: wait for pages to free
             self._queue.popleft()
-            slot = free[0]
-            self._slots[slot] = _SlotState(
-                req, jax.random.PRNGKey(req.seed))
+            st = _SlotState(req, jax.random.PRNGKey(req.seed))
+            st.spec = (self.spec_enabled and req.temperature <= 0.0
+                       and req.speculative is not False)
+            self._account_claim(slot, st, info)
+            self._slots[slot] = st
             admitted.append((slot, req))
         stat_set("decode_queue_depth", len(self._queue))
         return admitted
 
+    def _account_claim(self, slot: int, st: _SlotState, info) -> None:
+        """Fold one admission's prefix-cache outcome into the slot's
+        phase plan and the hit-rate accounting."""
+        req = st.req
+        n = len(req.prompt)
+        self._hit_pages += info.hit_pages
+        self._prompt_pages += info.prompt_pages
+        if info.hit_pages:
+            stat_add("decode_prefix_pages_hit", info.hit_pages)
+        stat_add("decode_prefix_pages_total", info.prompt_pages)
+        total = stat_get("decode_prefix_pages_total")
+        if total:
+            stat_set("decode_cache_hit_rate",
+                     int(100 * stat_get("decode_prefix_pages_hit")
+                         / total))
+        stat_set("decode_shared_pages", self._cache.shared_pages)
+        if info.hit_tokens >= n:
+            # the ENTIRE prompt is cache-covered: skip prefill — the
+            # first decode step re-derives the last prompt position's
+            # logits (its K/V write aims at trash: the shared pages
+            # already hold that position) and samples the first token
+            st.phase = "decode"
+            st.write_trash_once = True
+            st.last_token = req.prompt[-1]
+            st.prefill_pos = n
+            # the first step's query is the LAST prompt position: its
+            # K/V (and everything before) is already in the shared
+            # pages, so the length cursor starts one short of the
+            # prompt and the step's own write goes to trash
+            self._cache.lengths[slot] = n - 1
+            stat_add("decode_prefill_skipped")
+        else:
+            st.phase = "prefill"
+            st.prefill_pos = info.hit_tokens  # page-aligned by design
+
     def _release(self, slot: int):
+        st = self._slots[slot]
+        register = None
+        if st is not None and self._cache.prefix is not None \
+                and st.phase == "decode" \
+                and (not self.spec_enabled or st.spec):
+            # register this slot's pages for future prefix hits — only
+            # when the draft pools are synced too (a non-speculative
+            # slot on a spec engine never wrote draft K/V; stale draft
+            # bytes could not corrupt output, only acceptance, but we
+            # keep the index clean).  Content = prompt + generated,
+            # truncated to the positions actually written — minus any
+            # trailing positions a spec slot wrote through the normal
+            # step (target-only; the draft bytes there are stale).
+            seq = st.req.prompt + st.req.generated
+            register = seq[:int(self._cache.lengths[slot])
+                           - st.draft_lag]
         self._slots[slot] = None
-        self._cache.release(slot)
+        self._cache.release(slot, register_tokens=register)
         stat_set("decode_free_pages", self._cache.allocator.num_free)
+        stat_set("decode_shared_pages", self._cache.shared_pages)
 
     def _finish_slot(self, slot: int, error=None):
         st = self._slots[slot]
@@ -654,36 +967,74 @@ class DecodeEngine:
                     # blocked head) honest while idle
                     self._cond.wait(0.05 if self._queue else None)
                     continue
-            for slot, req in admitted:
-                self._run_prefill(slot, req)
+            self._service_prefills()
             self._reap_live()
-            if self.live_slots:
-                self._run_step()
+            self._run_decode_round()
 
-    # -- device work ------------------------------------------------------
-    def _run_prefill(self, slot: int, req: DecodeRequest):
+    # -- device work: prefill ---------------------------------------------
+    def _service_prefills(self):
+        """Advance prefill-phase slots.  Chunked mode dispatches ONE
+        chunk per engine-loop iteration (round-robin across prefilling
+        slots) so the decoding slots keep stepping between chunks;
+        unchunked mode completes each prefill in one dispatch."""
+        pre = [i for i, st in enumerate(self._slots)
+               if st is not None and st.phase == "prefill"]
+        if not pre:
+            return
+        chunk = self.config.prefill_chunk_pages
+        if chunk > 0:
+            pick = min(pre, key=lambda i:
+                       (i - self._prefill_rr) % self.config.slots)
+            self._prefill_rr = (pick + 1) % self.config.slots
+            self._run_prefill_rows(
+                pick, chunk * self.config.page_size)
+        else:
+            for i in pre:
+                st = self._slots[i]
+                if st.prefill_pos == 0:
+                    self._run_prefill_full(i)
+                else:
+                    # prefix-cache suffix: only the unmatched tail of
+                    # the prompt is computed, in one dispatch
+                    rows = self._buckets.seq_bucket(
+                        len(st.req.prompt) - st.prefill_pos)
+                    self._run_prefill_rows(i, rows)
+
+    def _run_prefill_full(self, slot: int):
+        """The whole-prompt prefill fast path (no cache hit, chunking
+        off): page-wholesale K/V writes + locally-built full-width
+        attention, one dispatch."""
         import jax.numpy as jnp
 
         st = self._slots[slot]
+        req = st.req
         try:
             t_pad = self._buckets.seq_bucket(len(req.prompt))
             tokens = np.zeros((t_pad,), np.int32)
             tokens[:len(req.prompt)] = req.prompt
+            args = lambda w: (w, jnp.asarray(tokens),  # noqa: E731
+                              np.int32(len(req.prompt)),
+                              jnp.asarray(self._cache.page_table[slot]),
+                              st.base_key,
+                              np.float32(req.temperature),
+                              np.int32(req.top_k),
+                              np.float32(req.top_p))
             t0 = time.monotonic()
             with otrace.span("serving/decode_prefill", slot=slot,
                              bucket=t_pad):
                 tok, last = self._exe.run_persistent(
                     self._prefill_fn(t_pad), _STATE_VARS,
-                    args=(self.weights, jnp.asarray(tokens),
-                          np.int32(len(req.prompt)),
-                          jnp.asarray(self._cache.page_table[slot]),
-                          st.base_key,
-                          np.float32(req.temperature),
-                          np.int32(req.top_k),
-                          np.float32(req.top_p)),
-                    scope=self._scope)
+                    args=args(self.weights), scope=self._scope)
+                if st.spec:
+                    # mirror the prefill into the draft's pools (same
+                    # page ids) so proposals can read the prompt
+                    self._exe.run_persistent(
+                        self._prefill_fn(t_pad, "draft"), _DRAFT_VARS,
+                        args=args(self.draft_weights), scope=self._scope)
             stat_time("decode_prefill_seconds", time.monotonic() - t0)
             stat_add("decode_prefills")
+            st.prefill_pos = len(req.prompt)
+            st.phase = "decode"
             self._cache.lengths[slot] = len(req.prompt)
             if req.record_logits:
                 req.logits_trace.append(np.asarray(last))
@@ -692,6 +1043,73 @@ class DecodeEngine:
             stat_add("decode_prefill_errors")
             self._finish_slot(slot, e)
 
+    def _run_prefill_rows(self, slot: int, rows: int):
+        """One prefill chunk of ``rows`` positions starting at the
+        slot's prefill cursor (page-aligned).  Serves both chunked
+        prefill and the prefix-cache suffix (start > 0): attention
+        gathers the already-present pages for positions below the
+        cursor, so the chunk's logits stay bitwise-equal to a full
+        prefill.  The FINAL chunk samples the request's first token."""
+        import jax.numpy as jnp
+
+        st = self._slots[slot]
+        req = st.req
+        cc = self._cache.config
+        try:
+            n = len(req.prompt)
+            start = st.prefill_pos
+            n_live = min(rows, n - start)
+            final = start + n_live >= n
+            tokens = np.zeros((1, rows), np.int32)
+            tokens[0, :n_live] = req.prompt[start:start + n_live]
+            write_page = np.zeros((1, rows), np.int32)
+            write_off = np.zeros((1, rows), np.int32)
+            for r in range(n_live):
+                pos = start + r
+                write_page[0, r] = self._cache.page_table[slot][
+                    pos // cc.page_size]
+                write_off[0, r] = pos % cc.page_size
+            t0 = time.monotonic()
+            args = lambda w: (w, jnp.asarray(tokens),  # noqa: E731
+                              np.asarray([start], np.int32),
+                              np.asarray([min(n - 1 - start, rows - 1)],
+                                         np.int32),
+                              jnp.asarray(
+                                  self._cache.page_table[slot:slot + 1]),
+                              jnp.asarray(write_page),
+                              jnp.asarray(write_off),
+                              jnp.asarray(
+                                  np.asarray(st.base_key)[None]),
+                              np.zeros((1,), np.int32),
+                              np.asarray([req.temperature], np.float32),
+                              np.asarray([req.top_k], np.int32),
+                              np.asarray([req.top_p], np.float32))
+            with otrace.span("serving/decode_prefill_chunk", slot=slot,
+                             start=start, rows=rows):
+                tok, _greedy, logits = self._exe.run_persistent(
+                    self._rows_fn(rows, 1), _STATE_VARS,
+                    args=args(self.weights), scope=self._scope)
+                if st.spec:
+                    self._exe.run_persistent(
+                        self._rows_fn(rows, 1, "draft"), _DRAFT_VARS,
+                        args=args(self.draft_weights), scope=self._scope)
+            stat_time("decode_prefill_seconds", time.monotonic() - t0)
+            stat_add("prefill_chunks")
+            self._prefill_chunk_count += 1
+            st.prefill_pos += n_live
+            if final:
+                stat_add("decode_prefills")
+                st.phase = "decode"
+                self._cache.lengths[slot] = n
+                if req.record_logits:
+                    req.logits_trace.append(
+                        np.asarray(logits)[0, n - 1 - start].copy())
+                self._deliver(slot, int(np.asarray(tok)[0]))
+        except Exception as e:  # noqa: BLE001 — fault isolation per req
+            stat_add("decode_prefill_errors")
+            self._finish_slot(slot, e)
+
+    # -- device work: decode ----------------------------------------------
     def _deliver(self, slot: int, token: int):
         """Account one sampled token for a live slot; finish + free the
         slot the moment its request is done."""
@@ -710,15 +1128,49 @@ class DecodeEngine:
                 or st.n_generated >= st.req.max_new_tokens:
             self._finish_slot(slot)
 
-    def _run_step(self):
+    def _perform_cow(self, plans):
+        """Run the device half of every planned copy-on-write BEFORE
+        the write dispatch that needed it (the host tables were already
+        swapped by plan_cow)."""
+        if not plans:
+            return
+        if self._cow_fn is None:
+            self._cow_fn = self._build_cow_fn()
+        for src, dst in plans:
+            self._exe.run_persistent(
+                self._cow_fn, self._cow_state,
+                args=(np.int32(src), np.int32(dst)), scope=self._scope)
+            stat_add("decode_cow_copies")
+            self._cow_copies += 1
+
+    def _run_decode_round(self):
+        decoding = [i for i, st in enumerate(self._slots)
+                    if st is not None and st.phase == "decode"]
+        if not decoding:
+            return
+        stat_max("decode_slot_occupancy_max", len(decoding))
+        spec = [i for i in decoding
+                if self._slots[i].spec
+                and (self._slots[i].req.max_new_tokens
+                     - self._slots[i].n_generated) >= 2]
+        if spec:
+            self._run_spec(spec)
+        normal = [i for i in decoding
+                  if self._slots[i] is not None and i not in set(spec)]
+        if normal:
+            self._run_step(normal)
+
+    def _run_step(self, live_idx):
         import jax.numpy as jnp
 
         c = self._cache.config
         s = c.num_slots
-        live_idx = [i for i, st in enumerate(self._slots)
-                    if st is not None]
-        if not live_idx:
-            return
+        # copy-on-write any shared page this step would write (a
+        # borrowed partial tail at its first divergent token)
+        for i in live_idx:
+            if not self._slots[i].write_trash_once:
+                self._perform_cow(self._cache.plan_cow(
+                    i, [int(self._cache.lengths[i])]))
         tokens = np.zeros((s,), np.int32)
         positions = np.zeros((s,), np.int32)
         live = np.zeros((s,), bool)
@@ -734,7 +1186,13 @@ class DecodeEngine:
             tokens[i] = st.last_token
             positions[i] = self._cache.lengths[i]
             live[i] = True
-            write_page[i], write_off[i] = self._cache.write_coords(i)
+            if st.write_trash_once:
+                # cache-hit first step: the shared pages already hold
+                # this position's K/V — re-deriving it writes identical
+                # bytes, but shared pages are immutable, so aim at trash
+                write_page[i], write_off[i] = 0, 0
+            else:
+                write_page[i], write_off[i] = self._cache.write_coords(i)
             counters[i] = st.n_generated
             temp[i] = st.req.temperature
             top_k[i] = st.req.top_k
@@ -765,28 +1223,144 @@ class DecodeEngine:
         logits_np = None
         for i in live_idx:
             st = self._slots[i]
+            st.write_trash_once = False
+            if st.spec:
+                st.draft_lag += 1  # target-only write: draft is stale
             self._cache.lengths[i] += 1
             if st.req.record_logits:
                 if logits_np is None:
                     logits_np = np.asarray(logits)
                 st.req.logits_trace.append(logits_np[i].copy())
             self._deliver(i, int(nxt[i]))
-        occ = self.live_slots
-        stat_set("decode_slot_occupancy", occ)
-        stat_max("decode_slot_occupancy_max", len(live_idx))
+        stat_set("decode_slot_occupancy", self.live_slots)
         stat_add("decode_steps")
+
+    def _run_spec(self, spec_idx):
+        """One speculative round for the greedy slots: a k-token draft
+        burst (ONE dispatch) then ONE batched target step verifying all
+        k+1 positions.  Every emitted token is the TARGET's argmax at
+        its position — bitwise-identical to non-speculative greedy
+        decode; proposals only decide how many tokens this round
+        yields (1..k+1)."""
+        import jax.numpy as jnp
+
+        c = self._cache.config
+        s = c.num_slots
+        k = self.config.spec_k
+        rows = k + 1
+        k_live = {}
+        for i in spec_idx:
+            st = self._slots[i]
+            rem = st.req.max_new_tokens - st.n_generated
+            k_live[i] = min(k, rem - 1)
+            # CoW the pages this round's window writes (skip the
+            # trash-aimed first position on the cache-hit path)
+            n = int(self._cache.lengths[i])
+            lo = n + (1 if st.write_trash_once else 0)
+            self._perform_cow(self._cache.plan_cow(
+                i, range(lo, n + k_live[i] + 1)))
+        tok0 = np.zeros((s,), np.int32)
+        start = np.zeros((s,), np.int32)
+        live = np.zeros((s,), bool)
+        trash_first = np.zeros((s,), bool)
+        for i in spec_idx:
+            st = self._slots[i]
+            tok0[i] = st.last_token
+            start[i] = self._cache.lengths[i]
+            live[i] = True
+            trash_first[i] = st.write_trash_once
+        t0 = time.monotonic()
+        try:
+            if self._propose_fn is None:
+                self._propose_fn = self._build_propose_fn(k)
+            with otrace.span("serving/decode_spec", live=len(spec_idx),
+                             k=k):
+                (props,) = self._exe.run_persistent(
+                    self._propose_fn, _DRAFT_VARS,
+                    args=(self.draft_weights, jnp.asarray(tok0),
+                          jnp.asarray(start), jnp.asarray(live),
+                          jnp.asarray(trash_first),
+                          jnp.asarray(self._cache.page_table)),
+                    scope=self._scope)
+                props = np.asarray(props)            # [S, k+1]
+                tokens = np.zeros((s, rows), np.int32)
+                write_page = np.zeros((s, rows), np.int32)
+                write_off = np.zeros((s, rows), np.int32)
+                for i in spec_idx:
+                    tokens[i, 0] = tok0[i]
+                    tokens[i, 1:] = props[i, :k]
+                    for r in range(k_live[i] + 1):
+                        if r == 0 and trash_first[i]:
+                            continue  # stays (0, 0): trash
+                        pos = int(start[i]) + r
+                        write_page[i, r] = self._cache.page_table[i][
+                            pos // c.page_size]
+                        write_off[i, r] = pos % c.page_size
+                _tok, greedy, logits = self._exe.run_persistent(
+                    self._rows_fn(rows, s), _STATE_VARS,
+                    args=(self.weights, jnp.asarray(tokens),
+                          jnp.asarray(start),
+                          np.zeros((s,), np.int32),
+                          jnp.asarray(self._cache.page_table),
+                          jnp.asarray(write_page),
+                          jnp.asarray(write_off),
+                          np.zeros((s, 2), np.uint32),
+                          np.zeros((s,), np.int32),
+                          np.zeros((s,), np.float32),
+                          np.zeros((s,), np.int32),
+                          np.ones((s,), np.float32)),
+                    scope=self._scope)
+                greedy = np.asarray(greedy)          # [S, k+1]
+        except Exception as e:  # noqa: BLE001 — batch fault isolation
+            stat_add("decode_step_errors")
+            for i in spec_idx:
+                if self._slots[i] is not None:
+                    self._finish_slot(i, e)
+            return
+        stat_time("decode_step_seconds", time.monotonic() - t0)
+        logits_np = None
+        proposed = accepted = 0
+        for i in spec_idx:
+            st = self._slots[i]
+            a = 0
+            while a < k_live[i] and int(props[i, a]) == int(greedy[i, a]):
+                a += 1
+            proposed += k_live[i]
+            accepted += a
+            st.write_trash_once = False
+            for j in range(a + 1):
+                self._cache.lengths[i] += 1
+                if st.req.record_logits:
+                    if logits_np is None:
+                        logits_np = np.asarray(logits)
+                    st.req.logits_trace.append(logits_np[i, j].copy())
+                self._deliver(i, int(greedy[i, j]))
+                if self._slots[i] is None:
+                    break  # finished (EOS/budget) mid-emission
+        self._spec_proposed += proposed
+        self._spec_accepted += accepted
+        stat_add("decode_spec_proposed", proposed)
+        stat_add("decode_spec_accepted", accepted)
+        stat_add("decode_spec_rounds")
+        total = stat_get("decode_spec_proposed")
+        if total:
+            stat_set("spec_accept_rate",
+                     int(100 * stat_get("decode_spec_accepted") / total))
+        stat_set("decode_slot_occupancy", self.live_slots)
 
     # -- oracle / observability ------------------------------------------
     def recompute_logits(self, tokens: Sequence[int]) -> np.ndarray:
         """Full-recompute oracle: run the ENTIRE sequence through the
-        prefill path from scratch (no cache reuse) and return the last
-        position's logits.  Runs on THROWAWAY page pools — the prefill
-        body only ever WRITES pages (its attention reads the locally
-        built K/V, so fresh zero pools are numerically identical), and
-        touching the live pools would race the engine thread's donating
-        step.  Safe to call while the engine is serving.
-        ``tests/test_decode_engine.py`` compares this bitwise against
-        the streamed decode logits at every step."""
+        prefill path from scratch (no cache reuse, no prefix sharing)
+        and return the last position's logits.  Runs on THROWAWAY page
+        pools — the prefill body only ever WRITES pages (its attention
+        reads the locally built K/V, so fresh zero pools are
+        numerically identical), and touching the live pools would race
+        the engine thread's donating step.  Safe to call while the
+        engine is serving.  ``tests/test_decode_engine.py`` compares
+        this bitwise against the streamed decode logits at every step;
+        ``tests/test_decode_prefix_spec.py`` does the same for the
+        shared-prefix, CoW, chunked, and speculative paths."""
         import jax
         import jax.numpy as jnp
 
@@ -809,6 +1383,8 @@ class DecodeEngine:
     def stats(self) -> dict:
         with self._cond:
             depth = len(self._queue)
+        hp, pp = self._hit_pages, self._prompt_pages
+        sp, sa = self._spec_proposed, self._spec_accepted
         return {
             "name": self.name,
             "slots": self.config.slots,
@@ -820,4 +1396,15 @@ class DecodeEngine:
             "num_pages": self._cache.config.num_pages,
             "cache_bytes": self._cache.config.cache_bytes(),
             "continuous": self._continuous,
+            "prefix_cache": self.config.prefix_cache,
+            "prefix_hit_pages": hp,
+            "prefix_prompt_pages": pp,
+            "cache_hit_rate": round(hp / pp, 4) if pp else 0.0,
+            "shared_pages": self._cache.shared_pages,
+            "cow_copies": self._cow_copies,
+            "prefill_chunks": self._prefill_chunk_count,
+            "spec_enabled": self.spec_enabled,
+            "spec_proposed": sp,
+            "spec_accepted": sa,
+            "spec_accept_rate": round(sa / sp, 4) if sp else 0.0,
         }

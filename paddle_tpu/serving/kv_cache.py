@@ -1,4 +1,5 @@
-"""Paged per-slot KV cache for autoregressive decode (serving/decode.py).
+"""Paged per-slot KV cache with prefix sharing for autoregressive decode
+(serving/decode.py).
 
 Layout (the vLLM PagedAttention idea, TPU-native): all keys/values for
 every serving slot live in TWO device arrays of fixed-size pages
@@ -8,34 +9,60 @@ every serving slot live in TWO device arrays of fixed-size pages
 and each slot owns an ordered list of page ids (its *page table*).  A
 slot's logical sequence position ``t`` maps to page ``table[t // page]``
 offset ``t % page``.  Pages are allocated from a host-side free list at
-admission and returned the moment a request finishes — a finished slot
-frees its memory immediately instead of padding to the longest request
-in a batch.
+admission and returned when their REFCOUNT drops to zero — a finished
+slot releases its references immediately instead of padding to the
+longest request in a batch.
 
 Page 0 is the TRASH page: it is never allocated, dead slots' per-step
 writes land there, and an empty page-table entry points at it.  Reads
 are always masked by the slot's live length, so trash contents are
 never observable.
 
+**Prefix sharing** (this file's tentpole): at millions of users most
+prompts open with the same system/template prefix, so recomputing and
+re-storing its K/V per request wastes both HBM and prefill compute.
+When a request finishes, its pages are registered in a host-side
+``PrefixIndex`` — an exact token-content trie keyed by
+``(parent_page_id, page_token_tuple)``, collision-free by construction
+(no hashing shortcut can serve a wrong byte).  Admission walks the trie
+over the new prompt: every matched page is SHARED into the slot's page
+table with a refcount bump instead of being allocated and prefilled.
+Sharing rules that keep the device arrays coherent:
+
+- A registered page is immutable (the index itself holds one
+  reference).  A slot may only write a page it solely owns
+  (``refcount == 1`` and unregistered).
+- The trie's final entry may be a *partial* page (a prompt tail shorter
+  than one page).  A consumer that matches it borrows the page and
+  must **copy-on-write** before its first divergent token lands there:
+  ``plan_cow`` swaps the slot's reserved spare page into the table and
+  returns the ``(src, dst)`` device copy the engine must perform before
+  its next write dispatch.
+- Worst-case reservation stays shared-aware and exhaustion-proof: a
+  claim allocates ``total_pages - shared_full_pages`` fresh pages —
+  when a partial page is borrowed, one of those fresh pages is held
+  back as the CoW spare, so the mid-decode copy can NEVER fail on an
+  empty pool (a decode step still never dies on cache exhaustion).
+- Under pool pressure, admission evicts least-recently-hit CHILDLESS
+  index entries whose pages only the index references (bottom-up, so a
+  reused page id can never be mistaken for a live trie parent).
+
 The device arrays themselves are registered in a ``framework.Scope``
 and threaded through ``Executor.run_persistent`` with donation — the
-cache never round-trips to host between steps.
-
-Admission is conservative: a request reserves
-``ceil((prompt_len + max_new_tokens) / page_size)`` pages up front, so
-a decode step can never fail on cache exhaustion mid-generation (the
-price is vLLM-style optimistic over-commit is out of scope; the
-allocator still shares one pool across slots, so short requests leave
-room for more concurrent long ones than a dense [slots, max_seq] layout
-would).
+cache never round-trips to host between steps.  The speculative-decode
+draft model's page pools (serving/decode.py) are indexed by the SAME
+page ids, so sharing, reservation, and CoW cover them for free (the
+engine's CoW copy spans every pool).
 """
 from __future__ import annotations
 
 import math
 import threading
-from typing import List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
+
+from ..monitor import stat_add
 
 K_PAGES_VAR = "__decode_k_pages__"
 V_PAGES_VAR = "__decode_v_pages__"
@@ -85,10 +112,15 @@ class CacheConfig:
 
 
 class PageAllocator:
-    """Host-side free list over page ids 1..num_pages-1 (0 is trash)."""
+    """Host-side free list over page ids 1..num_pages-1 (0 is trash).
+
+    A double free corrupts the pool silently (two slots end up writing
+    the same page), so ``free`` detects it via a mirror set and raises
+    LOUDLY instead."""
 
     def __init__(self, num_pages: int):
         self._free: List[int] = list(range(num_pages - 1, 0, -1))
+        self._free_set = set(self._free)
         self._lock = threading.Lock()
 
     @property
@@ -99,31 +131,205 @@ class PageAllocator:
     def alloc(self, n: int) -> Optional[List[int]]:
         """Take n pages, or None (atomically nothing) when the pool
         cannot cover the request."""
+        if n <= 0:
+            # guard the n==0 slice below (`self._free[-0:]` is the
+            # WHOLE list, not an empty one) — a fully-shared claim
+            # legitimately needs zero fresh pages
+            return []
         with self._lock:
             if n > len(self._free):
                 return None
             taken = self._free[-n:]
             del self._free[-n:]
+            self._free_set.difference_update(taken)
             return list(reversed(taken))
 
     def free(self, pages: Sequence[int]) -> None:
         with self._lock:
             for p in pages:
-                if p != 0:
-                    self._free.append(int(p))
+                p = int(p)
+                if p == 0:
+                    continue
+                if p in self._free_set:
+                    raise RuntimeError(
+                        f"double free of KV-cache page {p}: the page is "
+                        f"already on the free list (refcount/lifecycle "
+                        f"bug — a slot release or eviction ran twice)")
+                self._free.append(p)
+                self._free_set.add(p)
+
+
+class _PrefixEntry:
+    __slots__ = ("page_id", "parent", "tokens", "full", "children",
+                 "tick")
+
+    def __init__(self, page_id, parent, tokens, full, tick):
+        self.page_id = page_id
+        self.parent = parent
+        self.tokens = tokens
+        self.full = full
+        self.children = 0
+        self.tick = tick
+
+
+class PrefixIndex:
+    """Exact-content trie over registered (immutable) pages.
+
+    Node key = ``(parent_page_id, tuple(page_tokens))`` — page ids are
+    unique while resident, so the chain match is exact and a prompt can
+    never hit a page holding different bytes (no hash collisions by
+    construction).  Entries record their token content, so the FINAL
+    partial page of a prompt can be matched as a token-prefix of a
+    registered tail (the consumer then copy-on-writes at its first
+    divergent token).  Single-threaded by contract: only the engine
+    thread mutates it (admission / release / eviction)."""
+
+    def __init__(self, page_size: int):
+        self.page_size = int(page_size)
+        self._by_key: Dict[tuple, _PrefixEntry] = {}
+        self._children: Dict[int, List[_PrefixEntry]] = {}
+        self._by_page: Dict[int, _PrefixEntry] = {}
+        self._tick = 0
+
+    def __len__(self) -> int:
+        return len(self._by_page)
+
+    def is_registered(self, page_id: int) -> bool:
+        return int(page_id) in self._by_page
+
+    def lookup(self, prompt: Sequence[int]) -> Tuple[List[int],
+                                                     Optional[int]]:
+        """Longest registered prefix of ``prompt``: ``(full_pages,
+        partial_page)`` — ordered page ids for every whole matched page
+        and, when the REMAINING prompt tail is a token-prefix of a
+        registered page's content, that page id (the CoW candidate).
+        A partial hit therefore always means the ENTIRE prompt is
+        cache-covered."""
+        p = self.page_size
+        prompt = [int(t) for t in prompt]
+        n = len(prompt)
+        self._tick += 1
+        full: List[int] = []
+        parent = 0
+        while (len(full) + 1) * p <= n:
+            toks = tuple(prompt[len(full) * p:(len(full) + 1) * p])
+            e = self._by_key.get((parent, toks))
+            if e is None:
+                break
+            e.tick = self._tick
+            full.append(e.page_id)
+            parent = e.page_id
+        partial = None
+        m = n - len(full) * p
+        if m > 0:
+            tail = tuple(prompt[len(full) * p:])
+            for e in self._children.get(parent, ()):
+                if len(e.tokens) >= m and e.tokens[:m] == tail:
+                    e.tick = self._tick
+                    partial = e.page_id
+                    break
+        return full, partial
+
+    def register(self, pages: Sequence[int], tokens: Sequence[int],
+                 on_new) -> int:
+        """Register the chain of ``pages`` holding ``tokens`` (page i
+        holds tokens[i*p:(i+1)*p]; the last page may be partial).  An
+        existing identical entry is adopted as the chain parent and the
+        caller's duplicate page is simply not registered (it frees
+        normally).  ``on_new(page_id)`` is called for each page the
+        index takes a reference on.  Returns newly registered count."""
+        p = self.page_size
+        tokens = [int(t) for t in tokens]
+        parent = 0
+        new = 0
+        for i, pid in enumerate(pages):
+            pid = int(pid)
+            toks = tuple(tokens[i * p:(i + 1) * p])
+            if not toks or pid == 0:
+                break
+            existing = self._by_key.get((parent, toks))
+            if existing is not None:
+                parent = existing.page_id
+                if len(toks) < p:
+                    break
+                continue
+            if pid in self._by_page:
+                # the page is already registered under another key —
+                # never alias one page into two trie positions
+                break
+            e = _PrefixEntry(pid, parent, toks, len(toks) == p,
+                             self._tick)
+            self._by_key[(parent, toks)] = e
+            self._children.setdefault(parent, []).append(e)
+            if parent in self._by_page:
+                self._by_page[parent].children += 1
+            self._by_page[pid] = e
+            on_new(pid)
+            new += 1
+            if not e.full:
+                break
+            parent = pid
+        return new
+
+    def evict(self, n_pages: int, can_evict, on_evict) -> int:
+        """Free up to ``n_pages`` pages by removing least-recently-hit
+        CHILDLESS entries whose page ``can_evict(pid)`` approves (only
+        the index references it).  Bottom-up by construction: an entry
+        with children is never removed, so a freed-and-reused page id
+        can never be mistaken for a live chain parent.  O(entries) per
+        eviction — fine at host-bookkeeping scale."""
+        freed = 0
+        while freed < n_pages:
+            victims = [e for e in self._by_page.values()
+                       if e.children == 0 and can_evict(e.page_id)]
+            if not victims:
+                break
+            e = min(victims, key=lambda v: v.tick)
+            self._remove(e)
+            on_evict(e.page_id)
+            freed += 1
+        return freed
+
+    def _remove(self, e: _PrefixEntry) -> None:
+        del self._by_key[(e.parent, e.tokens)]
+        sibs = self._children[e.parent]
+        sibs.remove(e)
+        if not sibs:
+            del self._children[e.parent]
+        if e.parent in self._by_page:
+            self._by_page[e.parent].children -= 1
+        del self._by_page[e.page_id]
+
+
+class ClaimInfo:
+    """What an admission claim resolved to (prefix-cache accounting)."""
+
+    __slots__ = ("hit_tokens", "full_hits", "partial", "hit_pages",
+                 "prompt_pages", "fresh_pages")
+
+    def __init__(self, hit_tokens, full_hits, partial, hit_pages,
+                 prompt_pages, fresh_pages):
+        self.hit_tokens = hit_tokens      # prompt positions cache-covered
+        self.full_hits = full_hits        # whole shared pages
+        self.partial = partial            # borrowed a partial tail page
+        self.hit_pages = hit_pages        # full_hits + (1 if partial)
+        self.prompt_pages = prompt_pages  # ceil(len(prompt)/page)
+        self.fresh_pages = fresh_pages    # newly allocated pages
 
 
 class PagedKVCache:
-    """Host bookkeeping (page tables, lengths, allocator) + the device
-    page arrays, which live in ``scope`` so Executor.run_persistent can
-    donate them through each decode step."""
+    """Host bookkeeping (page tables, lengths, refcounts, allocator,
+    prefix index) + the device page arrays, which live in ``scope`` so
+    Executor.run_persistent can donate them through each decode step."""
 
-    def __init__(self, config: CacheConfig, scope):
+    def __init__(self, config: CacheConfig, scope, prefix_cache=True):
         import jax.numpy as jnp
 
         self.config = config
         self.scope = scope
         self.allocator = PageAllocator(config.num_pages)
+        self.prefix: Optional[PrefixIndex] = \
+            PrefixIndex(config.page_size) if prefix_cache else None
         c = config
         # per-slot host mirrors: the scheduler reads/writes these; the
         # device sees them as small per-step i32 feeds
@@ -131,34 +337,190 @@ class PagedKVCache:
                                    np.int32)
         self.lengths = np.zeros((c.num_slots,), np.int32)
         self._slot_pages: List[List[int]] = [[] for _ in range(c.num_slots)]
+        # every page id a slot holds ONE reference on (table pages +
+        # the CoW spare); release decrefs exactly this list
+        self._slot_refs: List[List[int]] = [[] for _ in range(c.num_slots)]
+        # reserved CoW target for a borrowed partial page (at most one)
+        self._cow_spare: List[List[int]] = [[] for _ in range(c.num_slots)]
+        self._refs = [0] * c.num_pages
         shape = (c.num_layers, c.num_pages, c.page_size, c.num_heads,
                  c.head_dim)
         scope.set_var(K_PAGES_VAR, jnp.zeros(shape, c.dtype))
         scope.set_var(V_PAGES_VAR, jnp.zeros(shape, c.dtype))
 
-    # -- slot lifecycle ---------------------------------------------------
-    def claim(self, slot: int, reserve_tokens: int) -> bool:
-        """Reserve pages covering ``reserve_tokens`` positions for the
-        slot; False when the pool can't cover it (caller retries later)."""
-        n = self.config.pages_for(reserve_tokens)
+    # -- refcounts --------------------------------------------------------
+    def _incref(self, pid: int) -> None:
+        self._refs[pid] += 1
+
+    def _decref(self, pid: int) -> None:
+        r = self._refs[pid] = self._refs[pid] - 1
+        if r < 0:
+            raise RuntimeError(
+                f"KV-cache page {pid} refcount went negative — a "
+                f"release/eviction path dropped a reference it never "
+                f"held")
+        if r == 0:
+            self.allocator.free([pid])
+
+    def refcount(self, pid: int) -> int:
+        return self._refs[int(pid)]
+
+    @property
+    def shared_pages(self) -> int:
+        """Pages currently pinned by the prefix index."""
+        return len(self.prefix) if self.prefix is not None else 0
+
+    def _alloc_evicting(self, n: int) -> Optional[List[int]]:
+        """Allocate n pages, evicting cache-only prefix entries under
+        pressure (least-recently-hit, childless first)."""
         pages = self.allocator.alloc(n)
-        if pages is None:
-            return False
-        self._slot_pages[slot] = pages
+        if pages is not None or self.prefix is None:
+            return pages
+        short = n - self.allocator.num_free
+        evicted = self.prefix.evict(
+            short, can_evict=lambda pid: self._refs[pid] == 1,
+            on_evict=self._decref)
+        if evicted:
+            stat_add("decode_prefix_evictions", evicted)
+        return self.allocator.alloc(n)
+
+    # -- slot lifecycle ---------------------------------------------------
+    def claim(self, slot: int, reserve_tokens: int,
+              prompt: Optional[Sequence[int]] = None
+              ) -> Optional[ClaimInfo]:
+        """Reserve pages covering ``reserve_tokens`` positions for the
+        slot, sharing every registered prefix page of ``prompt``; None
+        when the pool can't cover the FRESH remainder (caller retries
+        later).  Shared-aware worst case: ``total - shared_full`` fresh
+        pages are taken either way — with a partial borrow one of them
+        is held back as the CoW spare, so the later copy-on-write can
+        never hit an empty pool."""
+        total = self.config.pages_for(reserve_tokens)
+        full_hits: List[int] = []
+        partial: Optional[int] = None
+        if self.prefix is not None and prompt is not None:
+            full_hits, partial = self.prefix.lookup(prompt)
+        hits = full_hits + ([partial] if partial is not None else [])
+        # pin the matched pages BEFORE the eviction-backed allocation:
+        # a just-matched childless tail page is index-only (refcount 1)
+        # and would otherwise be a legal eviction victim — freed and
+        # handed straight back as this claim's "fresh" page, aliasing
+        # one physical page under two table roles
+        for pid in hits:
+            self._incref(pid)
+        n_fresh = total - len(full_hits)
+        fresh = self._alloc_evicting(n_fresh)
+        if fresh is None and partial is not None:
+            # drop the partial borrow under pressure: unpinned, its
+            # page becomes an eviction candidate again, and the fresh
+            # count is unchanged (the borrow traded its CoW spare for
+            # a plain page) — so any reservation the submit-time check
+            # admitted can still be satisfied instead of deadlocking
+            # the queue head behind its own matched page
+            self._decref(partial)
+            partial = None
+            hits = list(full_hits)
+            fresh = self._alloc_evicting(n_fresh)
+        if fresh is None:
+            for pid in hits:
+                self._decref(pid)  # still index-pinned: never frees
+            return None
+        for pid in fresh:
+            self._incref(pid)
+        table_pages = list(full_hits)
+        rest = list(fresh)
+        spare: List[int] = []
+        if partial is not None:
+            spare = [rest.pop(0)]
+            table_pages.append(partial)
+        table_pages += rest
+        self._slot_pages[slot] = table_pages
+        self._slot_refs[slot] = hits + fresh
+        self._cow_spare[slot] = spare
         row = np.zeros((self.config.pages_per_slot,), np.int32)
-        row[:n] = pages
+        row[:len(table_pages)] = table_pages
         self.page_table[slot] = row
         self.lengths[slot] = 0
-        return True
+        prompt_len = len(prompt) if prompt is not None else 0
+        hit_tokens = len(full_hits) * self.config.page_size
+        if partial is not None:
+            hit_tokens = prompt_len  # partial hit == full prompt cover
+        return ClaimInfo(
+            hit_tokens=hit_tokens, full_hits=len(full_hits),
+            partial=partial is not None,
+            hit_pages=len(full_hits) + (1 if partial is not None else 0),
+            prompt_pages=self.config.pages_for(max(prompt_len, 1))
+            if prompt is not None else 0,
+            fresh_pages=len(fresh))
 
-    def release(self, slot: int) -> None:
-        self.allocator.free(self._slot_pages[slot])
+    def release(self, slot: int,
+                register_tokens: Optional[Sequence[int]] = None) -> None:
+        """Drop the slot's references.  When ``register_tokens`` is
+        given (the token content whose K/V the slot's leading pages
+        hold), those pages are first registered in the prefix index —
+        the index takes its own reference, so registered pages survive
+        the release for future prompts to share."""
+        if register_tokens and self.prefix is not None:
+            n_pages = self.config.pages_for(len(register_tokens))
+            self.prefix.register(
+                self._slot_pages[slot][:n_pages], register_tokens,
+                on_new=self._incref)
+        for pid in self._slot_refs[slot]:
+            self._decref(pid)
         self._slot_pages[slot] = []
+        self._slot_refs[slot] = []
+        self._cow_spare[slot] = []
         self.page_table[slot] = 0
         self.lengths[slot] = 0
 
     def slot_pages(self, slot: int) -> List[int]:
         return list(self._slot_pages[slot])
+
+    # -- copy-on-write ----------------------------------------------------
+    def writable(self, slot: int, position: int) -> bool:
+        pid = int(self.page_table[slot][int(position)
+                                        // self.config.page_size])
+        if pid == 0:
+            return True  # trash absorbs anything
+        return self._refs[pid] == 1 and not (
+            self.prefix is not None and self.prefix.is_registered(pid))
+
+    def plan_cow(self, slot: int, positions: Sequence[int]
+                 ) -> List[Tuple[int, int]]:
+        """Make every page covering ``positions`` writable by the slot.
+        Shared/registered pages are swapped for the slot's reserved
+        spare (falling back to a fresh allocation, which the
+        reservation accounting makes unreachable); the page table is
+        updated NOW and the returned ``(src, dst)`` copies MUST be
+        performed on-device by the caller before its next write
+        dispatch."""
+        plans: List[Tuple[int, int]] = []
+        p = self.config.page_size
+        for idx in sorted({int(pos) // p for pos in positions}):
+            pid = int(self.page_table[slot][idx])
+            if pid == 0 or self.writable(slot, idx * p):
+                continue
+            if self._cow_spare[slot]:
+                dst = self._cow_spare[slot].pop()
+            else:
+                got = self._alloc_evicting(1)
+                if got is None:
+                    raise CacheExhaustedError(
+                        f"copy-on-write for slot {slot} page index "
+                        f"{idx} found an empty pool — the shared-aware "
+                        f"reservation accounting is broken (a spare "
+                        f"page should have been held at admission)")
+                dst = got[0]
+                self._incref(dst)
+                self._slot_refs[slot].append(dst)
+            self.page_table[slot][idx] = dst
+            self._slot_pages[slot][idx] = dst
+            self._slot_refs[slot].remove(pid)
+            # shared pages are held by the index and/or other slots, so
+            # this decref can never free the page mid-copy
+            self._decref(pid)
+            plans.append((pid, dst))
+        return plans
 
     def write_coords(self, slot: int):
         """(page_id, offset) for the NEXT position of the slot."""
@@ -170,12 +532,38 @@ class PagedKVCache:
         return (self.scope.get_var(K_PAGES_VAR),
                 self.scope.get_var(V_PAGES_VAR))
 
+    # -- integrity audit (chaos tests / debugging) ------------------------
+    def debug_check(self) -> None:
+        """Assert the refcount/free-list/index books balance: every
+        page is exactly one of {free, referenced}, and each page's
+        refcount equals index-pin + per-slot references.  Raises
+        AssertionError with the discrepancy."""
+        want = [0] * self.config.num_pages
+        for slot_refs in self._slot_refs:
+            for pid in slot_refs:
+                want[pid] += 1
+        if self.prefix is not None:
+            for pid in list(self.prefix._by_page):
+                want[pid] += 1
+        with self.allocator._lock:
+            free = set(self.allocator._free)
+            assert len(free) == len(self.allocator._free), \
+                "free list holds duplicate pages"
+        for pid in range(1, self.config.num_pages):
+            assert self._refs[pid] == want[pid], (
+                f"page {pid}: refcount {self._refs[pid]} != "
+                f"{want[pid]} held references")
+            in_free = pid in free
+            assert in_free == (self._refs[pid] == 0), (
+                f"page {pid}: refcount {self._refs[pid]} but "
+                f"{'on' if in_free else 'not on'} the free list")
+
 
 # -- pure jit-side helpers (operate on the page arrays functionally) ------
 
 def scatter_token_layer(pages, layer: int, val, page_id, offset):
-    """Write one new position per slot: val [S, H, D] lands at
-    (layer, page_id[s], offset[s]) — dead slots pass page 0 (trash)."""
+    """Write one new position per row: val [R, H, D] lands at
+    (layer, page_id[r], offset[r]) — dead rows pass page 0 (trash)."""
     return pages.at[layer, page_id, offset].set(
         val.astype(pages.dtype))
 

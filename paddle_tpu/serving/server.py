@@ -221,7 +221,8 @@ class DecodeServer:
     tpot_seconds histograms)."""
 
     def __init__(self, model, weights, config=None, replicas: int = 1,
-                 http_port: Optional[int] = None):
+                 http_port: Optional[int] = None, draft_model=None,
+                 draft_weights=None):
         from .decode import DecodeConfig, DecodeEngine
 
         if replicas < 1:
@@ -229,7 +230,8 @@ class DecodeServer:
         self._config = config or DecodeConfig()
         self._engines = [
             DecodeEngine(model, weights, self._config,
-                         name=f"replica-{i}")
+                         name=f"replica-{i}", draft_model=draft_model,
+                         draft_weights=draft_weights)
             for i in range(replicas)
         ]
         self._http_port = http_port
@@ -302,6 +304,10 @@ class DecodeServer:
 
     def stats(self) -> Dict:
         per = [e.stats() for e in self._engines]
+        hit = sum(p["prefix_hit_pages"] for p in per)
+        total = sum(p["prefix_prompt_pages"] for p in per)
+        proposed = sum(p["spec_proposed"] for p in per)
+        accepted = sum(p["spec_accepted"] for p in per)
         return {
             "replicas": per,
             "n_replicas": len(per),
@@ -309,6 +315,17 @@ class DecodeServer:
             "live_slots": sum(p["live_slots"] for p in per),
             "free_slots": sum(p["free_slots"] for p in per),
             "queue_depth": sum(p["queue_depth"] for p in per),
+            # tentpole aggregates: fleet-wide prefix-cache hit rate,
+            # shared-page footprint, CoW traffic, chunked-prefill and
+            # speculative-decode activity (per-replica rows above)
+            "cache_hit_rate": round(hit / total, 4) if total else 0.0,
+            "shared_pages": sum(p["shared_pages"] for p in per),
+            "cow_copies": sum(p["cow_copies"] for p in per),
+            "prefill_chunks": sum(p["prefill_chunks"] for p in per),
+            "spec_accept_rate": round(accepted / proposed, 4)
+            if proposed else 0.0,
+            "spec_proposed": proposed,
+            "spec_accepted": accepted,
         }
 
     def health(self) -> Dict:

@@ -180,11 +180,15 @@ def test_join_and_leave_at_step_boundaries(model_and_weights):
 
 def test_admission_blocks_on_pages_not_slots(model_and_weights):
     """A shared page pool smaller than slots*max_seq exercises real
-    paging pressure: the second request waits for pages, then runs."""
+    paging pressure: the second request waits for pages, then runs.
+    prefix_cache=False pins the PURE paging semantics (with the prefix
+    cache on, released pages are deliberately RETAINED by the index —
+    tests/test_decode_prefix_spec.py covers that accounting)."""
     # pool: trash + 6 pages of 8 = 48 positions; each request needs
     # ceil((2+30)/8) = 4 pages, so two can't fit at once
     eng = make_engine(model_and_weights, slots=2, max_seq_len=64,
-                      page_size=8, num_pages=7).start()
+                      page_size=8, num_pages=7,
+                      prefix_cache=False).start()
     blocked0 = stat_get("decode_admission_blocked_pages")
     try:
         r1 = eng.submit([1, 2], max_new_tokens=30)

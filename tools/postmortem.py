@@ -199,7 +199,8 @@ def render(bundle: str, tail: int = 15, stacks: bool = False,
                 "postmortem_", "cluster_", "ckpt_saves", "ckpt_save_f",
                 "health_", "hbm_", "executable_size", "mfu_flops",
                 "compile_seconds_count", "executable_hlo_ops",
-                "pass_layer_scan", "decode_", "ttft_", "tpot_")
+                "pass_layer_scan", "decode_", "ttft_", "tpot_",
+                "spec_accept_rate", "prefill_chunks")
         for ln in rows:
             if metrics or any(k in ln for k in keys):
                 w(f"  {ln}\n")
