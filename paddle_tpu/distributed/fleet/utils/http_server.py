@@ -8,7 +8,9 @@ glue (and is exercised by the test suite over real localhost HTTP).
 It also doubles as the serving layer's observability port: ``routes``
 maps a path (e.g. ``/stats``, ``/health``) to a zero-arg callable whose
 return value is served as JSON — GETs on a registered route never touch
-the KV store.  A route may instead return ``(bytes, content_type)`` for
+the KV store.  A route key ending in ``/`` is a PREFIX route: it
+matches any longer path under it and its callable receives the path
+remainder as one argument (``/debug/request/<trace id>``).  A route may instead return ``(bytes, content_type)`` for
 non-JSON payloads; every server registers a default ``/metrics`` route
 serving the whole counter+histogram registry in Prometheus
 text-exposition format (``paddle_tpu.observe``), so any fleet/serving
@@ -32,11 +34,29 @@ class KVHandler(BaseHTTPRequestHandler):
     def do_GET(self):
         # route match ignores the query string (scrapers send
         # /stats?format=... and cache-busting /health?ts=...)
-        route = self.server.routes.get(urlsplit(self.path).path)
+        path = urlsplit(self.path).path
+        route = self.server.routes.get(path)
+        route_arg = None
+        if route is not None and path.endswith("/"):
+            # an exact GET of a prefix-route key is the empty-remainder
+            # case — the handler expects its one argument
+            route_arg = ""
+        if route is None:
+            # prefix routes: a key ending in "/" matches any longer
+            # path under it and the handler receives the remainder
+            # (e.g. "/debug/request/" -> route("<trace id>"));
+            # longest prefix wins
+            for rp in sorted(self.server.routes, key=len, reverse=True):
+                if rp.endswith("/") and path.startswith(rp) \
+                        and len(path) > len(rp):
+                    route, route_arg = self.server.routes[rp], \
+                        path[len(rp):]
+                    break
         if route is not None:
             ctype = "application/json"
             try:
-                payload = route()
+                payload = route(route_arg) if route_arg is not None \
+                    else route()
                 if isinstance(payload, tuple):  # (body, content_type)
                     payload, ctype = payload
                 body = payload if isinstance(payload, bytes) \
@@ -83,9 +103,17 @@ class KVHandler(BaseHTTPRequestHandler):
 
 def _metrics_route():
     """Default GET /metrics handler: Prometheus text exposition of the
-    whole StatRegistry + histogram registry (observe/histogram.py)."""
+    whole StatRegistry + histogram registry (observe/histogram.py).
+    SLO burn/goodput gauges are re-evaluated per scrape — they
+    otherwise refresh only on terminal requests, and a gauge frozen at
+    its last-burst peak would never resolve an alert."""
+    from ....observe import slo as _slo
     from ....observe.histogram import prometheus_text
 
+    try:
+        _slo.refresh_gauges()
+    except Exception:  # noqa: BLE001 — the exposition must still serve
+        pass
     return (prometheus_text().encode(),
             "text/plain; version=0.0.4; charset=utf-8")
 

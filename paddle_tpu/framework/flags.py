@@ -282,6 +282,41 @@ define_flag("decode_prefill_chunk_pages", 0,
             "one long prefill dispatch (protects ttft_ms_p99 for the "
             "slots already decoding); 0 = off (one prefill dispatch "
             "per request)")
+define_flag("request_trace_sample", 1.0,
+            "per-request tracing (paddle_tpu.observe.request_trace): "
+            "head-sampling fraction of NORMAL completions whose full "
+            "timeline is retained in the bounded finished-trace ring "
+            "(deterministic exact rate).  Recording itself is always on "
+            "and ~free (one monotonic read + a tuple append per "
+            "lifecycle event); tail retention keeps every SLO violator "
+            "and abnormal ending (deadline/abandoned/rejected/error) "
+            "REGARDLESS of this flag — 0 retains only the traces you'd "
+            "page on")
+define_flag("request_trace_ring", 512,
+            "capacity of the retained finished-trace ring "
+            "(request_trace.TraceStore); oldest retained traces fall "
+            "off — in-flight timelines are unaffected")
+define_flag("slo_ttft_p99_ms", 0.0,
+            "SLO objective (paddle_tpu.observe.slo): time-to-first-"
+            "token p99 target in ms — a request whose ttft exceeds it "
+            "(or that dies before first token) burns the 1% error "
+            "budget; 0 = objective disabled.  Burn-rate/budget gauges "
+            "ride /metrics as slo_burn_rate_ttft_p99_ppm / "
+            "slo_budget_remaining_ttft_p99_ppm")
+define_flag("slo_tpot_p50_ms", 0.0,
+            "SLO objective: per-request MEAN time-per-output-token p50 "
+            "target in ms (budget 50%); 0 = disabled")
+define_flag("slo_error_rate_ppm", 10000,
+            "SLO objective: allowed fraction of requests ending in any "
+            "outcome other than 'completed', in parts-per-million "
+            "(default 10000 = 1%); 0 = disabled.  Always-on by default "
+            "so decode_goodput_rps and the burn gauges exist out of "
+            "the box")
+define_flag("slo_windows_s", "60,300",
+            "comma-separated rolling window lengths (seconds) for the "
+            "multi-window burn-rate evaluation (SRE-workbook style: "
+            "short window catches fast burn, long window slow bleed); "
+            "goodput is measured over the shortest window")
 define_flag("decode_spec_k", 0,
             "decode engine: speculative decoding window — a draft "
             "model (DecodeEngine(draft_model=, draft_weights=)) "

@@ -26,6 +26,19 @@ This package is the middle:
   snapshot, flight tail, flags), crash/atexit hooks, and cluster-wide
   health telemetry (per-rank heartbeats over the fleet KV server +
   the aggregated ``/metrics/cluster`` route on rank 0).
+- ``request_trace`` — Dapper-style per-request serving timelines:
+  trace ids minted at submit, structured lifecycle events (enqueue,
+  admission, prefill chunks, decode steps, CoW copies, speculative
+  rounds, terminal outcome), head-sampling
+  (``FLAGS_request_trace_sample``) with tail retention of every SLO
+  violator and abnormal ending; served on ``/debug/requests`` +
+  ``/debug/request/<id>``, exported to Chrome trace JSON, embedded in
+  postmortem bundles as ``requests.json``.
+- ``slo``        — declarative objectives (ttft p99 / tpot p50 /
+  error rate) evaluated on rolling multi-windows (SRE-workbook burn
+  rates): ``slo_burn_rate_*`` / ``slo_budget_remaining_*`` gauges and
+  the ``decode_goodput_rps`` metric (completions meeting ALL
+  objectives per second).
 - ``xla_stats``  — XLA introspection: per-compile wall time
   (``compile_seconds``), executable size, per-chip HBM footprint from
   ``compiled.memory_analysis()`` joined with the tensor-parallel
@@ -34,8 +47,11 @@ This package is the middle:
   memory budget gate (``FLAGS_hbm_budget_fraction`` →
   :class:`~.xla_stats.MemoryBudgetError` before dispatch).
 """
-from . import flight, health, xla_stats
+from . import flight, health, request_trace, slo, xla_stats
 from .flight import FlightRecorder, get_flight_recorder
+from .request_trace import (RequestTrace, TraceStore,
+                            export_request_chrome_trace, get_trace_store)
+from .slo import Objective, SLOEngine, get_slo_engine
 from .health import (HealthReporter, StallWatchdog, cluster_health,
                      dump_postmortem, executor_progress,
                      install_crash_handler, serve_cluster_health,
@@ -73,4 +89,8 @@ __all__ = [
     "xla_stats", "MemoryBudgetError", "memory_breakdown",
     "var_attribution", "check_hbm_budget", "device_memory_stats",
     "memory_report",
+    # per-request tracing + SLO plane
+    "request_trace", "RequestTrace", "TraceStore", "get_trace_store",
+    "export_request_chrome_trace", "slo", "Objective", "SLOEngine",
+    "get_slo_engine",
 ]

@@ -172,6 +172,11 @@ def dump_postmortem(reason: str, directory: Optional[str] = None,
     - ``memory.json``  XLA compile records (per-chip HBM footprint
       breakdown + per-var attribution + budget verdicts) and a live
       per-device memory sample (observe/xla_stats.py)
+    - ``requests.json`` per-request serving traces: retained SLO
+      violators + abnormal endings (full timelines), the live
+      in-flight table, and the SLO verdict snapshot (burn rates,
+      budget remaining, goodput) — observe/request_trace.py +
+      observe/slo.py; pretty-print with ``python -m tools.reqtrace``
     """
     directory = directory or _flags.flag("postmortem_dir") or "postmortem"
     safe = re.sub(r"[^A-Za-z0-9_.-]", "_", str(reason))[:48] or "unknown"
@@ -226,12 +231,29 @@ def dump_postmortem(reason: str, directory: Optional[str] = None,
         with open(p, "w") as f:
             json.dump(xla_stats.memory_report(), f, indent=2, default=repr)
 
+    def _requests_json(p):
+        from . import request_trace as _rt
+        from . import slo as _slo
+
+        store = _rt.get_trace_store()
+        doc = {
+            "slo": _slo.snapshot(),
+            "violators": [t.to_dict() for t in store.violators(50)],
+            "retained": [t.to_dict(events=False)
+                         for t in store.retained(100)],
+            "inflight": [t.to_dict(events=False)
+                         for t in store.inflight()],
+        }
+        with open(p, "w") as f:
+            json.dump(doc, f, indent=2, default=repr)
+
     section("stacks.txt", _stacks)
     section("trace.json", _trace)
     section("metrics.prom", _metrics)
     section("flight.jsonl", _flight_tail)
     section("flags.json", _flags_json)
     section("memory.json", _memory_json)
+    section("requests.json", _requests_json)
 
     meta = {
         "reason": str(reason),

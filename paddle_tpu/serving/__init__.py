@@ -29,6 +29,7 @@ from .buckets import (  # noqa: F401
     BucketSpec,
     DeadlineExceededError,
     QueueFullError,
+    RequestAbandonedError,
     RequestTooLargeError,
     ServerClosedError,
     ServingError,
@@ -53,7 +54,8 @@ __all__ = [
     "Batcher", "BucketSpec", "CacheConfig", "CacheExhaustedError",
     "DeadlineExceededError", "DecodeConfig", "DecodeEngine",
     "DecodeRequest", "DecodeServer", "InferenceRequest", "PageAllocator",
-    "PagedKVCache", "PrefixIndex", "QueueFullError", "RequestBase",
+    "PagedKVCache", "PrefixIndex", "QueueFullError",
+    "RequestAbandonedError", "RequestBase",
     "RequestTooLargeError", "Server", "ServerClosedError",
     "ServingConfig", "ServingError", "TransformerLM",
     "prefill_bucket_grid",

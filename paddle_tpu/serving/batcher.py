@@ -33,8 +33,8 @@ from ..observe import tracer as otrace
 from ..observe.histogram import stat_time
 from ..profiler import RecordEvent
 from .buckets import (BucketSpec, DeadlineExceededError, QueueFullError,
-                      ServerClosedError, ServingError, assemble,
-                      plan_request)
+                      RequestAbandonedError, ServerClosedError,
+                      ServingError, assemble, plan_request)
 
 class _Unset:
     """"Use the server default" deadline sentinel; the stable repr keeps
@@ -60,9 +60,11 @@ class RequestBase:
     ``_complete`` race."""
 
     __slots__ = ("deadline", "t_enqueue", "_event", "_lock", "_result",
-                 "_error")
+                 "_error", "trace")
 
     _deadline_stat = "serving_deadline_exceeded"
+    # flat-name outcome counters: <prefix>_requests_total_<outcome>
+    _outcome_prefix = "serving"
 
     def __init__(self, deadline):
         self.deadline = deadline  # absolute monotonic seconds, or None
@@ -71,6 +73,7 @@ class RequestBase:
         self._lock = threading.Lock()
         self._result = None
         self._error = None
+        self.trace = None  # observe.request_trace.RequestTrace
 
     def _complete(self, result=None, error=None) -> bool:
         """First completion wins (batcher and client-side deadline can
@@ -80,7 +83,73 @@ class RequestBase:
                 return False
             self._result, self._error = result, error
             self._event.set()
-            return True
+        try:
+            # EVERY terminal path funnels here (engine reply, queue
+            # reap, client-side deadline self-reap, abandon, cancel),
+            # so the per-outcome counters, terminal latency, the SLO
+            # observation, and the trace verdict happen exactly once
+            self._on_terminal(error)
+        except Exception:  # noqa: BLE001 — instrumentation must never
+            stat_add("request_trace_errors")  # break completion
+        return True
+
+    # -- terminal accounting ---------------------------------------------
+    @staticmethod
+    def _classify(error) -> str:
+        if error is None:
+            return "completed"
+        if isinstance(error, DeadlineExceededError):
+            return "deadline"
+        if isinstance(error, RequestAbandonedError):
+            return "abandoned"
+        if isinstance(error, QueueFullError):
+            return "rejected"
+        if isinstance(error, ServerClosedError):
+            return "cancelled"
+        return "error"
+
+    def _on_terminal(self, error) -> None:
+        outcome = self._classify(error)
+        latency = time.monotonic() - self.t_enqueue
+        stat_add(f"{self._outcome_prefix}_requests_total_{outcome}")
+        self._finish_stats(outcome, latency)
+        if self.trace is None:
+            return
+        summary = self._summary(outcome, latency)
+        try:
+            violations = self._slo_check(summary)
+        except Exception:  # noqa: BLE001 — a broken objective must not
+            # leak the trace in the in-flight map forever
+            stat_add("request_trace_errors")
+            violations = ()
+        from ..observe.request_trace import get_trace_store
+
+        summary.pop("outcome", None)  # stored top-level on the trace
+        get_trace_store().finish(
+            self.trace, outcome=outcome,
+            reason=summary.pop("reason", None)
+            or (f"{type(error).__name__}: {error}" if error else None),
+            violations=violations, **summary)
+
+    def _finish_stats(self, outcome: str, latency: float) -> None:
+        """Terminal latency for the abnormal paths — the completed path
+        records ``serving_latency_seconds`` at reply time already, but
+        error-rate SLOs need deadline/abandon/cancel in the
+        distribution's denominator too."""
+        if outcome != "completed":
+            stat_time("serving_latency_seconds", latency)
+
+    def _summary(self, outcome: str, latency: float) -> dict:
+        return {"outcome": outcome, "latency_s": round(latency, 6)}
+
+    def _slo_check(self, summary: dict):
+        return ()
+
+    def abandon(self, reason: str = "client abandoned") -> bool:
+        """Client-side give-up: completes the request with
+        ``RequestAbandonedError`` (outcome ``abandoned``); the engine
+        frees any slot/queue entry it holds at the next boundary."""
+        return self._complete(error=RequestAbandonedError(reason))
 
     def expired(self, now=None) -> bool:
         return self.deadline is not None and \
@@ -154,22 +223,39 @@ class Batcher:
 
     # -- client side -----------------------------------------------------
     def submit(self, feeds, deadline_ms=_UNSET) -> InferenceRequest:
+        from ..observe.request_trace import get_trace_store
+
         with otrace.span("serving/enqueue"):
-            arrays, nrows, key = plan_request(feeds, self._plans, self._spec)
+            try:
+                arrays, nrows, key = plan_request(feeds, self._plans,
+                                                  self._spec)
+            except ServingError:
+                stat_add("serving_requests_total_rejected")
+                raise
             if deadline_ms is _UNSET:
                 deadline_ms = self._default_deadline_ms
             deadline = None if deadline_ms is None \
                 else time.monotonic() + float(deadline_ms) / 1e3
             req = InferenceRequest(arrays, nrows, key, deadline)
+            req.trace = get_trace_store().start(
+                "serving", replica="batcher", nrows=nrows,
+                key=str(key),
+                deadline_ms=None if deadline_ms is None
+                else float(deadline_ms))
             with self._cond:
                 if self._closing:
-                    raise ServerClosedError("server is draining/stopped")
+                    err = ServerClosedError("server is draining/stopped")
+                    req._complete(error=err)
+                    raise err
                 if len(self._queue) >= self._max_queue:
                     stat_add("serving_rejected_queue_full")
-                    raise QueueFullError(
+                    err = QueueFullError(
                         f"request queue is at capacity ({self._max_queue}); "
                         f"retry with backoff")
+                    req._complete(error=err)
+                    raise err
                 self._queue.append(req)
+                req.trace.event("enqueue", queue_depth=len(self._queue))
                 stat_add("serving_requests")
                 stat_set("serving_queue_depth", len(self._queue))
                 stat_max("serving_queue_depth_max", len(self._queue))
@@ -227,6 +313,23 @@ class Batcher:
     def queue_depth(self) -> int:
         with self._cond:
             return len(self._queue)
+
+    def debug_requests(self):
+        """Live in-flight table for the ``/debug/requests`` route: one
+        row per queued request (trace id, age, rows, bucket key)."""
+        with self._cond:
+            q = list(self._queue)
+        now = time.monotonic()
+        return [{
+            "trace_id": r.trace.trace_id if r.trace is not None else None,
+            "replica": "batcher",
+            "phase": "queued",
+            "age_ms": round((now - r.t_enqueue) * 1e3, 3),
+            "rows": r.nrows,
+            "key": str(r.key),
+            "deadline_in_ms": None if r.deadline is None
+            else round((r.deadline - now) * 1e3, 3),
+        } for r in q if not r.done()]
 
     # -- consumer side ---------------------------------------------------
     def _reap_expired_locked(self):
@@ -304,6 +407,10 @@ class Batcher:
             with otrace.span("serving/pad", requests=len(requests)):
                 feeds, total, bucket_rows = assemble(
                     requests, requests[0].key, self._spec, self._pad_value)
+            for r in requests:
+                if r.trace is not None:
+                    r.trace.event("execute", bucket_rows=bucket_rows,
+                                  batch_mates=len(requests))
             with otrace.span("serving/execute", rows=bucket_rows,
                              requests=len(requests)):
                 with RecordEvent(f"serving/batch_b{bucket_rows}"):

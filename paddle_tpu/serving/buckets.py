@@ -46,6 +46,11 @@ class ServerClosedError(ServingError):
     """The server is draining or stopped and accepts no new requests."""
 
 
+class RequestAbandonedError(ServingError):
+    """The client explicitly abandoned the request (RequestBase.abandon);
+    the engine frees its slot/queue entry at the next boundary."""
+
+
 class BucketSpec:
     """The static bucket grid: batch sizes x sequence lengths.
 

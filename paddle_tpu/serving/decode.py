@@ -209,10 +209,11 @@ class DecodeRequest(RequestBase):
 
     __slots__ = ("prompt", "max_new_tokens", "temperature", "top_k",
                  "top_p", "seed", "on_token", "generated", "_stream",
-                 "t_first_token", "record_logits", "logits_trace",
-                 "speculative")
+                 "t_first_token", "t_last_token", "record_logits",
+                 "logits_trace", "speculative", "finish_reason")
 
     _deadline_stat = "decode_deadline_exceeded"
+    _outcome_prefix = "decode"
 
     def __init__(self, prompt, max_new_tokens, deadline, temperature,
                  top_k, top_p, seed, on_token, record_logits=False,
@@ -228,15 +229,52 @@ class DecodeRequest(RequestBase):
         self.generated: List[int] = []
         self._stream: _queue.Queue = _queue.Queue()
         self.t_first_token: Optional[float] = None
+        self.t_last_token: Optional[float] = None
         self.record_logits = bool(record_logits)
         self.logits_trace: List[np.ndarray] = []
         self.speculative = speculative  # None=auto, False=opt out
+        self.finish_reason: Optional[str] = None
+
+    # terminal accounting (RequestBase._on_terminal hooks) ---------------
+    def _finish_stats(self, outcome, latency):
+        # unlike the batcher, decode had NO terminal-latency series at
+        # all — record it for EVERY outcome (submit-time rejections
+        # observe it separately in DecodeEngine.submit) so error-rate
+        # denominators cover deadline/abandon/reject alike
+        stat_time("decode_request_latency_seconds", latency)
+
+    def _summary(self, outcome, latency):
+        n = len(self.generated)
+        ttft = None if self.t_first_token is None \
+            else self.t_first_token - self.t_enqueue
+        tpot = None
+        if n >= 2 and self.t_last_token is not None \
+                and self.t_first_token is not None:
+            # per-request MEAN time-per-output-token (what the tpot_p50
+            # SLO objective judges)
+            tpot = (self.t_last_token - self.t_first_token) / (n - 1)
+        return {
+            "outcome": outcome,
+            "latency_s": round(latency, 6),
+            "ttft_s": None if ttft is None else round(ttft, 6),
+            "tpot_s": None if tpot is None else round(tpot, 6),
+            "n_tokens": n,
+            "prompt_len": len(self.prompt),
+            "reason": self.finish_reason,
+        }
+
+    def _slo_check(self, summary):
+        from ..observe import slo as _slo
+
+        return _slo.observe_request(summary)
 
     # engine side ---------------------------------------------------------
     def _emit(self, token: int) -> None:
+        now = time.monotonic()
         if self.t_first_token is None:
-            self.t_first_token = time.monotonic()
+            self.t_first_token = now
             stat_time("ttft_seconds", self.t_first_token - self.t_enqueue)
+        self.t_last_token = now
         self.generated.append(int(token))
         self._stream.put(int(token))
         if self.on_token is not None:
@@ -277,7 +315,7 @@ class DecodeRequest(RequestBase):
 class _SlotState:
     __slots__ = ("req", "base_key", "n_generated", "last_token", "t_last",
                  "phase", "prefill_pos", "write_trash_once", "spec",
-                 "draft_lag")
+                 "draft_lag", "chunks", "t_admit")
 
     def __init__(self, req, base_key):
         self.req = req
@@ -285,6 +323,8 @@ class _SlotState:
         self.n_generated = 0
         self.last_token = 0
         self.t_last = time.monotonic()
+        self.t_admit = self.t_last
+        self.chunks = 0             # prefill chunks dispatched
         self.phase = "prefill"      # "prefill" -> "decode"
         self.prefill_pos = 0        # next prompt position to prefill
         self.write_trash_once = False  # cache-hit path: first decode
@@ -398,6 +438,10 @@ class DecodeEngine:
                         c.slots, c.max_seq_len, c.page_size,
                         num_pages=c.num_pages, dtype=c.cache_dtype),
             self._scope, prefix_cache=c.prefix_cache)
+        # per-request timeline hook: claim/CoW/register/evict events
+        # from the cache land on the owning request's trace
+        self._cache.on_event = self._on_cache_event
+        self._admitting = None  # request whose claim() is in flight
         self.weights = jax.tree_util.tree_map(jax.numpy.asarray, weights)
         if draft_model is not None:
             self.draft_weights = jax.tree_util.tree_map(
@@ -438,6 +482,27 @@ class DecodeEngine:
     @property
     def spec_enabled(self) -> bool:
         return self._draft_model is not None and self.config.spec_k > 0
+
+    # -- per-request tracing helpers -------------------------------------
+    @staticmethod
+    def _tev(req, name, **attrs) -> None:
+        tr = req.trace
+        if tr is not None:
+            tr.event(name, **attrs)
+
+    def _on_cache_event(self, slot, name, **attrs):
+        """PagedKVCache event hook: attribute cache lifecycle events
+        (claim / cow_swap / evict / register) to the owning request's
+        timeline.  During admission the slot state does not exist yet,
+        so the claim-in-flight request is the fallback owner (evictions
+        triggered by its allocation ARE its wait)."""
+        st = self._slots[slot] if slot is not None \
+            and 0 <= slot < len(self._slots) else None
+        req = st.req if st is not None else self._admitting
+        if req is not None:
+            self._tev(req, f"cache/{name}",
+                      **({"slot": slot} if slot is not None else {}),
+                      **attrs)
 
     # -- jitted step builders --------------------------------------------
     def _attend(self, q, k_pages, v_pages, layer, page_table, lengths):
@@ -682,8 +747,54 @@ class DecodeEngine:
                on_token: Optional[Callable[[int], None]] = None,
                record_logits: bool = False,
                speculative: Optional[bool] = None) -> DecodeRequest:
+        from ..observe.request_trace import get_trace_store
+
         c = self.config
         prompt = [int(t) for t in prompt]
+        trace = get_trace_store().start(
+            "decode", replica=self.name, prompt_len=len(prompt),
+            max_new_tokens=None if max_new_tokens is None
+            else int(max_new_tokens))
+        try:
+            return self._submit_traced(
+                trace, prompt, max_new_tokens, deadline_ms, temperature,
+                top_k, top_p, seed, on_token, record_logits, speculative)
+        except Exception as e:
+            # submit-time rejection IS a terminal outcome: count it,
+            # record its (instant) terminal latency so error-rate
+            # denominators include rejects, and tail-retain the (tiny)
+            # trace so /debug/request/<id> can answer "why did my
+            # request never run".  Only SERVER-fault rejections burn
+            # the SLO budget (overload shedding, draining) — a buggy
+            # client hammering an invalid prompt must not page anyone.
+            outcome = "cancelled" if isinstance(e, ServerClosedError) \
+                else "rejected"
+            stat_add(f"decode_requests_total_{outcome}")
+            latency = time.monotonic() - trace.t_start
+            stat_time("decode_request_latency_seconds", latency)
+            summary = {"outcome": outcome,
+                       "latency_s": round(latency, 6),
+                       "ttft_s": None, "tpot_s": None, "n_tokens": 0,
+                       "prompt_len": len(prompt)}
+            violations = ()
+            if isinstance(e, (QueueFullError, ServerClosedError)):
+                try:
+                    from ..observe import slo as _slo
+
+                    violations = _slo.observe_request(summary)
+                except Exception:  # noqa: BLE001 — never mask the
+                    stat_add("request_trace_errors")  # rejection
+            summary.pop("outcome")  # stored top-level on the trace
+            get_trace_store().finish(
+                trace, outcome=outcome,
+                reason=f"{type(e).__name__}: {e}",
+                violations=violations, **summary)
+            raise
+
+    def _submit_traced(self, trace, prompt, max_new_tokens, deadline_ms,
+                       temperature, top_k, top_p, seed, on_token,
+                       record_logits, speculative) -> DecodeRequest:
+        c = self.config
         if not prompt:
             raise ValueError("prompt must hold at least one token id")
         if speculative:
@@ -739,7 +850,16 @@ class DecodeEngine:
                                 temperature, top_k, top_p, seed,
                                 on_token, record_logits=record_logits,
                                 speculative=speculative)
+            req.trace = trace
             self._queue.append(req)
+            # resolved defaults ride the event, not trace.attrs: the
+            # trace is already visible to concurrent /debug readers
+            # and attrs must stay structurally frozen after start()
+            trace.event("enqueue", queue_depth=len(self._queue),
+                        max_new_tokens=int(max_new_tokens),
+                        seed=int(seed),
+                        deadline_ms=None if deadline_ms is None
+                        else float(deadline_ms))
             stat_add("decode_requests")
             stat_set("decode_queue_depth", len(self._queue))
             self._cond.notify_all()
@@ -853,9 +973,16 @@ class DecodeEngine:
             # still never die on cache exhaustion mid-flight
             slot = free[0]
             need = len(req.prompt) + req.max_new_tokens
-            info = self._cache.claim(slot, need, prompt=req.prompt)
+            self._admitting = req
+            try:
+                info = self._cache.claim(slot, need, prompt=req.prompt)
+            finally:
+                self._admitting = None
             if info is None:
                 stat_add("decode_admission_blocked_pages")
+                self._tev(req, "admission_blocked",
+                          reason="pages",
+                          free_pages=self._cache.allocator.num_free)
                 break  # FIFO head-of-line: wait for pages to free
             self._queue.popleft()
             st = _SlotState(req, jax.random.PRNGKey(req.seed))
@@ -879,10 +1006,23 @@ class DecodeEngine:
         stat_add("decode_prefix_pages_total", info.prompt_pages)
         total = stat_get("decode_prefix_pages_total")
         if total:
-            stat_set("decode_cache_hit_rate",
-                     int(100 * stat_get("decode_prefix_pages_hit")
-                         / total))
+            hits = stat_get("decode_prefix_pages_hit")
+            # deprecated integer-percent form (kept for dashboards) +
+            # the float-precision _ppm companion (same pattern as
+            # cluster_step_time_skew_ppm)
+            stat_set("decode_cache_hit_rate", int(100 * hits / total))
+            stat_set("decode_cache_hit_rate_ppm",
+                     int(1e6 * hits / total))
         stat_set("decode_shared_pages", self._cache.shared_pages)
+        self._tev(req, "admit", slot=slot,
+                  queue_wait_ms=round(
+                      (st.t_admit - req.t_enqueue) * 1e3, 3),
+                  prompt_pages=info.prompt_pages,
+                  fresh_pages=info.fresh_pages,
+                  hit_pages=info.hit_pages,
+                  hit_tokens=info.hit_tokens,
+                  cow_spare=bool(info.partial),
+                  prefill_skipped=info.hit_tokens >= n)
         if info.hit_tokens >= n:
             # the ENTIRE prompt is cache-covered: skip prefill — the
             # first decode step re-derives the last prompt position's
@@ -919,8 +1059,10 @@ class DecodeEngine:
             seq = st.req.prompt + st.req.generated
             register = seq[:int(self._cache.lengths[slot])
                            - st.draft_lag]
-        self._slots[slot] = None
+        # release BEFORE clearing the slot so the cache's register/
+        # evict events can still be attributed to the owning request
         self._cache.release(slot, register_tokens=register)
+        self._slots[slot] = None
         stat_set("decode_free_pages", self._cache.allocator.num_free)
         stat_set("decode_shared_pages", self._cache.shared_pages)
 
@@ -1032,6 +1174,9 @@ class DecodeEngine:
                         self._prefill_fn(t_pad, "draft"), _DRAFT_VARS,
                         args=args(self.draft_weights), scope=self._scope)
             stat_time("decode_prefill_seconds", time.monotonic() - t0)
+            self._tev(req, "prefill", slot=slot, bucket=t_pad,
+                      tokens=len(req.prompt),
+                      dur_ms=round((time.monotonic() - t0) * 1e3, 3))
             stat_add("decode_prefills")
             st.prefill_pos = len(req.prompt)
             st.phase = "decode"
@@ -1096,6 +1241,10 @@ class DecodeEngine:
             stat_time("decode_prefill_seconds", time.monotonic() - t0)
             stat_add("prefill_chunks")
             self._prefill_chunk_count += 1
+            st.chunks += 1
+            self._tev(req, "prefill_chunk", slot=slot, start=start,
+                      rows=rows, live=n_live, final=final,
+                      dur_ms=round((time.monotonic() - t0) * 1e3, 3))
             st.prefill_pos += n_live
             if final:
                 stat_add("decode_prefills")
@@ -1123,12 +1272,17 @@ class DecodeEngine:
         self.tokens_total += 1
         stat_add("decode_tokens_total")
         st.req._emit(token)
+        self._tev(st.req, "token", slot=slot, token=int(token),
+                  n=st.n_generated)
         eos = self.config.eos_id
-        if (eos is not None and token == eos) \
-                or st.n_generated >= st.req.max_new_tokens:
+        if eos is not None and token == eos:
+            st.req.finish_reason = "eos"
+            self._finish_slot(slot)
+        elif st.n_generated >= st.req.max_new_tokens:
+            st.req.finish_reason = "budget"
             self._finish_slot(slot)
 
-    def _perform_cow(self, plans):
+    def _perform_cow(self, slot, plans):
         """Run the device half of every planned copy-on-write BEFORE
         the write dispatch that needed it (the host tables were already
         swapped by plan_cow)."""
@@ -1136,12 +1290,18 @@ class DecodeEngine:
             return
         if self._cow_fn is None:
             self._cow_fn = self._build_cow_fn()
+        st = self._slots[slot]
         for src, dst in plans:
+            t0 = time.monotonic()
             self._exe.run_persistent(
                 self._cow_fn, self._cow_state,
                 args=(np.int32(src), np.int32(dst)), scope=self._scope)
             stat_add("decode_cow_copies")
             self._cow_copies += 1
+            if st is not None:
+                self._tev(st.req, "cow", slot=slot, src=int(src),
+                          dst=int(dst),
+                          dur_ms=round((time.monotonic() - t0) * 1e3, 3))
 
     def _run_decode_round(self):
         decoding = [i for i, st in enumerate(self._slots)
@@ -1169,7 +1329,7 @@ class DecodeEngine:
         # borrowed partial tail at its first divergent token)
         for i in live_idx:
             if not self._slots[i].write_trash_once:
-                self._perform_cow(self._cache.plan_cow(
+                self._perform_cow(i, self._cache.plan_cow(
                     i, [int(self._cache.lengths[i])]))
         tokens = np.zeros((s,), np.int32)
         positions = np.zeros((s,), np.int32)
@@ -1257,7 +1417,7 @@ class DecodeEngine:
             # trash-aimed first position on the cache-hit path)
             n = int(self._cache.lengths[i])
             lo = n + (1 if st.write_trash_once else 0)
-            self._perform_cow(self._cache.plan_cow(
+            self._perform_cow(i, self._cache.plan_cow(
                 i, range(lo, n + k_live[i] + 1)))
         tok0 = np.zeros((s,), np.int32)
         start = np.zeros((s,), np.int32)
@@ -1327,6 +1487,8 @@ class DecodeEngine:
                 a += 1
             proposed += k_live[i]
             accepted += a
+            self._tev(st.req, "spec_round", slot=i,
+                      proposed=k_live[i], accepted=a)
             st.write_trash_once = False
             for j in range(a + 1):
                 self._cache.lengths[i] += 1
@@ -1344,8 +1506,10 @@ class DecodeEngine:
         stat_add("decode_spec_rounds")
         total = stat_get("decode_spec_proposed")
         if total:
-            stat_set("spec_accept_rate",
-                     int(100 * stat_get("decode_spec_accepted") / total))
+            acc = stat_get("decode_spec_accepted")
+            # deprecated integer-percent + float-precision _ppm
+            stat_set("spec_accept_rate", int(100 * acc / total))
+            stat_set("spec_accept_rate_ppm", int(1e6 * acc / total))
         stat_set("decode_slot_occupancy", self.live_slots)
 
     # -- oracle / observability ------------------------------------------
@@ -1379,6 +1543,56 @@ class DecodeEngine:
             jax.random.PRNGKey(0), np.float32(0.0), np.int32(0),
             np.float32(1.0))
         return np.asarray(last)
+
+    def debug_requests(self) -> List[dict]:
+        """Live in-flight table (the ``/debug/requests`` route): one
+        row per occupied slot and per queued request — trace id, age,
+        slot, phase, pages held, prefill chunks done, tokens emitted,
+        deadline headroom.  Read-mostly and engine-thread-racy by
+        design (a scrape must never block the step loop); a row for a
+        slot that frees mid-snapshot simply disappears next scrape."""
+        now = time.monotonic()
+        rows: List[dict] = []
+        for i, st in enumerate(list(self._slots)):
+            if st is None:
+                continue
+            req = st.req
+            rows.append({
+                "trace_id": req.trace.trace_id
+                if req.trace is not None else None,
+                "replica": self.name,
+                "slot": i,
+                "phase": st.phase,
+                "age_ms": round((now - req.t_enqueue) * 1e3, 3),
+                "prompt_len": len(req.prompt),
+                "prefill_pos": st.prefill_pos,
+                "chunks_done": st.chunks,
+                "pages": len(self._cache.slot_pages(i)),
+                "tokens": st.n_generated,
+                "max_new_tokens": req.max_new_tokens,
+                "speculative": st.spec,
+                "deadline_in_ms": None if req.deadline is None
+                else round((req.deadline - now) * 1e3, 3),
+            })
+        with self._cond:
+            queued = list(self._queue)
+        for req in queued:
+            if req.done():
+                continue
+            rows.append({
+                "trace_id": req.trace.trace_id
+                if req.trace is not None else None,
+                "replica": self.name,
+                "slot": None,
+                "phase": "queued",
+                "age_ms": round((now - req.t_enqueue) * 1e3, 3),
+                "prompt_len": len(req.prompt),
+                "tokens": 0,
+                "max_new_tokens": req.max_new_tokens,
+                "deadline_in_ms": None if req.deadline is None
+                else round((req.deadline - now) * 1e3, 3),
+            })
+        return rows
 
     def stats(self) -> dict:
         with self._cond:

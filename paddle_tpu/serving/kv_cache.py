@@ -327,6 +327,13 @@ class PagedKVCache:
 
         self.config = config
         self.scope = scope
+        # optional per-request tracing hook: ``on_event(slot, name,
+        # **attrs)`` fired on cache lifecycle events (cow_swap, evict,
+        # register) — the decode engine wires it to the owning
+        # request's timeline (observe/request_trace.py); ``slot`` is
+        # None for events with no slot owner (evictions during an
+        # admission allocation)
+        self.on_event = None
         self.allocator = PageAllocator(config.num_pages)
         self.prefix: Optional[PrefixIndex] = \
             PrefixIndex(config.page_size) if prefix_cache else None
@@ -347,6 +354,15 @@ class PagedKVCache:
                  c.head_dim)
         scope.set_var(K_PAGES_VAR, jnp.zeros(shape, c.dtype))
         scope.set_var(V_PAGES_VAR, jnp.zeros(shape, c.dtype))
+
+    def _fire(self, slot, name, **attrs) -> None:
+        hook = self.on_event
+        if hook is None:
+            return
+        try:
+            hook(slot, name, **attrs)
+        except Exception:  # noqa: BLE001 — instrumentation must never
+            stat_add("request_trace_errors")  # corrupt cache bookkeeping
 
     # -- refcounts --------------------------------------------------------
     def _incref(self, pid: int) -> None:
@@ -382,6 +398,7 @@ class PagedKVCache:
             on_evict=self._decref)
         if evicted:
             stat_add("decode_prefix_evictions", evicted)
+            self._fire(None, "evict", pages=evicted)
         return self.allocator.alloc(n)
 
     # -- slot lifecycle ---------------------------------------------------
@@ -462,9 +479,12 @@ class PagedKVCache:
         the release for future prompts to share."""
         if register_tokens and self.prefix is not None:
             n_pages = self.config.pages_for(len(register_tokens))
-            self.prefix.register(
+            new = self.prefix.register(
                 self._slot_pages[slot][:n_pages], register_tokens,
                 on_new=self._incref)
+            if new:
+                self._fire(slot, "register", pages=new,
+                           tokens=len(register_tokens))
         for pid in self._slot_refs[slot]:
             self._decref(pid)
         self._slot_pages[slot] = []
@@ -519,6 +539,8 @@ class PagedKVCache:
             # shared pages are held by the index and/or other slots, so
             # this decref can never free the page mid-copy
             self._decref(pid)
+            self._fire(slot, "cow_swap", src=pid, dst=dst,
+                       page_index=idx)
             plans.append((pid, dst))
         return plans
 

@@ -34,6 +34,20 @@ from .buckets import BucketSpec, bucket_feed_specs, feed_plans
 logger = logging.getLogger(__name__)
 
 
+def _debug_request_route(trace_id: str) -> Dict:
+    """GET ``/debug/request/<id>``: full timeline JSON for one trace
+    (in flight or retained), from the process trace store."""
+    from ..observe.request_trace import get_trace_store
+
+    tr = get_trace_store().get(trace_id)
+    if tr is None:
+        return {"error": f"no trace {trace_id!r} in flight or retained "
+                         f"(head-sampled out, or fell off the ring — "
+                         f"see FLAGS_request_trace_sample / "
+                         f"FLAGS_request_trace_ring)"}
+    return tr.to_dict()
+
+
 class ServingConfig:
     """Knobs for the serving layer (reference Paddle Serving's
     server-config proto, collapsed to what the TPU path needs)."""
@@ -119,7 +133,11 @@ class Server:
 
             self._kv = KVServer(self._config.http_port,
                                 routes={"/stats": self.stats,
-                                        "/health": self.health})
+                                        "/health": self.health,
+                                        "/debug/requests":
+                                            self.debug_requests,
+                                        "/debug/request/":
+                                            _debug_request_route})
             self._kv.start()
         self._t_start = time.monotonic()
         self._started = True
@@ -188,6 +206,11 @@ class Server:
                 out.get("serving_padded_rows", 0)
                 / max(rows + out.get("serving_padded_rows", 0), 1), 3)
         return out
+
+    def debug_requests(self) -> Dict:
+        """Live in-flight request table (GET ``/debug/requests``)."""
+        rows = self._batcher.debug_requests()
+        return {"requests": rows, "n": len(rows)}
 
     def health(self) -> Dict:
         depth = self._batcher.queue_depth
@@ -276,7 +299,12 @@ class DecodeServer:
 
             self._kv = KVServer(self._http_port,
                                 routes={"/stats": self.stats,
-                                        "/health": self.health})
+                                        "/health": self.health,
+                                        "/debug/requests":
+                                            self.debug_requests,
+                                        "/debug/request/":
+                                            _debug_request_route,
+                                        "/debug/slo": self.debug_slo})
             self._kv.start()
         self._t_start = time.monotonic()
         self._started = True
@@ -302,13 +330,34 @@ class DecodeServer:
     def http_port(self) -> Optional[int]:
         return self._kv.port if self._kv is not None else None
 
+    def debug_requests(self) -> Dict:
+        """GET ``/debug/requests``: replica-tagged live in-flight rows
+        aggregated across every engine (each row carries its replica
+        name and trace id; follow ``/debug/request/<id>`` for the full
+        timeline)."""
+        rows = []
+        for eng in self._engines:
+            rows.extend(eng.debug_requests())
+        return {"requests": rows, "n": len(rows),
+                "replicas": len(self._engines)}
+
+    def debug_slo(self) -> Dict:
+        """GET ``/debug/slo``: objectives, multi-window burn rates,
+        budget remaining, and goodput (observe/slo.py snapshot)."""
+        from ..observe import slo as _slo
+
+        return _slo.snapshot()
+
     def stats(self) -> Dict:
         per = [e.stats() for e in self._engines]
         hit = sum(p["prefix_hit_pages"] for p in per)
         total = sum(p["prefix_prompt_pages"] for p in per)
         proposed = sum(p["spec_proposed"] for p in per)
         accepted = sum(p["spec_accepted"] for p in per)
+        slo_snap = self.debug_slo()
         return {
+            "goodput_rps": slo_snap.get("goodput_rps", 0.0),
+            "slo_violations": slo_snap.get("violations_total", 0),
             "replicas": per,
             "n_replicas": len(per),
             "tokens_total": sum(p["tokens_total"] for p in per),

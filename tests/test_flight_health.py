@@ -176,7 +176,7 @@ class TestCkptFlightEvents:
 # ---------------------------------------------------------------------------
 
 BUNDLE_FILES = ("meta.json", "stacks.txt", "trace.json", "metrics.prom",
-                "flight.jsonl", "flags.json")
+                "flight.jsonl", "flags.json", "requests.json")
 
 
 class TestPostmortem:
@@ -721,6 +721,15 @@ class TestMFUGuard:
 class TestPostmortemCLI:
     def _bundle(self, tmp_path):
         flight.record("test/cli", marker="xyz")
+        # a retained violator so the bundle's requests.json section is
+        # populated (observe/request_trace.py)
+        from paddle_tpu.observe import request_trace as rt
+
+        store = rt.get_trace_store()
+        tr = store.start("decode", replica="replica-cli")
+        tr.event("admit", slot=0)
+        store.finish(tr, outcome="deadline", reason="cli smoke",
+                     violations=["ttft_p99"], latency_s=0.5)
         return health.dump_postmortem("cli_smoke",
                                       directory=str(tmp_path))
 
@@ -746,3 +755,8 @@ class TestPostmortemCLI:
         assert r.returncode == 0, r.stderr
         assert "postmortem bundle" in r.stdout
         assert "cli_smoke" in r.stdout
+        # the requests.json section renders: violator row + its SLO
+        # violation, plus the reqtrace pointer
+        assert "violators" in r.stdout
+        assert "ttft_p99" in r.stdout
+        assert "tools.reqtrace" in r.stdout

@@ -2,8 +2,11 @@
 
 A bundle is what the stall watchdog / crash hook / bench failure path
 leaves behind: ``meta.json``, ``stacks.txt``, ``trace.json``,
-``metrics.prom``, ``flight.jsonl``, ``flags.json`` in one
-``bundle_<ts>_<pid>_<reason>`` directory.  This reader is pure stdlib —
+``metrics.prom``, ``flight.jsonl``, ``flags.json``, ``memory.json``,
+``requests.json`` (per-request serving traces + SLO verdict — the
+violator table renders here, full timelines via
+``python -m tools.reqtrace``) in one ``bundle_<ts>_<pid>_<reason>``
+directory.  This reader is pure stdlib —
 it must work on a machine (or in a container) where the framework
 itself won't even import, because that is exactly when you need it.
 
@@ -24,7 +27,8 @@ import sys
 from typing import List, Optional
 
 BUNDLE_FILES = ("meta.json", "stacks.txt", "trace.json", "metrics.prom",
-                "flight.jsonl", "flags.json", "memory.json")
+                "flight.jsonl", "flags.json", "memory.json",
+                "requests.json")
 
 
 def _mb(nbytes) -> float:
@@ -187,6 +191,23 @@ def render(bundle: str, tail: int = 15, stacks: bool = False,
               f"used {_mb(g.get('hbm_used_bytes'))} MB, "
               f"limit {_mb(g.get('hbm_limit_bytes'))} MB\n")
 
+    # -- per-request traces + SLO verdict (observe/request_trace + slo) ----
+    rq = _read_json(os.path.join(bundle, "requests.json"))
+    if rq is not None:
+        w(f"\nrequests: {len(rq.get('retained') or [])} retained traces, "
+          f"{len(rq.get('inflight') or [])} in flight at dump "
+          f"(python -m tools.reqtrace "
+          f"{os.path.join(bundle, 'requests.json')})\n")
+        # the rendering lives once, in the sibling pure-stdlib reader
+        try:
+            from . import reqtrace as _reqtrace
+        except ImportError:  # pragma: no cover - run as a bare script
+            import reqtrace as _reqtrace
+        _reqtrace.render_slo(rq.get("slo") or {}, out)
+        viol = rq.get("violators") or []
+        if viol:
+            _reqtrace.render_table(viol, out, title="violators")
+
     # -- metrics -----------------------------------------------------------
     mt = _read_text(os.path.join(bundle, "metrics.prom"))
     if mt is not None:
@@ -200,7 +221,8 @@ def render(bundle: str, tail: int = 15, stacks: bool = False,
                 "health_", "hbm_", "executable_size", "mfu_flops",
                 "compile_seconds_count", "executable_hlo_ops",
                 "pass_layer_scan", "decode_", "ttft_", "tpot_",
-                "spec_accept_rate", "prefill_chunks")
+                "spec_accept_rate", "prefill_chunks", "slo_burn_rate",
+                "slo_budget_remaining", "goodput", "request_trace")
         for ln in rows:
             if metrics or any(k in ln for k in keys):
                 w(f"  {ln}\n")

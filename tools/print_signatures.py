@@ -48,6 +48,8 @@ MODULES = [
     "paddle_tpu.observe",
     "paddle_tpu.observe.flight",
     "paddle_tpu.observe.health",
+    "paddle_tpu.observe.request_trace",
+    "paddle_tpu.observe.slo",
     "paddle_tpu.observe.xla_stats",
     "paddle_tpu.ckpt",
     "paddle_tpu.framework.passes",

@@ -1,0 +1,230 @@
+"""Pretty-print per-request serving traces (observe/request_trace.py).
+
+Input is any of:
+
+- a postmortem bundle's ``requests.json`` (or a bundle directory /
+  ``postmortem`` parent — the newest bundle's section is used),
+- a single-trace JSON file (``/debug/request/<id>`` saved to disk),
+- a live ``/debug/request/<id>`` or ``/debug/requests`` URL.
+
+Pure stdlib on purpose: like ``tools/postmortem.py`` it must work on a
+machine where the framework itself won't import, because that is
+exactly when you are reading a violator's timeline.
+
+Usage::
+
+    python -m tools.reqtrace requests.json            # SLO verdict + violator table
+    python -m tools.reqtrace requests.json --id ID    # one trace's timeline
+    python -m tools.reqtrace requests.json --all      # every violator timeline
+    python -m tools.reqtrace http://HOST:PORT/debug/requests
+    python -m tools.reqtrace http://HOST:PORT/debug/request/ID
+
+A bundle's ``requests.json`` serializes FULL timelines for the
+violators only; retained/in-flight rows carry header+summary (hit a
+live ``/debug/request/<id>`` for a non-violator's events).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+
+def _load(src: str):
+    if src.startswith("http://") or src.startswith("https://"):
+        from urllib.request import urlopen
+
+        with urlopen(src, timeout=10) as r:
+            return json.loads(r.read().decode())
+    path = src
+    if os.path.isdir(path):
+        # a bundle dir (or a directory of bundles): use its
+        # requests.json — newest bundle wins, same rule as
+        # tools/postmortem.py
+        cand = os.path.join(path, "requests.json")
+        if not os.path.isfile(cand):
+            bundles = [os.path.join(path, d) for d in os.listdir(path)
+                       if d.startswith("bundle_")]
+            bundles = [b for b in bundles
+                       if os.path.isfile(os.path.join(b, "requests.json"))]
+            if not bundles:
+                raise FileNotFoundError(
+                    f"{path} holds no requests.json (not a bundle?)")
+            cand = os.path.join(max(bundles, key=os.path.getmtime),
+                                "requests.json")
+        path = cand
+    with open(path) as f:
+        return json.load(f)
+
+
+def _ms(v) -> str:
+    return "-" if v is None else f"{float(v):.1f}"
+
+
+def render_trace(tr: dict, out=None) -> None:
+    out = out or sys.stdout
+    w = out.write
+    s = tr.get("summary") or {}
+    w(f"trace {tr.get('trace_id', '?')}  kind={tr.get('kind', '?')}  "
+      f"replica={tr.get('replica', '?')}\n")
+    w(f"  outcome:  {tr.get('outcome', 'in-flight')}"
+      f"{'  (' + str(tr['reason']) + ')' if tr.get('reason') else ''}\n")
+    viol = tr.get("violations") or []
+    if viol:
+        w(f"  SLO violations: {', '.join(viol)}\n")
+    if s:
+        parts = []
+        for k, label, scale in (("latency_s", "latency", 1e3),
+                                ("ttft_s", "ttft", 1e3),
+                                ("tpot_s", "tpot", 1e3)):
+            if s.get(k) is not None:
+                parts.append(f"{label}={s[k] * scale:.1f}ms")
+        if s.get("n_tokens") is not None:
+            parts.append(f"tokens={s['n_tokens']}")
+        if s.get("prompt_len") is not None:
+            parts.append(f"prompt={s['prompt_len']}")
+        if parts:
+            w(f"  summary:  {'  '.join(parts)}\n")
+    attrs = tr.get("attrs") or {}
+    if attrs:
+        w(f"  attrs:    "
+          f"{' '.join(f'{k}={v}' for k, v in sorted(attrs.items()))}\n")
+    evs = tr.get("events") or []
+    if not evs and tr.get("n_events"):
+        # retained/in-flight rows in a bundle's requests.json carry
+        # header+summary only (violators serialize full timelines)
+        w(f"  timeline:  {tr['n_events']} events recorded but not "
+          f"serialized in this file — query a live "
+          f"/debug/request/{tr.get('trace_id', '')} for them\n")
+    if evs:
+        dropped = ", %d dropped" % tr["dropped_events"] \
+            if tr.get("dropped_events") else ""
+        w(f"  timeline ({len(evs)} events{dropped}):\n")
+        for ev in evs:
+            rest = {k: v for k, v in ev.items()
+                    if k not in ("t_ms", "name")}
+            body = "  ".join(f"{k}={v}" for k, v in rest.items())
+            w(f"    +{float(ev.get('t_ms', 0.0)):>10.3f}ms  "
+              f"{ev.get('name', '?'):<18} {body}\n")
+
+
+def render_table(rows: List[dict], out=None,
+                 title: str = "requests") -> None:
+    out = out or sys.stdout
+    w = out.write
+    w(f"{title} ({len(rows)}):\n")
+    if not rows:
+        return
+    w(f"  {'trace_id':<18} {'replica':<10} {'phase/outcome':<14} "
+      f"{'age/lat ms':>10} {'ttft ms':>8} {'tok':>4}  detail\n")
+    for r in rows:
+        s = r.get("summary") or {}
+        phase = r.get("phase") or r.get("outcome") or "?"
+        age = r.get("age_ms")
+        if age is None:
+            age = None if s.get("latency_s") is None \
+                else s["latency_s"] * 1e3
+        ttft = None if s.get("ttft_s") is None else s["ttft_s"] * 1e3
+        tok = r.get("tokens", s.get("n_tokens", ""))
+        detail = []
+        if r.get("violations"):
+            detail.append("SLO:" + ",".join(r["violations"]))
+        if r.get("reason"):
+            detail.append(str(r["reason"]))
+        if r.get("slot") is not None:
+            detail.append(f"slot={r['slot']}")
+        if r.get("chunks_done"):
+            detail.append(f"chunks={r['chunks_done']}")
+        w(f"  {str(r.get('trace_id', '?')):<18} "
+          f"{str(r.get('replica', '?')):<10} {str(phase):<14} "
+          f"{_ms(age):>10} {_ms(ttft):>8} {str(tok):>4}  "
+          f"{' '.join(detail)}\n")
+
+
+def render_slo(slo: dict, out=None) -> None:
+    out = out or sys.stdout
+    w = out.write
+    if not slo:
+        return
+    w(f"SLO verdict ({slo.get('observed', 0)} requests observed, "
+      f"{slo.get('violations_total', 0)} violations, goodput "
+      f"{slo.get('goodput_rps', 0.0):.3f} req/s):\n")
+    burns = slo.get("burn_rates") or {}
+    remaining = slo.get("budget_remaining") or {}
+    for o in slo.get("objectives") or []:
+        name = o.get("name", "?")
+        rates = burns.get(name) or {}
+        rate_s = "  ".join(f"{k}={v:.2f}x"
+                           for k, v in sorted(rates.items()))
+        thr = o.get("threshold_ms")
+        w(f"  {name:<12} budget={o.get('budget')}"
+          f"{'  thr=' + str(thr) + 'ms' if thr is not None else ''}  "
+          f"burn[{rate_s}]  "
+          f"budget_remaining={remaining.get(name, 1.0):.2%}\n")
+
+
+def render(doc, trace_id: Optional[str] = None, show_all: bool = False,
+           out=None) -> int:
+    out = out or sys.stdout
+    if isinstance(doc, dict) and "events" in doc \
+            and "trace_id" in doc:  # one full trace
+        render_trace(doc, out)
+        return 0
+    if isinstance(doc, dict) and "error" in doc and len(doc) == 1:
+        out.write(f"{doc['error']}\n")
+        return 1
+    if isinstance(doc, dict) and "requests" in doc:  # /debug/requests
+        render_table(doc.get("requests") or [], out,
+                     title="in-flight requests")
+        return 0
+    # a postmortem requests.json section
+    violators = doc.get("violators") or []
+    retained = doc.get("retained") or []
+    inflight = doc.get("inflight") or []
+    if trace_id is not None:
+        pool = {t.get("trace_id"): t
+                for t in retained + inflight}
+        pool.update({t.get("trace_id"): t for t in violators})
+        tr = pool.get(trace_id)
+        if tr is None:
+            out.write(f"no trace {trace_id!r} in this file "
+                      f"({len(pool)} known)\n")
+            return 1
+        render_trace(tr, out)
+        return 0
+    render_slo(doc.get("slo") or {}, out)
+    render_table(violators, out, title="\nviolators (full timelines)")
+    if show_all:
+        for tr in violators:
+            out.write("\n")
+            render_trace(tr, out)
+    out.write(f"\nretained traces: {len(retained)}   in-flight at dump: "
+              f"{len(inflight)}   (--id <violator id> for its "
+              f"timeline; non-violators carry summaries only)\n")
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.reqtrace",
+        description="Pretty-print paddle_tpu per-request serving traces")
+    ap.add_argument("src",
+                    help="requests.json / bundle dir / single-trace "
+                         "JSON / /debug URL")
+    ap.add_argument("--id", default=None,
+                    help="render one trace's full timeline")
+    ap.add_argument("--all", action="store_true",
+                    help="render every violator's full timeline")
+    args = ap.parse_args(argv)
+    try:
+        doc = _load(args.src)
+    except (OSError, ValueError) as e:
+        print(f"cannot load {args.src}: {e}", file=sys.stderr)
+        return 2
+    return render(doc, trace_id=args.id, show_all=args.all)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
