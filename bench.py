@@ -930,7 +930,107 @@ def bench_decode(pt, jax):
             "decode — the lossless-acceptance contract is broken")
     spec_speedup = spec_tps / base_tps
 
+    # -- quantized KV cache A/B at a FIXED pool byte budget --------------
+    # the pool is sized in BYTES (what the chip actually has), so int8
+    # pages + their scale planes fit ~2x the page count of bf16 pages —
+    # which is ~2x the concurrent slots the admission reservation covers
+    from paddle_tpu.monitor import stat_set
+    from paddle_tpu.serving.kv_cache import CacheConfig
+
+    def _kv_cfg(quantized, num_pages):
+        return CacheConfig(model.num_layers, model.num_heads,
+                           model.head_dim, num_slots=12, max_seq_len=64,
+                           page_size=8, num_pages=num_pages,
+                           dtype="bfloat16", quantized=quantized)
+
+    kv_budget = _kv_cfg(False, 13).cache_bytes()  # bf16 pool: 13 pages
+    q_pages = kv_budget // _kv_cfg(True, 2).per_page_pool_bytes()
+
+    def kv_capacity(kv_quant, num_pages):
+        # each request reserves exactly 2 pages (10 prompt + 6 new at
+        # page 8); slots (12) exceed what either pool can admit, so the
+        # measured peak is page-bound — the quantity under test
+        e = DecodeEngine(model, weights, DecodeConfig(
+            slots=12, max_seq_len=64, page_size=8,
+            num_pages=int(num_pages), max_queue=16, prefix_cache=False,
+            kv_quant=kv_quant, cache_dtype="bfloat16")).start()
+        try:
+            rr = [e.submit(list(rs.randint(1, DECODE_VOCAB, 10)),
+                           max_new_tokens=6,
+                           on_token=lambda t: time.sleep(0.05))
+                  for i in range(12)]
+            peak = 0
+            t_end = time.perf_counter() + 30
+            while time.perf_counter() < t_end \
+                    and not all(r.done() for r in rr):
+                peak = max(peak, e.live_slots)
+                time.sleep(0.005)
+            for r in rr:
+                r.result(timeout=120)
+        finally:
+            e.stop()
+        return peak
+
+    kv_cap_base = kv_capacity(False, 13)
+    kv_cap_quant = kv_capacity(True, q_pages)
+    gc.collect()
+
+    # quantized throughput + the quality tax, measured never assumed:
+    # teacher-forced greedy top-1 agreement and max-abs-logit delta of
+    # the quantized run against the full-precision recompute oracle
+    from paddle_tpu.ops.quant_ops import quant_quality_delta
+
+    def kv_phase(kv_quant):
+        e = DecodeEngine(model, weights, DecodeConfig(
+            slots=4, max_seq_len=128, page_size=DECODE_PAGE,
+            prefix_cache=False, kv_quant=kv_quant)).start()
+        try:
+            e.generate([1, 2], max_new_tokens=4)  # pay the compiles
+            t0 = time.perf_counter()
+            reqs = [e.submit(p, max_new_tokens=32, record_logits=True)
+                    for p in spec_prompts]
+            outs = [r.result(timeout=600) for r in reqs]
+            wall = time.perf_counter() - t0
+            oracle = None
+            if kv_quant:
+                # teacher-forced: the oracle replays the QUANTIZED
+                # run's own tokens so logits stay position-comparable
+                oracle = [
+                    np.stack([e.recompute_logits(list(p) + o[:t])
+                              for t in range(len(o))])
+                    for p, o in zip(spec_prompts, outs)]
+                quant_logits = [np.stack(r.logits_trace)
+                                for r in reqs]
+        finally:
+            e.stop()
+        toks = sum(len(o) for o in outs)
+        if not kv_quant:
+            return toks / wall, None
+        delta = quant_quality_delta(np.concatenate(quant_logits),
+                                    np.concatenate(oracle))
+        return toks / wall, delta
+
+    kv_base_tps, _ = kv_phase(False)
+    kv_quant_tps, kv_delta = kv_phase(True)
+    stat_set("decode_kv_quant_top1_agreement_ppm",
+             int(kv_delta["top1_agreement"] * 1e6))
+    gc.collect()
+
     return {
+        "decode_kv_quant_capacity": kv_cap_quant,
+        "decode_kv_unquant_capacity": kv_cap_base,
+        "decode_kv_quant_capacity_ratio": round(
+            kv_cap_quant / max(kv_cap_base, 1), 3),
+        "decode_kv_quant_pool_pages": int(q_pages),
+        "decode_kv_unquant_pool_pages": 13,
+        "decode_kv_quant_tokens_per_sec": round(kv_quant_tps, 1),
+        "decode_kv_unquant_tokens_per_sec": round(kv_base_tps, 1),
+        "decode_kv_quant_speedup": round(
+            kv_quant_tps / max(kv_base_tps, 1e-9), 3),
+        "decode_kv_quant_top1_agreement": round(
+            kv_delta["top1_agreement"], 4),
+        "decode_kv_quant_max_abs_logit_delta": round(
+            kv_delta["max_abs_logit_delta"], 6),
         "decode_tokens_per_sec": round(cont["tokens_per_sec"], 1),
         "ttft_ms_p99": round(cont["ttft_ms_p99"], 3),
         "tpot_ms_p50": round(cont["tpot_ms_p50"], 3),
@@ -956,6 +1056,82 @@ def bench_decode(pt, jax):
         "decode_spec_speedup": round(spec_speedup, 3),
         "decode_spec_accept_rate": round(spec_st["spec_accept_rate"], 4),
     }
+
+
+def bench_quant(pt, jax):
+    """Weight-only quantized inference (slim PostTrainingWeightQuantPass
+    + ops/quant_ops.dequant_matmul): a matmul-heavy inference program
+    run bf16-precision vs FLAGS_weight_quant=int8, emitting (1) the PR 8
+    ``hbm_required_bytes`` ratio — the executable no longer takes the
+    f32 weights as arguments, only the int8 carriers + scales, so the
+    predicted per-chip footprint should drop well below the 0.55x
+    acceptance bar — and (2) the ``quant_quality_delta`` report
+    (max-abs-logit delta + greedy top-1 agreement over a fixed eval
+    batch, mirrored onto /metrics as gauges)."""
+    import numpy as np
+
+    from paddle_tpu import layers
+    from paddle_tpu.framework.program import Program, program_guard
+    from paddle_tpu.monitor import stat_get
+    from paddle_tpu.ops.quant_ops import quant_quality_delta
+
+    # equal-width stack: XLA reuses ONE dequant temp buffer across the
+    # layers, so the carrier savings dominate the footprint even on the
+    # CPU reference path (the TPU Pallas path never materializes the
+    # dequantized weight at all)
+    depth, width, classes, batch = 6, 1024, 16, 64
+    main_p, startup = Program(), Program()
+    main_p.random_seed = 11
+    with program_guard(main_p, startup):
+        x = layers.data("x", [width])
+        h = x
+        for _ in range(depth):
+            h = layers.fc(h, width, act="relu")
+        logits = layers.fc(h, classes, bias_attr=False)
+    exe = pt.Executor()
+    scope = pt.framework.Scope()
+    exe.run(startup, scope=scope)
+    feed = {"x": np.random.RandomState(5).randn(batch, width)
+            .astype("f4")}
+
+    def phase(quant):
+        pt.set_flags({"FLAGS_weight_quant": "int8" if quant else ""})
+        try:
+            out = np.asarray(exe.run(main_p, feed=feed,
+                                     fetch_list=[logits],
+                                     scope=scope)[0])
+            t0 = time.perf_counter()
+            for _ in range(8):
+                out = np.asarray(exe.run(main_p, feed=feed,
+                                         fetch_list=[logits],
+                                         scope=scope)[0])
+            wall = time.perf_counter() - t0
+        finally:
+            pt.set_flags({"FLAGS_weight_quant": ""})
+        return out, stat_get("hbm_required_bytes"), wall / 8
+
+    ref, hbm_ref, t_ref = phase(False)
+    q, hbm_q, t_q = phase(True)
+    delta = quant_quality_delta(q, ref)
+    out = {
+        "quant_quality_delta": {
+            "max_abs_logit_delta": round(
+                delta["max_abs_logit_delta"], 6),
+            "top1_agreement": round(delta["top1_agreement"], 4),
+        },
+        "quant_quality_top1_agreement": round(
+            delta["top1_agreement"], 4),
+        "weight_quant_step_time_ratio": round(
+            t_q / max(t_ref, 1e-9), 3),
+    }
+    if hbm_ref and hbm_q:
+        # PR 8 accounting: predicted per-chip executable footprint;
+        # absent (no memory_analysis on this jax) the ratio is omitted
+        # rather than guessed
+        out["weight_quant_hbm_bytes"] = int(hbm_q)
+        out["weight_quant_baseline_hbm_bytes"] = int(hbm_ref)
+        out["weight_quant_hbm_ratio"] = round(hbm_q / hbm_ref, 3)
+    return out
 
 
 CKPT_ARRAYS = 16
@@ -1221,6 +1397,12 @@ def main():
         result.update(bench_decode(pt, jax))
     except Exception as e:
         errors["decode"] = f"{type(e).__name__}: {e}"[:500]
+    try:
+        # weight-only quantized inference: hbm_required_bytes ratio +
+        # the measured quality tax (quant_quality_delta)
+        result.update(bench_quant(pt, jax))
+    except Exception as e:
+        errors["quant"] = f"{type(e).__name__}: {e}"[:500]
     # tensor-parallel flagship (dp×mp mesh) — only where a mesh exists;
     # single-chip rounds skip it silently (the MULTICHIP dryrun's tp
     # leg covers the 8-virtual-device case every round)

@@ -317,6 +317,28 @@ define_flag("slo_windows_s", "60,300",
             "multi-window burn-rate evaluation (SRE-workbook style: "
             "short window catches fast burn, long window slow bleed); "
             "goodput is measured over the shortest window")
+define_flag("weight_quant", "",
+            "post-training weight-only quantization "
+            "(slim/quantization.py PostTrainingWeightQuantPass): rewrite "
+            "matmul-family weights to a compact carrier + per-output-"
+            "channel scales lowered through the dequant-fused "
+            "ops/quant_ops.dequant_matmul kernel.  '' = off; 'int8' = "
+            "symmetric int8; 'fp8_e4m3' = float8 e4m3 where the "
+            "installed jax has the dtype (probed via jax_compat, falls "
+            "back to int8 with quant_fp8_unavailable counted).  "
+            "Per-program override: slim.quantization.mark_weight_quant",
+            affects_lowering=True)
+define_flag("decode_kv_quant", False,
+            "decode engine: store KV-cache pages int8 with a parallel "
+            "per-page scale pool (serving/kv_cache.py) — scales are "
+            "per position-in-page per head, written by the SAME step "
+            "that writes the page bytes, so stored content is "
+            "write-once and order-independent (speculative decode "
+            "stays bitwise-equal to its own non-speculative quantized "
+            "run).  Roughly halves bytes per page vs bf16, so a fixed "
+            "pool byte budget holds ~2x the pages -> ~2x decode slots; "
+            "attention dequantizes pages inline in both the reference "
+            "and Pallas paths")
 define_flag("decode_spec_k", 0,
             "decode engine: speculative decoding window — a draft "
             "model (DecodeEngine(draft_model=, draft_weights=)) "

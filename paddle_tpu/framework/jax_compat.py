@@ -146,6 +146,21 @@ def device_memory_stats(device=None):
     return dict(ms)
 
 
+def float8_e4m3_dtype():
+    """The float8 e4m3 dtype of the installed jax (weight-only fp8
+    serving, ops/quant_ops.py), or None when this jax/ml_dtypes build
+    lacks it — the quant pass then degrades to int8 and counts
+    ``quant_fp8_unavailable`` so the telemetry says why the mode flag
+    had no effect."""
+    import jax.numpy as jnp
+
+    for name in ("float8_e4m3fn", "float8_e4m3"):
+        dt = getattr(jnp, name, None)
+        if dt is not None:
+            return dt
+    return None
+
+
 def axis_size(axis_name):
     """``lax.axis_size`` (newer jax); older jax constant-folds
     ``psum(1, axis)`` to the same static int inside shard_map."""

@@ -276,16 +276,50 @@ class Pass:
 PASS_REGISTRY: Dict[str, type] = {}
 
 
-def register_pass(cls):
+def register_pass(cls=None, *, before: Optional[str] = None):
     """Register a Pass subclass into the ordered default registry and
     rebuild the default pipeline on next use (a registration after the
-    first Executor run would otherwise be silently inert)."""
+    first Executor run would otherwise be silently inert).  ``before``
+    inserts the pass ahead of an already-registered name instead of
+    appending — how a pass defined outside this module (the weight-quant
+    pass in slim/quantization.py) claims its pipeline position."""
+    if cls is None:
+        return lambda c: register_pass(c, before=before)
     global _default_pipeline
     if cls.name in PASS_REGISTRY:
         raise KeyError(f"pass {cls.name!r} already registered")
-    PASS_REGISTRY[cls.name] = cls
+    if before is None:
+        PASS_REGISTRY[cls.name] = cls
+    else:
+        if before not in PASS_REGISTRY:
+            raise KeyError(f"register_pass(before={before!r}): no such "
+                           f"registered pass")
+        items = []
+        for name, c in PASS_REGISTRY.items():
+            if name == before:
+                items.append((cls.name, cls))
+            items.append((name, c))
+        PASS_REGISTRY.clear()
+        PASS_REGISTRY.update(items)
     _default_pipeline = None
     return cls
+
+
+_EXTERNAL_PASSES_LOADED = False
+
+
+def _ensure_external_passes():
+    """Import the modules that register passes from OUTSIDE this file
+    so the default registry is complete before a pipeline snapshots it.
+    Lazy (first pipeline construction, i.e. first Executor dispatch):
+    importing slim at module-import time would cycle through the
+    framework package."""
+    global _EXTERNAL_PASSES_LOADED
+    if _EXTERNAL_PASSES_LOADED:
+        return
+    _EXTERNAL_PASSES_LOADED = True
+    from ..slim import quantization  # noqa: F401 — import registers
+                                     # PostTrainingWeightQuantPass
 
 
 def _numel(shape) -> int:
@@ -2020,6 +2054,8 @@ class PassPipeline:
     """
 
     def __init__(self, passes: Optional[Sequence[Pass]] = None):
+        if passes is None:
+            _ensure_external_passes()
         self._passes: Tuple[Pass, ...] = tuple(
             passes if passes is not None
             else (cls() for cls in PASS_REGISTRY.values()))

@@ -68,9 +68,18 @@ def decode_attention_reference(q, k, v, lengths, *, sm_scale=None):
     return out.astype(q.dtype)
 
 
-def _decode_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
-                   m_scr, l_scr, acc_scr, *, sm_scale, page, n_pages):
+def _decode_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, *rest,
+                   sm_scale, page, n_pages, quantized=False):
     import jax.experimental.pallas as pl
+
+    if quantized:
+        # int8 pages ride with their per-page scale planes; the
+        # dequant happens HERE, on the tile already in VMEM — the f32
+        # K/V never exists in HBM (the dequant-fused contract)
+        ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        o_ref, m_scr, l_scr, acc_scr = rest
+        ks_ref = vs_ref = None
 
     s_idx = pl.program_id(0)
     p_idx = pl.program_id(1)
@@ -89,6 +98,9 @@ def _decode_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
         q = q_ref[0].astype(jnp.float32)              # (H, D)
         k = k_ref[0].astype(jnp.float32)              # (page, H, D)
         v = v_ref[0].astype(jnp.float32)
+        if ks_ref is not None:
+            k = k * ks_ref[0].astype(jnp.float32)[..., None]
+            v = v * vs_ref[0].astype(jnp.float32)[..., None]
         # scores per head over this page's positions: (H, page)
         s = lax.dot_general(
             q, k, (((1,), (2,)), ((0,), (1,))),
@@ -117,7 +129,7 @@ def _decode_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
 
 
 def _paged_call(q, k_pages, v_pages, page_table, lengths, sm_scale,
-                interpret):
+                interpret, k_scales=None, v_scales=None):
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -125,20 +137,32 @@ def _paged_call(q, k_pages, v_pages, page_table, lengths, sm_scale,
     pps = page_table.shape[1]
     page = k_pages.shape[1]
     flat_table = page_table.reshape(-1).astype(jnp.int32)
+    quantized = k_scales is not None
 
+    in_specs = [
+        pl.BlockSpec((1, h, d), lambda s, p, pt, ln: (s, 0, 0)),
+        # THE paged-attention move: the K/V block index is read out
+        # of the prefetched page table, so each grid step DMAs one
+        # pool page — no gather materialization
+        pl.BlockSpec((1, page, h, d),
+                     lambda s, p, pt, ln: (pt[s * pps + p], 0, 0, 0)),
+        pl.BlockSpec((1, page, h, d),
+                     lambda s, p, pt, ln: (pt[s * pps + p], 0, 0, 0)),
+    ]
+    operands = [q, k_pages, v_pages]
+    if quantized:
+        # the scale planes ride the same page-id indexing as the pages
+        in_specs += [
+            pl.BlockSpec((1, page, h),
+                         lambda s, p, pt, ln: (pt[s * pps + p], 0, 0)),
+            pl.BlockSpec((1, page, h),
+                         lambda s, p, pt, ln: (pt[s * pps + p], 0, 0)),
+        ]
+        operands += [k_scales, v_scales]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,  # (flat page table, lengths)
         grid=(n_slots, pps),
-        in_specs=[
-            pl.BlockSpec((1, h, d), lambda s, p, pt, ln: (s, 0, 0)),
-            # THE paged-attention move: the K/V block index is read out
-            # of the prefetched page table, so each grid step DMAs one
-            # pool page — no gather materialization
-            pl.BlockSpec((1, page, h, d),
-                         lambda s, p, pt, ln: (pt[s * pps + p], 0, 0, 0)),
-            pl.BlockSpec((1, page, h, d),
-                         lambda s, p, pt, ln: (pt[s * pps + p], 0, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, h, d), lambda s, p, pt, ln: (s, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((h, _LANES), jnp.float32),   # running max
@@ -147,18 +171,32 @@ def _paged_call(q, k_pages, v_pages, page_table, lengths, sm_scale,
         ],
     )
     kern = functools.partial(_decode_kernel, sm_scale=sm_scale,
-                             page=page, n_pages=pps)
+                             page=page, n_pages=pps,
+                             quantized=quantized)
     return pl.pallas_call(
         kern,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((n_slots, h, d), q.dtype),
         interpret=interpret,
-    )(flat_table, lengths.astype(jnp.int32), q, k_pages, v_pages)
+    )(flat_table, lengths.astype(jnp.int32), *operands)
+
+
+def _gather_dequant(pages, scales, page_table):
+    """Reference-path page gather: [S, pps*page, H, D] at full width,
+    dequantized inline when a scale pool rides along."""
+    s, pps = page_table.shape
+    page = pages.shape[1]
+    g = pages[page_table]                    # [S, pps, page, H, D]
+    if scales is not None:
+        g = g.astype(jnp.float32) \
+            * scales[page_table].astype(jnp.float32)[..., None]
+    return g.reshape(s, pps * page, *pages.shape[2:])
 
 
 def paged_decode_attention(q, k_pages, v_pages, page_table, lengths, *,
                            sm_scale=None, use_pallas="auto",
-                           interpret=False):
+                           interpret=False, k_scales=None,
+                           v_scales=None):
     """Decode attention straight off the page pool.
 
     q [S,H,D]; k/v_pages [P,page,H,D] (ONE layer's pool); page_table
@@ -166,7 +204,11 @@ def paged_decode_attention(q, k_pages, v_pages, page_table, lengths, *,
     Pallas kernel on the TPU backend only (CPU gets the gather+mask
     reference, keeping tier-1 Mosaic-free), 'always' forces it
     (combine with interpret=True off-TPU), 'never' forces the
-    reference.
+    reference.  ``k_scales``/``v_scales`` [P,page,H] arm the quantized
+    path (FLAGS_decode_kv_quant): pages are int8 and BOTH paths
+    dequantize them inline — the Pallas kernel per tile in VMEM, the
+    reference during the gather — before the one shared masked-softmax
+    formulation.
     """
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
@@ -175,12 +217,11 @@ def paged_decode_attention(q, k_pages, v_pages, page_table, lengths, *,
             else "never"
     if use_pallas == "always":
         return _paged_call(q, k_pages, v_pages, page_table, lengths,
-                           float(sm_scale), interpret)
+                           float(sm_scale), interpret,
+                           k_scales=k_scales, v_scales=v_scales)
     # reference: gather the page table to full width, then mask
-    s, pps = page_table.shape
-    page = k_pages.shape[1]
-    k = k_pages[page_table].reshape(s, pps * page, *k_pages.shape[2:])
-    v = v_pages[page_table].reshape(s, pps * page, *v_pages.shape[2:])
+    k = _gather_dequant(k_pages, k_scales, page_table)
+    v = _gather_dequant(v_pages, v_scales, page_table)
     return decode_attention_reference(q, k, v, lengths,
                                       sm_scale=sm_scale)
 
@@ -188,15 +229,20 @@ def paged_decode_attention(q, k_pages, v_pages, page_table, lengths, *,
 # -- multi-row variant: chunked prefill + speculative verify --------------
 
 
-def _chunk_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
-                  m_scr, l_scr, acc_scr, *, sm_scale, page, n_pages,
-                  n_rows):
+def _chunk_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, *rest,
+                  sm_scale, page, n_pages, n_rows, quantized=False):
     """The decode kernel generalized to R query rows per slot (a
     prefill chunk or a speculative t0+draft window).  Row r of slot s
     attends positions ``t < len_ref[s*R + r]`` — per-row causal masks
     over one shared page table, so shared and partially-filled pages
     need no special casing beyond the mask."""
     import jax.experimental.pallas as pl
+
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        o_ref, m_scr, l_scr, acc_scr = rest
+        ks_ref = vs_ref = None
 
     s_idx = pl.program_id(0)
     p_idx = pl.program_id(1)
@@ -219,6 +265,9 @@ def _chunk_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
         q = q_ref[0].astype(jnp.float32)              # (R, H, D)
         k = k_ref[0].astype(jnp.float32)              # (page, H, D)
         v = v_ref[0].astype(jnp.float32)
+        if ks_ref is not None:  # dequant-fused: int8 tile * VMEM scale
+            k = k * ks_ref[0].astype(jnp.float32)[..., None]
+            v = v * vs_ref[0].astype(jnp.float32)[..., None]
         # scores per head per row over this page: (H, R, page)
         s = lax.dot_general(
             q, k, (((2,), (2,)), ((1,), (1,))),
@@ -247,7 +296,7 @@ def _chunk_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
 
 
 def _chunk_call(q, k_pages, v_pages, page_table, row_lengths, sm_scale,
-                interpret):
+                interpret, k_scales=None, v_scales=None):
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -256,18 +305,29 @@ def _chunk_call(q, k_pages, v_pages, page_table, row_lengths, sm_scale,
     page = k_pages.shape[1]
     flat_table = page_table.reshape(-1).astype(jnp.int32)
     flat_lengths = row_lengths.reshape(-1).astype(jnp.int32)
+    quantized = k_scales is not None
 
+    in_specs = [
+        pl.BlockSpec((1, n_rows, h, d),
+                     lambda s, p, pt, ln: (s, 0, 0, 0)),
+        pl.BlockSpec((1, page, h, d),
+                     lambda s, p, pt, ln: (pt[s * pps + p], 0, 0, 0)),
+        pl.BlockSpec((1, page, h, d),
+                     lambda s, p, pt, ln: (pt[s * pps + p], 0, 0, 0)),
+    ]
+    operands = [q, k_pages, v_pages]
+    if quantized:
+        in_specs += [
+            pl.BlockSpec((1, page, h),
+                         lambda s, p, pt, ln: (pt[s * pps + p], 0, 0)),
+            pl.BlockSpec((1, page, h),
+                         lambda s, p, pt, ln: (pt[s * pps + p], 0, 0)),
+        ]
+        operands += [k_scales, v_scales]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,  # (flat page table, flat row lengths)
         grid=(n_slots, pps),
-        in_specs=[
-            pl.BlockSpec((1, n_rows, h, d),
-                         lambda s, p, pt, ln: (s, 0, 0, 0)),
-            pl.BlockSpec((1, page, h, d),
-                         lambda s, p, pt, ln: (pt[s * pps + p], 0, 0, 0)),
-            pl.BlockSpec((1, page, h, d),
-                         lambda s, p, pt, ln: (pt[s * pps + p], 0, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, n_rows, h, d),
                                lambda s, p, pt, ln: (s, 0, 0, 0)),
         scratch_shapes=[
@@ -277,18 +337,20 @@ def _chunk_call(q, k_pages, v_pages, page_table, row_lengths, sm_scale,
         ],
     )
     kern = functools.partial(_chunk_kernel, sm_scale=sm_scale,
-                             page=page, n_pages=pps, n_rows=n_rows)
+                             page=page, n_pages=pps, n_rows=n_rows,
+                             quantized=quantized)
     return pl.pallas_call(
         kern,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((n_slots, n_rows, h, d), q.dtype),
         interpret=interpret,
-    )(flat_table, flat_lengths, q, k_pages, v_pages)
+    )(flat_table, flat_lengths, *operands)
 
 
 def paged_chunk_attention(q, k_pages, v_pages, page_table, row_lengths,
                           *, sm_scale=None, use_pallas="auto",
-                          interpret=False):
+                          interpret=False, k_scales=None,
+                          v_scales=None):
     """Multi-row attention off the page pool — R query rows per slot.
 
     q [S,R,H,D]; k/v_pages [P,page,H,D] (ONE layer's pool); page_table
@@ -300,7 +362,8 @@ def paged_chunk_attention(q, k_pages, v_pages, page_table, row_lengths,
     gathered K/V across its rows and reuses
     ``decode_attention_reference`` VERBATIM — the single masked-softmax
     formulation at one width that keeps every cache path bitwise-equal
-    to the full-recompute oracle.  ``use_pallas`` dispatch matches
+    to the full-recompute oracle.  ``use_pallas`` dispatch and the
+    quantized ``k_scales``/``v_scales`` contract match
     ``paged_decode_attention``.
     """
     if sm_scale is None:
@@ -310,13 +373,11 @@ def paged_chunk_attention(q, k_pages, v_pages, page_table, row_lengths,
             else "never"
     if use_pallas == "always":
         return _chunk_call(q, k_pages, v_pages, page_table, row_lengths,
-                           float(sm_scale), interpret)
+                           float(sm_scale), interpret,
+                           k_scales=k_scales, v_scales=v_scales)
     s, r = q.shape[:2]
-    pps = page_table.shape[1]
-    page = k_pages.shape[1]
-    t = pps * page
-    k = k_pages[page_table].reshape(s, t, *k_pages.shape[2:])
-    v = v_pages[page_table].reshape(s, t, *v_pages.shape[2:])
+    k = _gather_dequant(k_pages, k_scales, page_table)
+    v = _gather_dequant(v_pages, v_scales, page_table)
     kr = jnp.broadcast_to(k[:, None], (s, r) + k.shape[1:]) \
         .reshape(s * r, *k.shape[1:])
     vr = jnp.broadcast_to(v[:, None], (s, r) + v.shape[1:]) \

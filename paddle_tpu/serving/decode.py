@@ -90,14 +90,33 @@ from .buckets import (BucketSpec, DeadlineExceededError, QueueFullError,
                       RequestTooLargeError, ServerClosedError,
                       prefill_bucket_grid)
 from . import kv_cache
-from .kv_cache import CacheConfig, PagedKVCache, K_PAGES_VAR, V_PAGES_VAR
+from .kv_cache import (CacheConfig, PagedKVCache, K_PAGES_VAR,
+                       V_PAGES_VAR, K_SCALES_VAR, V_SCALES_VAR)
 
 DRAFT_K_PAGES_VAR = "__decode_draft_k_pages__"
 DRAFT_V_PAGES_VAR = "__decode_draft_v_pages__"
+DRAFT_K_SCALES_VAR = "__decode_draft_k_scales__"
+DRAFT_V_SCALES_VAR = "__decode_draft_v_scales__"
 
-_STATE_VARS = (K_PAGES_VAR, V_PAGES_VAR)
+# the target-model state tuple comes from PagedKVCache.state_var_names()
+# (page pools + scale pools when quantized); only the draft tuple is
+# assembled here
 _DRAFT_VARS = (DRAFT_K_PAGES_VAR, DRAFT_V_PAGES_VAR)
 _DONE = object()  # stream sentinel
+
+
+def _split_state(state, quantized):
+    """Persistent-state tuple -> (k_pages, v_pages, k_scales,
+    v_scales); the scale pools exist only under FLAGS_decode_kv_quant."""
+    if quantized:
+        kp, vp, ks, vs = state
+        return kp, vp, ks, vs
+    kp, vp = state
+    return kp, vp, None, None
+
+
+def _join_state(kp, vp, ks, vs, quantized):
+    return (kp, vp, ks, vs) if quantized else (kp, vp)
 
 
 # ---------------------------------------------------------------------------
@@ -355,7 +374,8 @@ class DecodeConfig:
                  cache_dtype="float32",
                  prefix_cache: Optional[bool] = None,
                  prefill_chunk_pages: Optional[int] = None,
-                 spec_k: Optional[int] = None):
+                 spec_k: Optional[int] = None,
+                 kv_quant: Optional[bool] = None):
         from ..framework import flags
 
         self.slots = int(slots if slots is not None
@@ -382,6 +402,8 @@ class DecodeConfig:
             else flags.flag("decode_prefill_chunk_pages"))
         self.spec_k = int(spec_k if spec_k is not None
                           else flags.flag("decode_spec_k"))
+        self.kv_quant = bool(kv_quant if kv_quant is not None
+                             else flags.flag("decode_kv_quant"))
 
 
 class DecodeEngine:
@@ -436,13 +458,18 @@ class DecodeEngine:
         self._cache = PagedKVCache(
             CacheConfig(model.num_layers, model.num_heads, model.head_dim,
                         c.slots, c.max_seq_len, c.page_size,
-                        num_pages=c.num_pages, dtype=c.cache_dtype),
+                        num_pages=c.num_pages, dtype=c.cache_dtype,
+                        quantized=c.kv_quant),
             self._scope, prefix_cache=c.prefix_cache)
         # per-request timeline hook: claim/CoW/register/evict events
         # from the cache land on the owning request's trace
         self._cache.on_event = self._on_cache_event
         self._admitting = None  # request whose claim() is in flight
         self.weights = jax.tree_util.tree_map(jax.numpy.asarray, weights)
+        # persistent-state tuples every jitted step threads (the scale
+        # pools join them under FLAGS_decode_kv_quant)
+        self._state_vars = self._cache.state_var_names()
+        self._draft_state_vars = ()
         if draft_model is not None:
             self.draft_weights = jax.tree_util.tree_map(
                 jax.numpy.asarray, draft_weights)
@@ -450,18 +477,31 @@ class DecodeEngine:
             dshape = (draft_model.num_layers, cc.num_pages, cc.page_size,
                       draft_model.num_heads, draft_model.head_dim)
             self._scope.set_var(DRAFT_K_PAGES_VAR,
-                                jnp.zeros(dshape, cc.dtype))
+                                jnp.zeros(dshape, cc.store_dtype))
             self._scope.set_var(DRAFT_V_PAGES_VAR,
-                                jnp.zeros(dshape, cc.dtype))
+                                jnp.zeros(dshape, cc.store_dtype))
+            self._draft_state_vars = _DRAFT_VARS
+            if cc.quantized:
+                dsshape = (draft_model.num_layers, cc.num_pages,
+                           cc.page_size, draft_model.num_heads)
+                for nm in (DRAFT_K_SCALES_VAR, DRAFT_V_SCALES_VAR):
+                    self._scope.set_var(
+                        nm, jnp.full(dsshape, kv_cache.SCALE_EPS,
+                                     cc.scale_dtype))
+                self._draft_state_vars = _DRAFT_VARS + (
+                    DRAFT_K_SCALES_VAR, DRAFT_V_SCALES_VAR)
+                # freed-page scale resets + the debug_check audit must
+                # cover the draft pools too (same page ids)
+                self._cache.scale_vars += [DRAFT_K_SCALES_VAR,
+                                           DRAFT_V_SCALES_VAR]
         self._buckets = BucketSpec(
             (1,), prefill_bucket_grid(c.max_seq_len, c.page_size))
         self._step_fn = self._build_step_fn(model)
-        self._prefill_fns = {}   # (t_pad, which) -> jitted prefill
+        self._prefill_fns = {}   # (t_pad, which, qz) -> jitted prefill
         self._rows_fns = {}      # (rows, slots, which) -> jitted multirow
         self._propose_fn = None  # draft k-token burst (lazy)
         self._cow_fn = None      # page copy across every pool (lazy)
-        self._cow_state = _STATE_VARS + (
-            _DRAFT_VARS if draft_model is not None else ())
+        self._cow_state = self._state_vars + self._draft_state_vars
         self._slots: List[Optional[_SlotState]] = [None] * c.slots
         self._queue = collections.deque()
         self._cond = threading.Condition()
@@ -505,40 +545,47 @@ class DecodeEngine:
                       **attrs)
 
     # -- jitted step builders --------------------------------------------
-    def _attend(self, q, k_pages, v_pages, layer, page_table, lengths):
+    def _attend(self, q, k_pages, v_pages, k_scales, v_scales, layer,
+                page_table, lengths):
         from ..ops.pallas_decode_attention import paged_decode_attention
 
         # all backend dispatch (auto/always/never, Pallas vs the
-        # gather+mask reference) lives in ONE place: the op itself
+        # gather+mask reference) lives in ONE place: the op itself —
+        # including the quantized dequant-inline paths
         return paged_decode_attention(
             q, k_pages[layer], v_pages[layer], page_table, lengths,
             use_pallas=self.config.use_pallas,
-            interpret=self.config.interpret)
+            interpret=self.config.interpret,
+            k_scales=None if k_scales is None else k_scales[layer],
+            v_scales=None if v_scales is None else v_scales[layer])
 
-    def _token_step_body(self, model, weights, k_pages, v_pages, tokens,
-                         positions, page_table, write_page, write_off):
+    def _token_step_body(self, model, weights, k_pages, v_pages,
+                         k_scales, v_scales, tokens, positions,
+                         page_table, write_page, write_off):
         """One single-token step of ``model`` over the page pools:
         embed -> per-layer (write K/V at (write_page, write_off),
         attend over the slot's live history) -> logits.  Shared
         VERBATIM by the target step and the draft proposal burst so
-        both read the cache through the one formulation."""
+        both read the cache through the one formulation.  Quantized
+        pools (scales not None) write int8 + per-position scales and
+        attention dequantizes inline."""
         x = model._embed(weights, tokens, positions)       # [S, Dm]
         lengths = positions + 1  # the token written THIS step included
         for l in range(model.num_layers):
             lw = weights["layers"][l]
             h = model._ln(x, lw["ln1_g"], lw["ln1_b"])
             q, k, v = model._qkv(lw, h)                    # [S, H, D]
-            k_pages = kv_cache.scatter_token_layer(
-                k_pages, l, k, write_page, write_off)
-            v_pages = kv_cache.scatter_token_layer(
-                v_pages, l, v, write_page, write_off)
-            ctx = self._attend(q, k_pages, v_pages, l, page_table,
-                               lengths)
+            k_pages, k_scales = kv_cache.write_token_layer(
+                k_pages, k_scales, l, k, write_page, write_off)
+            v_pages, v_scales = kv_cache.write_token_layer(
+                v_pages, v_scales, l, v, write_page, write_off)
+            ctx = self._attend(q, k_pages, v_pages, k_scales, v_scales,
+                               l, page_table, lengths)
             x = x + model._attn_out(lw, ctx)
             x = x + model._mlp(
                 lw, model._ln(x, lw["ln2_g"], lw["ln2_b"]))
         logits = model._head(weights, x)                   # [S, V]
-        return logits, k_pages, v_pages
+        return logits, k_pages, v_pages, k_scales, v_scales
 
     def _build_step_fn(self, model):
         import jax
@@ -546,21 +593,24 @@ class DecodeEngine:
 
         from ..ops.sampling_ops import sample_tokens
 
+        qz = self.config.kv_quant
+
         def step(state, weights, tokens, positions, live, page_table,
                  write_page, write_off, base_keys, counters, temp, top_k,
                  top_p):
-            k_pages, v_pages = state
-            logits, k_pages, v_pages = self._token_step_body(
-                model, weights, k_pages, v_pages, tokens, positions,
+            kp, vp, ks, vs = _split_state(state, qz)
+            logits, kp, vp, ks, vs = self._token_step_body(
+                model, weights, kp, vp, ks, vs, tokens, positions,
                 page_table, write_page, write_off)
             keys = jax.vmap(jax.random.fold_in)(base_keys, counters)
             nxt = sample_tokens(keys, logits, temp, top_k, top_p)
             nxt = jnp.where(live, nxt, 0)
-            return (nxt, logits), (k_pages, v_pages)
+            return (nxt, logits), _join_state(kp, vp, ks, vs, qz)
 
         return jax.jit(step, donate_argnums=(0,))
 
-    def _build_prefill_fn(self, t_pad: int, model):
+    def _build_prefill_fn(self, t_pad: int, model,
+                          quantized: Optional[bool] = None):
         import jax
         import jax.numpy as jnp
 
@@ -572,10 +622,11 @@ class DecodeEngine:
         t_max = cc.max_seq_len
         n_bp = t_pad // cc.page_size
         cdt = cc.dtype
+        qz = cc.quantized if quantized is None else bool(quantized)
 
         def prefill(state, weights, tokens, length, pages, base_key,
                     temp, top_k, top_p):
-            k_pages, v_pages = state
+            k_pages, v_pages, k_scales, v_scales = _split_state(state, qz)
             positions = jnp.arange(t_pad, dtype=jnp.int32)
             x = model._embed(weights, tokens, positions)    # [T_pad, Dm]
             row_lengths = positions + 1
@@ -583,17 +634,27 @@ class DecodeEngine:
                 lw = weights["layers"][l]
                 h = model._ln(x, lw["ln1_g"], lw["ln1_b"])
                 q, k, v = model._qkv(lw, h)                 # [T_pad, H, D]
-                k_pages = kv_cache.scatter_prompt_layer(
-                    k_pages, l, k, pages[:n_bp])
-                v_pages = kv_cache.scatter_prompt_layer(
-                    v_pages, l, v, pages[:n_bp])
+                k_pages, k_scales = kv_cache.write_prompt_layer(
+                    k_pages, k_scales, l, k, pages[:n_bp])
+                v_pages, v_scales = kv_cache.write_prompt_layer(
+                    v_pages, v_scales, l, v, pages[:n_bp])
                 # attention at FULL cache width through the SAME cache
-                # dtype the pages store — each row's numerics are the
-                # ones decode will reproduce from the pages, which is
-                # the bitwise prefix-cache contract
+                # representation the pages store — each row's numerics
+                # are the ones decode will reproduce from the pages,
+                # which is the bitwise prefix-cache contract.  In
+                # quantized mode that representation is the local
+                # quant-dequant round trip (identical bytes to what
+                # write_prompt_layer just stored).
+                if qz:
+                    kq, ksc = kv_cache.quantize_kv(k)
+                    vq, vsc = kv_cache.quantize_kv(v)
+                    kl = kv_cache.dequantize_kv(kq, ksc, cdt)
+                    vl = kv_cache.dequantize_kv(vq, vsc, cdt)
+                else:
+                    kl, vl = k.astype(cdt), v.astype(cdt)
                 shape = (t_max, model.num_heads, model.head_dim)
-                kf = jnp.zeros(shape, cdt).at[:t_pad].set(k.astype(cdt))
-                vf = jnp.zeros(shape, cdt).at[:t_pad].set(v.astype(cdt))
+                kf = jnp.zeros(shape, cdt).at[:t_pad].set(kl)
+                vf = jnp.zeros(shape, cdt).at[:t_pad].set(vl)
                 ctx = decode_attention_reference(
                     q, jnp.broadcast_to(kf[None], (t_pad,) + shape),
                     jnp.broadcast_to(vf[None], (t_pad,) + shape),
@@ -607,7 +668,8 @@ class DecodeEngine:
             key0 = jax.random.fold_in(base_key, 0)
             tok = sample_tokens(key0[None], last[None], temp[None],
                                 top_k[None], top_p[None])[0]
-            return (tok, last), (k_pages, v_pages)
+            return (tok, last), _join_state(k_pages, v_pages, k_scales,
+                                            v_scales, qz)
 
         return jax.jit(prefill, donate_argnums=(0,))
 
@@ -627,11 +689,12 @@ class DecodeEngine:
         from ..ops.sampling_ops import greedy_sample, sample_tokens
 
         R, S = n_rows, n_slots
+        qz = self._cache.config.quantized
 
         def rows_fn(state, weights, tokens, start, last_row, page_table,
                     write_page, write_off, base_keys, counters, temp,
                     top_k, top_p):
-            k_pages, v_pages = state
+            k_pages, v_pages, k_scales, v_scales = _split_state(state, qz)
             positions = start[:, None] \
                 + jnp.arange(R, dtype=jnp.int32)[None, :]   # [S, R]
             # clip keeps padded/dead rows inside the positional table;
@@ -644,16 +707,18 @@ class DecodeEngine:
                 h = model._ln(x, lw["ln1_g"], lw["ln1_b"])
                 q, k, v = model._qkv(lw, h)                 # [S, R, H, D]
                 flat = (S * R, model.num_heads, model.head_dim)
-                k_pages = kv_cache.scatter_token_layer(
-                    k_pages, l, k.reshape(flat),
+                k_pages, k_scales = kv_cache.write_token_layer(
+                    k_pages, k_scales, l, k.reshape(flat),
                     write_page.reshape(-1), write_off.reshape(-1))
-                v_pages = kv_cache.scatter_token_layer(
-                    v_pages, l, v.reshape(flat),
+                v_pages, v_scales = kv_cache.write_token_layer(
+                    v_pages, v_scales, l, v.reshape(flat),
                     write_page.reshape(-1), write_off.reshape(-1))
                 ctx = paged_chunk_attention(
                     q, k_pages[l], v_pages[l], page_table, row_lengths,
                     use_pallas=self.config.use_pallas,
-                    interpret=self.config.interpret)
+                    interpret=self.config.interpret,
+                    k_scales=None if k_scales is None else k_scales[l],
+                    v_scales=None if v_scales is None else v_scales[l])
                 x = x + model._attn_out(lw, ctx)
                 x = x + model._mlp(
                     lw, model._ln(x, lw["ln2_g"], lw["ln2_b"]))
@@ -663,7 +728,8 @@ class DecodeEngine:
                 logits, last_row[:, None, None], axis=1)[:, 0]  # [S, V]
             keys = jax.vmap(jax.random.fold_in)(base_keys, counters)
             tok = sample_tokens(keys, last, temp, top_k, top_p)
-            return (tok, greedy, logits), (k_pages, v_pages)
+            return (tok, greedy, logits), _join_state(
+                k_pages, v_pages, k_scales, v_scales, qz)
 
         return jax.jit(rows_fn, donate_argnums=(0,))
 
@@ -682,10 +748,11 @@ class DecodeEngine:
         cc = self._cache.config
         p = cc.page_size
         pps = cc.pages_per_slot
+        qz = cc.quantized
 
         def propose(state, weights, tok0, start, live, trash_first,
                     page_table):
-            dk, dv = state
+            dk, dv, dks, dvs = _split_state(state, qz)
             cur = tok0
             props = []
             for j in range(k_steps + 1):
@@ -697,13 +764,14 @@ class DecodeEngine:
                 if j == 0:
                     pid = jnp.where(trash_first, 0, pid)
                 off = pos % p
-                logits, dk, dv = self._token_step_body(
-                    model, weights, dk, dv, cur,
+                logits, dk, dv, dks, dvs = self._token_step_body(
+                    model, weights, dk, dv, dks, dvs, cur,
                     jnp.clip(pos, 0, model.max_seq_len - 1),
                     page_table, pid, off)
                 cur = greedy_sample(logits)                  # [S]
                 props.append(cur)
-            return (jnp.stack(props, axis=1),), (dk, dv)
+            return (jnp.stack(props, axis=1),), _join_state(
+                dk, dv, dks, dvs, qz)
 
         return jax.jit(propose, donate_argnums=(0,))
 
@@ -719,13 +787,16 @@ class DecodeEngine:
 
         return jax.jit(cow, donate_argnums=(0,))
 
-    def _prefill_fn(self, t_pad: int, which: str = "target"):
-        key = (t_pad, which)
+    def _prefill_fn(self, t_pad: int, which: str = "target",
+                    quantized: Optional[bool] = None):
+        qz = self._cache.config.quantized if quantized is None \
+            else bool(quantized)
+        key = (t_pad, which, qz)
         fn = self._prefill_fns.get(key)
         if fn is None:
             model = self.model if which == "target" else self._draft_model
             fn = self._prefill_fns[key] = self._build_prefill_fn(
-                t_pad, model)
+                t_pad, model, quantized=qz)
             stat_add("decode_prefill_compiles")
         return fn
 
@@ -885,8 +956,12 @@ class DecodeEngine:
                        max_seq_len=self.config.max_seq_len,
                        page_size=self.config.page_size,
                        prefix_cache=self.config.prefix_cache,
+                       kv_quant=self.config.kv_quant,
                        spec_k=self.config.spec_k
                        if self.spec_enabled else 0)
+        stat_set("decode_kv_quant_enabled",
+                 1 if self.config.kv_quant else 0)
+        stat_set("decode_kv_page_bytes", self._cache.config.page_bytes())
         return self
 
     def stop(self, drain: bool = True):
@@ -1165,13 +1240,14 @@ class DecodeEngine:
             with otrace.span("serving/decode_prefill", slot=slot,
                              bucket=t_pad):
                 tok, last = self._exe.run_persistent(
-                    self._prefill_fn(t_pad), _STATE_VARS,
+                    self._prefill_fn(t_pad), self._state_vars,
                     args=args(self.weights), scope=self._scope)
                 if st.spec:
                     # mirror the prefill into the draft's pools (same
                     # page ids) so proposals can read the prompt
                     self._exe.run_persistent(
-                        self._prefill_fn(t_pad, "draft"), _DRAFT_VARS,
+                        self._prefill_fn(t_pad, "draft"),
+                        self._draft_state_vars,
                         args=args(self.draft_weights), scope=self._scope)
             stat_time("decode_prefill_seconds", time.monotonic() - t0)
             self._tev(req, "prefill", slot=slot, bucket=t_pad,
@@ -1232,11 +1308,12 @@ class DecodeEngine:
             with otrace.span("serving/decode_prefill_chunk", slot=slot,
                              start=start, rows=rows):
                 tok, _greedy, logits = self._exe.run_persistent(
-                    self._rows_fn(rows, 1), _STATE_VARS,
+                    self._rows_fn(rows, 1), self._state_vars,
                     args=args(self.weights), scope=self._scope)
                 if st.spec:
                     self._exe.run_persistent(
-                        self._rows_fn(rows, 1, "draft"), _DRAFT_VARS,
+                        self._rows_fn(rows, 1, "draft"),
+                        self._draft_state_vars,
                         args=args(self.draft_weights), scope=self._scope)
             stat_time("decode_prefill_seconds", time.monotonic() - t0)
             stat_add("prefill_chunks")
@@ -1362,7 +1439,7 @@ class DecodeEngine:
         try:
             with otrace.span("serving/decode_step", live=len(live_idx)):
                 nxt, logits = self._exe.run_persistent(
-                    self._step_fn, _STATE_VARS,
+                    self._step_fn, self._state_vars,
                     args=(self.weights, jnp.asarray(tokens),
                           jnp.asarray(positions), jnp.asarray(live),
                           jnp.asarray(self._cache.page_table),
@@ -1436,7 +1513,7 @@ class DecodeEngine:
             with otrace.span("serving/decode_spec", live=len(spec_idx),
                              k=k):
                 (props,) = self._exe.run_persistent(
-                    self._propose_fn, _DRAFT_VARS,
+                    self._propose_fn, self._draft_state_vars,
                     args=(self.draft_weights, jnp.asarray(tok0),
                           jnp.asarray(start), jnp.asarray(live),
                           jnp.asarray(trash_first),
@@ -1457,7 +1534,7 @@ class DecodeEngine:
                             pos // c.page_size]
                         write_off[i, r] = pos % c.page_size
                 _tok, greedy, logits = self._exe.run_persistent(
-                    self._rows_fn(rows, s), _STATE_VARS,
+                    self._rows_fn(rows, s), self._state_vars,
                     args=(self.weights, jnp.asarray(tokens),
                           jnp.asarray(start),
                           np.zeros((s,), np.int32),
@@ -1513,7 +1590,8 @@ class DecodeEngine:
         stat_set("decode_slot_occupancy", self.live_slots)
 
     # -- oracle / observability ------------------------------------------
-    def recompute_logits(self, tokens: Sequence[int]) -> np.ndarray:
+    def recompute_logits(self, tokens: Sequence[int],
+                         quantized: Optional[bool] = None) -> np.ndarray:
         """Full-recompute oracle: run the ENTIRE sequence through the
         prefill path from scratch (no cache reuse, no prefix sharing)
         and return the last position's logits.  Runs on THROWAWAY page
@@ -1521,13 +1599,22 @@ class DecodeEngine:
         reads the locally built K/V, so fresh zero pools are
         numerically identical), and touching the live pools would race
         the engine thread's donating step.  Safe to call while the
-        engine is serving.  ``tests/test_decode_engine.py`` compares
-        this bitwise against the streamed decode logits at every step;
+        engine is serving.
+
+        ``quantized`` defaults to False: the oracle is the FULL-
+        PRECISION reference, which on a kv-quantized engine is what the
+        quality-delta accounting compares against.  Pass
+        ``quantized=True`` on a quantized engine for the quantized
+        self-oracle — the recompute through the same per-position
+        quant-dequant the cache stores, which the composition tests pin
+        BITWISE against streamed decode.  ``tests/test_decode_engine.py``
+        compares the default oracle bitwise on unquantized engines;
         ``tests/test_decode_prefix_spec.py`` does the same for the
         shared-prefix, CoW, chunked, and speculative paths."""
         import jax
         import jax.numpy as jnp
 
+        qz = bool(quantized) if quantized is not None else False
         tokens = [int(t) for t in tokens]
         t_pad = self._buckets.seq_bucket(len(tokens))
         arr = np.zeros((t_pad,), np.int32)
@@ -1535,8 +1622,18 @@ class DecodeEngine:
         cc = self._cache.config
         shape = (cc.num_layers, cc.num_pages, cc.page_size, cc.num_heads,
                  cc.head_dim)
-        scratch = (jnp.zeros(shape, cc.dtype), jnp.zeros(shape, cc.dtype))
-        (tok, last), _ = self._prefill_fn(t_pad)(
+        if qz:
+            sshape = shape[:-1]
+            scratch = (jnp.zeros(shape, jnp.int8),
+                       jnp.zeros(shape, jnp.int8),
+                       jnp.full(sshape, kv_cache.SCALE_EPS,
+                                cc.scale_dtype),
+                       jnp.full(sshape, kv_cache.SCALE_EPS,
+                                cc.scale_dtype))
+        else:
+            scratch = (jnp.zeros(shape, cc.dtype),
+                       jnp.zeros(shape, cc.dtype))
+        (tok, last), _ = self._prefill_fn(t_pad, quantized=qz)(
             scratch, self.weights, jnp.asarray(arr),
             np.int32(len(tokens)),
             jnp.zeros((cc.pages_per_slot,), jnp.int32),
@@ -1611,6 +1708,8 @@ class DecodeEngine:
             "cache_bytes": self._cache.config.cache_bytes(),
             "continuous": self._continuous,
             "prefix_cache": self.config.prefix_cache,
+            "kv_quant": self.config.kv_quant,
+            "page_bytes": self._cache.config.page_bytes(),
             "prefix_hit_pages": hp,
             "prefix_prompt_pages": pp,
             "cache_hit_rate": round(hp / pp, 4) if pp else 0.0,

@@ -53,6 +53,20 @@ cache never round-trips to host between steps.  The speculative-decode
 draft model's page pools (serving/decode.py) are indexed by the SAME
 page ids, so sharing, reservation, and CoW cover them for free (the
 engine's CoW copy spans every pool).
+
+**Quantized storage** (``FLAGS_decode_kv_quant``): pages are stored
+int8 beside parallel scale pools ``[layers, pages, page_size, heads]``
+(one float32 scale per head per position-in-page; see
+:class:`CacheConfig` for why the scale granularity is the page's
+positions rather than one scalar per page).  Writes quantize in the
+step that produces the K/V (``write_token_layer`` /
+``write_prompt_layer``); both attention paths dequantize inline
+(``ops/pallas_decode_attention.py``).  Bytes per page roughly halve vs
+bf16, and since the admission reservation is page-count-based, a pool
+sized to a fixed byte budget admits ~2x the concurrent requests.
+Freed pages' scale planes reset to ``SCALE_EPS`` (batched, flushed at
+release/claim) so ``debug_check`` can audit scale-pool/page-pool
+agreement.
 """
 from __future__ import annotations
 
@@ -63,9 +77,14 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..monitor import stat_add
+from ..ops.quant_ops import SCALE_EPS
 
 K_PAGES_VAR = "__decode_k_pages__"
 V_PAGES_VAR = "__decode_v_pages__"
+K_SCALES_VAR = "__decode_k_scales__"
+V_SCALES_VAR = "__decode_v_scales__"
+
+KV_QMAX = 127.0  # symmetric int8 grid for quantized pages
 
 
 class CacheExhaustedError(RuntimeError):
@@ -73,11 +92,26 @@ class CacheExhaustedError(RuntimeError):
 
 
 class CacheConfig:
-    """Geometry of the paged cache (everything static / compile-time)."""
+    """Geometry of the paged cache (everything static / compile-time).
+
+    ``quantized=True`` (``FLAGS_decode_kv_quant``) stores pages as int8
+    with a parallel per-page scale pool: one float32 scale per head per
+    position-in-page (a ``[page_size, heads]`` scale plane per page,
+    living in ``k/v_scales [layers, pages, page_size, heads]``).  The
+    position-granular plane — rather than one scalar per page — is what
+    keeps stored bytes WRITE-ONCE: re-deriving a position (a rejected
+    speculative row, a chunked-prefill replay) re-quantizes only itself,
+    so page content is order-independent and speculative decode stays
+    bitwise-equal to its own non-speculative quantized run.  Bytes per
+    position drop from ``2*head_dim`` (bf16) to ``head_dim + 4`` —
+    about half — which is exactly what ``page_bytes()`` reports, so the
+    worst-case admission reservation and the PR 8 HBM accounting both
+    see the shrink and a fixed pool byte budget holds ~2x the pages."""
 
     def __init__(self, num_layers: int, num_heads: int, head_dim: int,
                  num_slots: int, max_seq_len: int, page_size: int,
-                 num_pages: Optional[int] = None, dtype="float32"):
+                 num_pages: Optional[int] = None, dtype="float32",
+                 quantized: bool = False):
         if max_seq_len % page_size:
             raise ValueError(
                 f"max_seq_len ({max_seq_len}) must be a multiple of "
@@ -97,18 +131,39 @@ class CacheConfig:
             else self.num_slots * self.pages_per_slot + 1
         if self.num_pages < 2:
             raise ValueError("num_pages must be >= 2 (page 0 is trash)")
+        self.quantized = bool(quantized)
+        # ``dtype`` stays the COMPUTE/reference dtype (what dequantized
+        # values and the full-recompute oracle use); ``store_dtype`` is
+        # what the page pools hold
         self.dtype = np.dtype(dtype)
+        self.store_dtype = np.dtype(np.int8) if self.quantized \
+            else self.dtype
+        self.scale_dtype = np.dtype(np.float32)
 
     def pages_for(self, seq_len: int) -> int:
         return max(1, math.ceil(int(seq_len) / self.page_size))
 
     def page_bytes(self) -> int:
-        return (self.page_size * self.num_heads * self.head_dim
-                * self.dtype.itemsize)
+        """Device bytes ONE page costs in one pool — including its
+        scale plane when quantized, so capacity math can't hide the
+        scale overhead."""
+        data = (self.page_size * self.num_heads * self.head_dim
+                * self.store_dtype.itemsize)
+        if self.quantized:
+            data += (self.page_size * self.num_heads
+                     * self.scale_dtype.itemsize)
+        return data
+
+    def per_page_pool_bytes(self) -> int:
+        """Total device bytes one page costs across EVERY pool (k + v,
+        all layers, scale planes included) — the unit a fixed byte
+        budget is divided by to size ``num_pages``."""
+        return 2 * self.num_layers * self.page_bytes()
 
     def cache_bytes(self) -> int:
-        """Total device bytes of BOTH page arrays (k + v)."""
-        return 2 * self.num_layers * self.num_pages * self.page_bytes()
+        """Total device bytes of the page arrays (k + v, scale pools
+        included when quantized)."""
+        return self.num_pages * self.per_page_pool_bytes()
 
 
 class PageAllocator:
@@ -352,8 +407,32 @@ class PagedKVCache:
         self._refs = [0] * c.num_pages
         shape = (c.num_layers, c.num_pages, c.page_size, c.num_heads,
                  c.head_dim)
-        scope.set_var(K_PAGES_VAR, jnp.zeros(shape, c.dtype))
-        scope.set_var(V_PAGES_VAR, jnp.zeros(shape, c.dtype))
+        scope.set_var(K_PAGES_VAR, jnp.zeros(shape, c.store_dtype))
+        scope.set_var(V_PAGES_VAR, jnp.zeros(shape, c.store_dtype))
+        # quantized mode: parallel per-page scale pools (one scale per
+        # head per position-in-page), plus the freed-page reset queue
+        # the scale audit relies on.  ``scale_vars`` also collects any
+        # EXTRA scale pools sharing this cache's page ids (the decode
+        # engine appends its draft-model scale pools) so resets and
+        # audits cover every pool.
+        self.scale_vars: List[str] = []
+        self._pending_scale_resets: List[int] = []
+        if c.quantized:
+            sshape = (c.num_layers, c.num_pages, c.page_size,
+                      c.num_heads)
+            scope.set_var(K_SCALES_VAR,
+                          jnp.full(sshape, SCALE_EPS, c.scale_dtype))
+            scope.set_var(V_SCALES_VAR,
+                          jnp.full(sshape, SCALE_EPS, c.scale_dtype))
+            self.scale_vars = [K_SCALES_VAR, V_SCALES_VAR]
+
+    def state_var_names(self) -> Tuple[str, ...]:
+        """Scope names a persistent step must thread (in order): the
+        two page pools, plus the scale pools when quantized."""
+        names = (K_PAGES_VAR, V_PAGES_VAR)
+        if self.config.quantized:
+            names += (K_SCALES_VAR, V_SCALES_VAR)
+        return names
 
     def _fire(self, slot, name, **attrs) -> None:
         hook = self.on_event
@@ -377,6 +456,33 @@ class PagedKVCache:
                 f"held")
         if r == 0:
             self.allocator.free([pid])
+            if self.config.quantized:
+                # hygiene + auditability: a freed page's scale plane is
+                # reset to SCALE_EPS (flushed in one batched device op
+                # at the end of the release/claim that freed it).  Not
+                # load-bearing for numerics — the write path quantizes
+                # each position with its own fresh scale and reads are
+                # length-masked — but it makes "this page is free" an
+                # observable device-side fact debug_check() can assert.
+                self._pending_scale_resets.append(pid)
+
+    def flush_scale_resets(self) -> None:
+        """Apply pending freed-page scale resets to every scale pool
+        (the cache's own + any engine-registered extras).  Runs in the
+        owner thread between step dispatches — eager jax ops, never
+        racing a donated in-flight step."""
+        if not self._pending_scale_resets:
+            return
+        import jax.numpy as jnp
+
+        pids = np.asarray(sorted(set(self._pending_scale_resets)),
+                          np.int32)
+        self._pending_scale_resets = []
+        for name in self.scale_vars:
+            arr = self.scope.get_var(name)
+            self.scope.set_var(
+                name, arr.at[:, pids].set(jnp.asarray(
+                    SCALE_EPS, arr.dtype)))
 
     def refcount(self, pid: int) -> int:
         return self._refs[int(pid)]
@@ -458,6 +564,7 @@ class PagedKVCache:
         row[:len(table_pages)] = table_pages
         self.page_table[slot] = row
         self.lengths[slot] = 0
+        self.flush_scale_resets()  # evictions may have freed pages
         prompt_len = len(prompt) if prompt is not None else 0
         hit_tokens = len(full_hits) * self.config.page_size
         if partial is not None:
@@ -492,6 +599,7 @@ class PagedKVCache:
         self._cow_spare[slot] = []
         self.page_table[slot] = 0
         self.lengths[slot] = 0
+        self.flush_scale_resets()
 
     def slot_pages(self, slot: int) -> List[int]:
         return list(self._slot_pages[slot])
@@ -558,8 +666,13 @@ class PagedKVCache:
     def debug_check(self) -> None:
         """Assert the refcount/free-list/index books balance: every
         page is exactly one of {free, referenced}, and each page's
-        refcount equals index-pin + per-slot references.  Raises
+        refcount equals index-pin + per-slot references.  When the
+        cache is quantized the audit extends to scale-pool/page-pool
+        agreement: every scale in every pool is finite, and every FREE
+        page's scale plane is reset to ``SCALE_EPS`` (in every pool —
+        the cache's own and any engine-registered draft pools).  Raises
         AssertionError with the discrepancy."""
+        self.flush_scale_resets()
         want = [0] * self.config.num_pages
         for slot_refs in self._slot_refs:
             for pid in slot_refs:
@@ -579,6 +692,22 @@ class PagedKVCache:
             assert in_free == (self._refs[pid] == 0), (
                 f"page {pid}: refcount {self._refs[pid]} but "
                 f"{'on' if in_free else 'not on'} the free list")
+        if not self.config.quantized:
+            return
+        free_idx = np.asarray(sorted(free), np.int32)
+        for name in self.scale_vars:
+            arr = np.asarray(self.scope.get_var(name))
+            assert np.isfinite(arr).all(), (
+                f"scale pool {name} holds non-finite scales — a write "
+                f"path stored an unclamped/overflowed scale")
+            assert (arr > 0).all(), (
+                f"scale pool {name} holds non-positive scales")
+            if len(free_idx):
+                stale = arr[:, free_idx]
+                assert np.all(stale == np.float32(SCALE_EPS)), (
+                    f"scale pool {name}: freed pages "
+                    f"{free_idx[np.argwhere(np.any(stale != np.float32(SCALE_EPS), axis=(0, 2, 3)))].ravel().tolist()} "
+                    f"kept live scales — a free path skipped the reset")
 
 
 # -- pure jit-side helpers (operate on the page arrays functionally) ------
@@ -598,3 +727,60 @@ def scatter_prompt_layer(pages, layer: int, val, page_ids):
     page = pages.shape[2]
     v = val.reshape(n, page, val.shape[1], val.shape[2])
     return pages.at[layer, page_ids].set(v.astype(pages.dtype))
+
+
+def quantize_kv(val):
+    """Symmetric int8 quantization of K/V values at per-position
+    per-head granularity: ``val [..., H, D] -> (q int8 [..., H, D],
+    scale f32 [..., H])`` with the scale clamped PER SLICE (an all-zero
+    head stores exact zeros instead of dividing by ~0 — the
+    quant_ops._abs_max per-slice-clamp contract).  Pure and
+    position-local, so every write path (single-token decode, chunked
+    prefill rows, whole-prompt prefill, speculative re-writes) produces
+    IDENTICAL stored bytes for identical values — the order-independence
+    the bitwise spec/chunk composition tests pin."""
+    import jax.numpy as jnp
+
+    v = val.astype(jnp.float32)
+    scale = jnp.maximum(jnp.max(jnp.abs(v), axis=-1) / KV_QMAX,
+                        SCALE_EPS)
+    q = jnp.clip(jnp.round(v / scale[..., None]), -KV_QMAX, KV_QMAX) \
+        .astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_kv(q, scale, dtype):
+    """Inverse of :func:`quantize_kv` (broadcast the per-position
+    per-head scale back over head_dim)."""
+    import jax.numpy as jnp
+
+    return (q.astype(jnp.float32)
+            * scale.astype(jnp.float32)[..., None]).astype(dtype)
+
+
+def write_token_layer(pages, scales, layer: int, val, page_id, offset):
+    """Quantization-aware :func:`scatter_token_layer`: returns
+    ``(pages, scales)``.  ``scales=None`` is the unquantized path
+    (pages store ``val`` directly, scales pass through)."""
+    if scales is None:
+        return scatter_token_layer(pages, layer, val, page_id,
+                                   offset), None
+    q, s = quantize_kv(val)
+    return (pages.at[layer, page_id, offset].set(q),
+            scales.at[layer, page_id, offset].set(
+                s.astype(scales.dtype)))
+
+
+def write_prompt_layer(pages, scales, layer: int, val, page_ids):
+    """Quantization-aware :func:`scatter_prompt_layer`: returns
+    ``(pages, scales)``; page-wholesale like the unquantized path, but
+    each position quantizes independently — bitwise-identical bytes to
+    the per-row chunked path writing the same values."""
+    if scales is None:
+        return scatter_prompt_layer(pages, layer, val, page_ids), None
+    n = page_ids.shape[0]
+    page = pages.shape[2]
+    v = val.reshape(n, page, val.shape[1], val.shape[2])
+    q, s = quantize_kv(v)
+    return (pages.at[layer, page_ids].set(q),
+            scales.at[layer, page_ids].set(s.astype(scales.dtype)))

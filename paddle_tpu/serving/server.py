@@ -375,6 +375,11 @@ class DecodeServer:
             if proposed else 0.0,
             "spec_proposed": proposed,
             "spec_accepted": accepted,
+            # quantized KV cache: fleet-wide pool bytes reflect the
+            # int8+scale page cost when FLAGS_decode_kv_quant is on
+            "kv_quant": all(p["kv_quant"] for p in per) if per
+            else False,
+            "cache_bytes": sum(p["cache_bytes"] for p in per),
         }
 
     def health(self) -> Dict:

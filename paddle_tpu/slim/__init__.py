@@ -3,6 +3,8 @@ python/paddle/fluid/contrib/slim/): quantization-aware training and
 post-training quantization over static Programs."""
 from .quantization import (  # noqa: F401
     PostTrainingQuantization,
+    PostTrainingWeightQuantPass,
     QuantizationTransformPass,
+    mark_weight_quant,
     quant_aware,
 )

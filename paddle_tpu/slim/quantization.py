@@ -16,12 +16,13 @@ through the quantized graph exactly like the reference's QAT flow.
 """
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from ..framework import unique_name
-from ..framework.program import Parameter, Program
+from ..framework.passes import Pass, register_pass
+from ..framework.program import Operator, Parameter, Program
 from ..initializer import ConstantInitializer
 
 # op type -> input slots eligible for quantization (weights + activations)
@@ -331,3 +332,214 @@ class PostTrainingQuantization:
                     op._rename_input(name, out_name)
             i += 1
         return prog
+
+
+# ---------------------------------------------------------------------------
+# post-training weight-only quantization (the inference byte-shrinker)
+# ---------------------------------------------------------------------------
+
+# per-op marker a program can carry instead of the global flag (stamped
+# by mark_weight_quant; an op attr, so it survives clone/proto round
+# trips AND joins the program fingerprint — stamping re-keys every
+# executor cache automatically, mirroring the __tp_rules__ pattern)
+WEIGHT_QUANT_ATTR = "__weight_quant__"
+
+# matmul-family subset of _QUANT_SLOTS eligible for the int8 rewrite
+# (the weight slot is "Y" for all three; conv stays on the qdq
+# simulation path — its filter layout needs its own kernel story)
+_WQ_OPS = ("mul", "matmul", "matmul_v2")
+
+_CARRIER_SUFFIX = "@WQ"
+_SCALE_SUFFIX = "@WQ_SCALE"
+
+
+def mark_weight_quant(program: Program, mode: str = "int8") -> Program:
+    """Arm PostTrainingWeightQuantPass for ``program`` regardless of
+    ``FLAGS_weight_quant``: stamps the mode onto every matmul-family op
+    (attr -> fingerprint -> executor caches re-key)."""
+    from ..ops.quant_ops import WEIGHT_QUANT_MODES
+
+    if mode not in WEIGHT_QUANT_MODES:
+        raise ValueError(
+            f"unknown weight-quant mode {mode!r}; expected one of "
+            f"{WEIGHT_QUANT_MODES}")
+    for op in program.global_block.ops:
+        if op.type in _WQ_OPS:
+            op.attrs[WEIGHT_QUANT_ATTR] = mode
+    program._bump()
+    return program
+
+
+@register_pass(before="layer_scan")
+class PostTrainingWeightQuantPass(Pass):
+    """Rewrite matmul-family weights to int8 / fp8-e4m3 carriers with
+    per-output-channel scales, lowered through the dequant-fused
+    ``dequant_matmul`` op (ops/quant_ops.py).
+
+    Registered in the framework pass pipeline (framework/passes.py)
+    AFTER ShardingPropagationPass — so scale vars can inherit the
+    weight's mp spec on the sharded axis — and BEFORE LayerScanPass, so
+    repeated layers stay isomorphic after the rewrite and their int8
+    carriers + scales get stacked like any other per-layer weight.
+    Gated by ``FLAGS_weight_quant`` ('' off, 'int8', 'fp8_e4m3') or
+    per-program by :func:`mark_weight_quant`.
+
+    Mechanics per quantizable op (weight slot ``Y`` holding a 2D
+    persistable var, resolved through at most one AMP ``cast``):
+
+    - the live scope value is quantized ONCE (``quantize_weight``:
+      symmetric per-output-channel, the same grid the QAT export's
+      ``fake_channel_wise_quantize_abs_max`` writes, scales clamped
+      per channel) into two new persistable vars ``<w>@WQ`` (carrier)
+      and ``<w>@WQ_SCALE`` (float32 ``[out_channels]``);
+    - the op is replaced by ``dequant_matmul`` carrying the original
+      semantics (``orig_type`` + the flattening/transpose attrs);
+    - a weight consumed through an AMP cast is rewritten to consume
+      the dequant output directly (the dequant lands at X's dtype, so
+      numerics match the cast path) — the orphaned cast is then
+      RedundantCast/DCE food, which is how the pass composes with the
+      AMP cast-elimination;
+    - when the program carries a ``TPShardingPlan`` the carrier
+      inherits the weight's spec and the scale inherits the sharded
+      axis' entry, so GSPMD keeps scale shards beside weight shards.
+
+    The ORIGINAL f32 weight var stays in the block and scope
+    (checkpoints and further training still see it); the rewritten
+    program simply never reads it, so it drops out of the executable's
+    argument footprint — which is where the PR 8 ``hbm_required_bytes``
+    accounting sees the bytes halve.
+    """
+
+    name = "post_training_weight_quant"
+
+    def __init__(self, mode: Optional[str] = None):
+        self._mode_override = mode
+
+    def _mode(self, program) -> Optional[str]:
+        if self._mode_override:
+            return self._mode_override
+        for op in program.global_block.ops:
+            m = op.attr(WEIGHT_QUANT_ATTR)
+            if m:
+                return str(m)
+        from ..framework import flags
+
+        return str(flags.flag("weight_quant")) or None
+
+    def should_apply(self, program, ctx) -> bool:
+        if ctx.scope is None or self._mode(program) is None:
+            return False
+        return any(op.type in _WQ_OPS
+                   for op in program.global_block.ops)
+
+    @staticmethod
+    def _resolve_weight(block, ops, idx, name):
+        """Resolve op input ``name`` to a persistable 2D weight var:
+        either directly, or through ONE dtype cast of one (the AMP
+        pattern).  Returns (weight_name, var) or (None, None)."""
+
+        def _weight_var(n):
+            v = block._find_var_recursive(n)
+            if v is not None and (isinstance(v, Parameter)
+                                  or getattr(v, "persistable", False)) \
+                    and len(getattr(v, "shape", ())) == 2:
+                return v
+            return None
+
+        v = _weight_var(name)
+        if v is not None:
+            return name, v
+        for j in range(idx - 1, -1, -1):
+            op = ops[j]
+            if name in op.output_arg_names():
+                if op.type != "cast":
+                    return None, None
+                xs = op.inputs.get("X", [])
+                if len(xs) != 1:
+                    return None, None
+                v = _weight_var(xs[0])
+                return (xs[0], v) if v is not None else (None, None)
+        return None, None
+
+    def apply(self, program, ctx) -> bool:
+        from ..framework import dtypes
+        from ..monitor import stat_add
+        from ..ops.quant_ops import quantize_weight, resolve_quant_mode
+
+        mode = resolve_quant_mode(self._mode(program))
+        block = program.global_block
+        scope = ctx.scope
+        plan = getattr(program, "_tp_plan", None)
+        quantized: Dict[str, Tuple[str, str]] = {}
+        n_rewritten = n_skipped = 0
+        for i, op in enumerate(list(block.ops)):
+            if op.type not in _WQ_OPS:
+                continue
+            ys = op.input("Y")
+            if len(ys) != 1:
+                n_skipped += 1
+                continue
+            if op.type != "mul" and bool(
+                    op.attr("transpose_Y", op.attr("trans_y", False))):
+                n_skipped += 1  # transposed weights flip the channel
+                continue        # axis; stay on the unquantized path
+            if op.type == "mul" and int(op.attr("y_num_col_dims", 1)) != 1:
+                n_skipped += 1
+                continue
+            wname, wvar = self._resolve_weight(block, block.ops, i, ys[0])
+            if wname is None or not scope.has_var(wname):
+                n_skipped += 1
+                continue
+            axis = _WEIGHT_AXIS[op.type]
+            cached = quantized.get(wname)
+            if cached is None:
+                carrier = wname + _CARRIER_SUFFIX
+                scale = wname + _SCALE_SUFFIX
+                q, s = quantize_weight(scope.get_var(wname), axis, mode)
+                scope.set_var(carrier, q)
+                scope.set_var(scale, s)
+                # the proto dtype enum has no float8 entry, so the
+                # carrier is declared int8 in BOTH modes (8-bit
+                # payload either way); the scope array — what the
+                # executor actually feeds — carries the authoritative
+                # dtype, and the op's "mode" attr records the truth
+                block.create_var(
+                    name=carrier, shape=list(wvar.shape),
+                    dtype="int8", persistable=True, stop_gradient=True)
+                block.create_var(
+                    name=scale, shape=[int(wvar.shape[axis])],
+                    dtype="float32", persistable=True,
+                    stop_gradient=True)
+                if plan is not None and wname in plan.specs:
+                    wspec = tuple(plan.specs[wname])
+                    plan.specs[carrier] = wspec
+                    if axis < len(wspec) and wspec[axis] is not None:
+                        plan.specs[scale] = (wspec[axis],)
+                quantized[wname] = cached = (carrier, scale)
+            carrier, scale = cached
+            attrs = {
+                "orig_type": op.type,
+                "weight_axis": axis,
+                "mode": mode,
+                "bit_length": 8,
+            }
+            for k in ("x_num_col_dims", "y_num_col_dims", "transpose_X",
+                      "transpose_Y", "trans_x", "trans_y", "alpha",
+                      WEIGHT_QUANT_ATTR):
+                if op.has_attr(k):
+                    attrs[k] = op.attr(k)
+            new_op = Operator(
+                block, "dequant_matmul",
+                inputs={"X": op.input("X"), "Y": [carrier],
+                        "Scale": [scale]},
+                outputs={k: list(v) for k, v in op.outputs.items()},
+                attrs=attrs)
+            block.ops[i] = new_op
+            n_rewritten += 1
+        if not n_rewritten:
+            return False
+        program._bump()
+        stat_add("pass_weight_quant_ops", n_rewritten)
+        if n_skipped:
+            stat_add("pass_weight_quant_skipped", n_skipped)
+        return True

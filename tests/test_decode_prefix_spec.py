@@ -461,19 +461,23 @@ def test_paged_chunk_attention_pallas_interpret_matches_reference():
 # -- free-list audit: chaos across admit / CoW / reap ---------------------
 
 
+@pytest.mark.parametrize("kv_quant", [False, True],
+                         ids=["fp", "kv_quant"])
 def test_chaos_admit_cow_reap_never_leaks_or_double_frees(
-        model_and_weights):
+        model_and_weights, kv_quant):
     """The bugfix-sweep pin: randomized waves of shared-prefix
     requests — full hits, partial borrows, CoW, deadline reaps,
     abandons, chunked prefills, speculative rounds — must leave the
     refcount/free-list/index books EXACTLY balanced
-    (``debug_check``)."""
+    (``debug_check``).  With ``kv_quant`` the audit extends to the
+    scale pools (target + draft): finite scales everywhere, freed
+    pages' scale planes reset."""
     model, weights = model_and_weights
     rs = np.random.RandomState(11)
     prefixes = [list(range(1, 9)), list(range(30, 42)), [5, 5, 5]]
     eng = make_engine(model_and_weights, slots=3, max_seq_len=64,
                       page_size=8, num_pages=17, max_queue=64,
-                      prefill_chunk_pages=1,
+                      prefill_chunk_pages=1, kv_quant=kv_quant,
                       draft=(model, weights), spec_k=2).start()
     try:
         waves = []

@@ -222,7 +222,8 @@ def render(bundle: str, tail: int = 15, stacks: bool = False,
                 "compile_seconds_count", "executable_hlo_ops",
                 "pass_layer_scan", "decode_", "ttft_", "tpot_",
                 "spec_accept_rate", "prefill_chunks", "slo_burn_rate",
-                "slo_budget_remaining", "goodput", "request_trace")
+                "slo_budget_remaining", "goodput", "request_trace",
+                "quant_", "pass_weight_quant")
         for ln in rows:
             if metrics or any(k in ln for k in keys):
                 w(f"  {ln}\n")
