@@ -163,10 +163,20 @@ class KVBarrier:
     a restart whose (tag, gen) collides with the crashed run's can at
     worst time out — the commit protocol never renames before the
     post-write barrier, so staleness degrades to a failed save, not a
-    torn checkpoint."""
+    torn checkpoint.
+
+    ``dead_ranks_fn`` (optional) wires the health plane in: a zero-arg
+    callable returning the currently dead-listed ranks (e.g.
+    ``fleet.elastic.dead_ranks_from_cluster(url)``).  A barrier whose
+    expected world SHRANK mid-wait — a participant died — then fails
+    fast with the missing rank NAMED instead of burning the full
+    deadline; the elastic supervisor classifies that as a topology
+    change and re-shards."""
 
     def __init__(self, endpoint: str, rank: int, world_size: int,
-                 timeout: float = 120.0, prefix: str = ""):
+                 timeout: float = 120.0, prefix: str = "",
+                 dead_ranks_fn: Optional[Callable[[], Sequence[int]]]
+                 = None):
         self.endpoint = endpoint.rstrip("/")
         if not self.endpoint.startswith("http"):
             self.endpoint = "http://" + self.endpoint
@@ -174,6 +184,7 @@ class KVBarrier:
         self.world_size = int(world_size)
         self.timeout = float(timeout)
         self.prefix = (prefix + ":") if prefix else ""
+        self.dead_ranks_fn = dead_ranks_fn
         self._tag_gens: Dict[str, int] = {}
         self._past_tags: list = []
 
@@ -209,6 +220,7 @@ class KVBarrier:
                         f"{e}") from e
                 time.sleep(0.05)
         missing = set(range(self.world_size))
+        last_dead_check = 0.0
         while missing:
             for r in sorted(missing):
                 try:
@@ -221,6 +233,26 @@ class KVBarrier:
                     pass
             if not missing:
                 break
+            # participant loss: once the health plane dead-lists a
+            # rank we are still waiting on, the barrier can NEVER
+            # complete — fail fast with the rank named (throttled:
+            # dead_ranks_fn may be an HTTP poll)
+            if self.dead_ranks_fn is not None and \
+                    time.monotonic() - last_dead_check >= 0.25:
+                last_dead_check = time.monotonic()
+                try:
+                    dead = {int(x) for x in (self.dead_ranks_fn() or ())}
+                except Exception:  # noqa: BLE001 - no evidence,
+                    dead = set()   # no verdict
+                lost = sorted(dead & missing)
+                if lost:
+                    raise CheckpointError(
+                        f"KVBarrier {gen_tag!r}: rank(s) {lost} "
+                        f"dead-listed by the health plane while still "
+                        f"missing from the barrier "
+                        f"(world={self.world_size}); failing fast "
+                        f"instead of waiting out the {self.timeout}s "
+                        f"deadline")
             if time.monotonic() >= deadline:
                 raise CheckpointError(
                     f"KVBarrier {gen_tag!r}: ranks {sorted(missing)} "

@@ -104,6 +104,19 @@ class Fleet:
         return self._strategy
 
 
+def __getattr__(name):
+    # fleet.elastic is lazy: the supervisor pulls in ckpt/observe and
+    # most fleet users (pure training scripts) never touch it
+    if name == "elastic":
+        import importlib
+
+        mod = importlib.import_module(".elastic", __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")
+
+
 _fleet_singleton = Fleet()
 
 init = _fleet_singleton.init
@@ -123,7 +136,7 @@ minimize = _fleet_singleton.minimize
 
 __all__ = [
     "DistributedStrategy", "Fleet", "PaddleCloudRoleMaker",
-    "UserDefinedRoleMaker", "init", "is_first_worker", "worker_index",
-    "worker_num", "is_worker", "barrier_worker", "distributed_optimizer",
-    "minimize",
+    "UserDefinedRoleMaker", "elastic", "init", "is_first_worker",
+    "worker_index", "worker_num", "is_worker", "barrier_worker",
+    "distributed_optimizer", "minimize",
 ]

@@ -409,6 +409,40 @@ def drain_all(raise_errors: bool = True):
         exe._window.drain_all(raise_errors=raise_errors)
 
 
+def quiesce_all(raise_errors: bool = True):
+    """Process-wide quiescence for the elastic supervisor: drain every
+    live Executor's in-flight window AND every pending async checkpoint
+    save, so the next restore observes only completed steps and
+    committed (or cleanly failed) checkpoints.  ``raise_errors=False``
+    parks drain failures for the next raising drain point — a failed
+    attempt's own exception is already being handled."""
+    drain_all(raise_errors=raise_errors)
+    try:
+        from ..ckpt import wait_all as _ckpt_wait_all
+
+        _ckpt_wait_all(raise_errors=raise_errors)
+    except ImportError:  # pragma: no cover - partial installs
+        pass
+
+
+def close_all() -> int:
+    """Re-init hook for topology changes: close every live Executor
+    (drains its window, then drops all its compiled-program caches) so
+    a rebuild on a NEW device mesh starts from a clean slate instead
+    of reusing executables keyed to the dead topology.  Returns the
+    number of executors closed."""
+    n = 0
+    for exe in list(_LIVE_EXECUTORS):
+        try:
+            exe.close()
+        except Exception:  # noqa: BLE001 - a failing drain on a dying
+            pass           # topology must not block the re-init
+        _LIVE_EXECUTORS.discard(exe)
+        n += 1
+    _update_inflight_gauge()
+    return n
+
+
 _threefry_partitionable_applied = False
 
 

@@ -328,6 +328,28 @@ define_flag("weight_quant", "",
             "back to int8 with quant_fp8_unavailable counted).  "
             "Per-program override: slim.quantization.mark_weight_quant",
             affects_lowering=True)
+define_flag("elastic_max_restarts", 3,
+            "elastic training supervisor (distributed/fleet/elastic): "
+            "restart budget — how many times ElasticSupervisor.run may "
+            "restart (in place) or re-shard (after a dead rank) "
+            "following a classified failure before raising a terminal "
+            "ElasticTerminated with the full restart history; bench.py "
+            "flagship rounds share the same budget for device-failure "
+            "retries")
+define_flag("elastic_preflight_timeout_s", 240.0,
+            "deadline for ONE subprocess-isolated device preflight "
+            "probe (fleet.elastic.preflight_device: import jax + a "
+            "tiny jit dispatch in a CHILD process, so a wedged backend "
+            "can never hang the supervisor itself); this is the BENCH "
+            "r04/r05 'device init did not complete within 240s' bound, "
+            "now a structured init_timeout verdict retried with "
+            "backoff instead of a zeroed round")
+define_flag("elastic_backoff_s", 10.0,
+            "base backoff between elastic restart/preflight attempts; "
+            "attempt k sleeps backoff * 2^(k-1) — exponential, so a "
+            "transiently-held chip (an orphaned worker still being "
+            "reaped) gets time to come back without burning the "
+            "restart budget in seconds")
 define_flag("decode_kv_quant", False,
             "decode engine: store KV-cache pages int8 with a parallel "
             "per-page scale pool (serving/kv_cache.py) — scales are "

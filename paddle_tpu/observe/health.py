@@ -539,6 +539,11 @@ atexit.register(_atexit_bundle)
 # ---------------------------------------------------------------------------
 
 
+# default cross-scrape rank-epoch book for bare cluster_health() calls
+# (serve_cluster_health keeps a per-route book instead)
+_CLUSTER_BOOK: Dict[int, Dict] = {}
+
+
 def _default_rank_stats() -> Dict:
     """What a rank puts in its heartbeat: progress counters + the raw
     step-time p50.  Reads the histogram DIRECTLY (not
@@ -611,6 +616,19 @@ class HealthReporter:
         """PUT one heartbeat; returns success.  Never raises."""
         import urllib.request
 
+        # chaos hook (fleet.elastic.chaos "heartbeat_blackhole"): drop
+        # this rank's beats so the health plane dead-lists a live
+        # process — consulted ONLY when the chaos module is already
+        # loaded (an unimported armory holds no armed faults)
+        _chaos = sys.modules.get(
+            "paddle_tpu.distributed.fleet.elastic.chaos")
+        if _chaos is not None and \
+                _chaos.take("heartbeat_blackhole", rank=self.rank):
+            self.failures += 1
+            from ..monitor import stat_add
+
+            stat_add("health_heartbeat_blackholed")
+            return False
         try:
             body = json.dumps(self.payload()).encode()
             url = f"{self.endpoint}/{HEALTH_KEY_PREFIX}{self.rank}"
@@ -657,18 +675,34 @@ class HealthReporter:
 
 
 def cluster_health(kv: Dict, world_size: Optional[int] = None,
-                   now: Optional[float] = None) -> Dict:
+                   now: Optional[float] = None,
+                   book: Optional[Dict] = None) -> Dict:
     """Aggregate raw KV heartbeat entries into the cluster-health view
     (pure function: testable without HTTP).
 
     ``kv`` maps key -> bytes/str as stored by the KV server.  A rank is
     *alive* when its last heartbeat is younger than 3x its own reported
-    interval.  The straggler gauge is relative step-time skew among
-    alive ranks: ``(max_p50 - min_p50) / min_p50`` — 0.0 when balanced,
-    1.0 when the slowest rank takes twice the fastest's step time.
-    Liveness/skew are mirrored to StatRegistry gauges so the plain
-    ``/metrics`` exposition carries them too."""
+    interval — recomputed per scrape, so a dead-listed rank that
+    RESUMES heartbeating re-enters ``alive_ranks`` and leaves
+    ``dead_ranks`` on the very next aggregation.  The straggler gauge
+    is relative step-time skew among alive ranks: ``(max_p50 - min_p50)
+    / min_p50`` — 0.0 when balanced, 1.0 when the slowest rank takes
+    twice the fastest's step time.  Liveness/skew are mirrored to
+    StatRegistry gauges so the plain ``/metrics`` exposition carries
+    them too.
+
+    ``book`` is the cross-scrape bookkeeping dict (``serve_cluster_
+    health`` keeps one per route; ``None`` uses a module-global): it
+    carries each rank's MONOTONIC restart epoch.  A rank whose pid
+    changed or whose cumulative ``dispatched`` counter went BACKWARDS
+    has restarted — its epoch bumps, the entry is flagged
+    ``restarted`` for this scrape, and it is excluded from the
+    straggler-skew computation until its counters move forward again
+    (a fresh process's reset step-time histogram is not a straggler
+    going backwards; the elastic supervisor reads ``rank_epochs`` to
+    tell a restarted rank from a stuck one)."""
     now = time.time() if now is None else now
+    book = _CLUSTER_BOOK if book is None else book
     ranks: Dict[int, Dict] = {}
     for key, raw in kv.items():
         m = re.fullmatch(re.escape(HEALTH_KEY_PREFIX) + r"(\d+)", key)
@@ -686,6 +720,40 @@ def cluster_health(kv: Dict, world_size: Optional[int] = None,
         entry = dict(payload)
         entry["last_heartbeat_age_s"] = round(age, 3)
         entry["alive"] = age < 3.0 * interval
+        # monotonic rank-epoch bookkeeping (see docstring)
+        pid = payload.get("pid")
+        disp = payload.get("dispatched")
+        rec = book.get(r)
+        if rec is None:
+            book[r] = rec = {"epoch": 0, "pid": pid, "dispatched": disp}
+        else:
+            new_pid = (pid is not None and rec.get("pid") is not None
+                       and pid != rec["pid"])
+            went_back = (isinstance(disp, (int, float))
+                         and isinstance(rec.get("dispatched"),
+                                        (int, float))
+                         and disp < rec["dispatched"])
+            if new_pid or went_back:
+                rec["epoch"] += 1
+                # sticky until the fresh process's counters move
+                # FORWARD — a restarted rank that has not dispatched
+                # a step yet must stay out of the skew gauge on every
+                # scrape in between, not only the detection scrape
+                rec["cooling"] = True
+                from ..monitor import stat_add
+
+                stat_add("cluster_rank_restarts")
+            elif rec.get("cooling") and isinstance(disp, (int, float)) \
+                    and isinstance(rec.get("dispatched"), (int, float)) \
+                    and disp > rec["dispatched"]:
+                rec.pop("cooling", None)
+            if rec.get("cooling"):
+                entry["restarted"] = True
+            if pid is not None:
+                rec["pid"] = pid
+            if disp is not None:
+                rec["dispatched"] = disp
+        entry["epoch"] = rec["epoch"]
         ranks[r] = entry
         if world_size is None and "world_size" in payload:
             world_size = int(payload["world_size"])
@@ -704,8 +772,12 @@ def cluster_health(kv: Dict, world_size: Optional[int] = None,
             max((ranks[r]["last_heartbeat_age_s"] for r in ranks),
                 default=0.0), 3),
     }
+    out["rank_epochs"] = {str(r): ranks[r]["epoch"] for r in sorted(ranks)}
+    # a just-restarted rank's step-time histogram restarted with it —
+    # its p50 must not read as the fleet's fastest (or slowest) rank
     p50s = {r: float(ranks[r]["step_time_p50_s"]) for r in alive
-            if float(ranks[r].get("step_time_p50_s") or 0.0) > 0.0}
+            if float(ranks[r].get("step_time_p50_s") or 0.0) > 0.0
+            and not ranks[r].get("restarted")}
     if len(p50s) >= 2:
         lo, hi = min(p50s.values()), max(p50s.values())
         out["step_time_skew"] = round((hi - lo) / lo, 4)
@@ -740,11 +812,15 @@ def serve_cluster_health(kv_server, world_size: Optional[int] = None):
     """Register the aggregated ``GET /metrics/cluster`` route on a
     fleet ``KVServer`` (rank 0's).  Heartbeats arrive as ordinary KV
     PUTs under ``health/rank/<k>``; the route aggregates the live
-    store on every scrape, so there is no aggregation thread to die."""
+    store on every scrape, so there is no aggregation thread to die.
+    The rank-epoch book lives in the route closure — one per server,
+    so restart detection survives across scrapes without leaking
+    between servers (tests run many)."""
+    book: Dict = {}
 
     def route():
         return cluster_health(kv_server.kv_snapshot(HEALTH_KEY_PREFIX),
-                              world_size=world_size)
+                              world_size=world_size, book=book)
 
     kv_server.add_route("/metrics/cluster", route)
     _flight.record("health/cluster_route", world_size=world_size)

@@ -755,6 +755,65 @@ def test_world1_manager_rejects_partial_shard(tmp_path):
     m.close()
 
 
+def test_kv_barrier_dead_rank_fails_fast_with_named_rank(tmp_path):
+    """ISSUE 14 satellite: a 2-rank barrier whose peer is dead-listed
+    by the health plane mid-wait fails FAST with the missing rank
+    named, instead of burning the full deadline.  Rank 0 arrives and
+    polls; rank 1 never arrives and gets dead-listed ~0.4s in — the
+    raise must come well under the 30s deadline."""
+    from paddle_tpu.distributed.fleet.utils import KVServer
+
+    srv = KVServer(0)
+    srv.start()
+    try:
+        ep = f"127.0.0.1:{srv.port}"
+        dead = set()
+        b = KVBarrier(ep, rank=0, world_size=2, timeout=30,
+                      dead_ranks_fn=lambda: dead)
+        threading.Timer(0.4, lambda: dead.add(1)).start()
+        t0 = time.monotonic()
+        with pytest.raises(CheckpointError,
+                           match=r"rank\(s\) \[1\] dead-listed"):
+            b("written:9:j0")
+        assert time.monotonic() - t0 < 10.0  # fast, not the deadline
+    finally:
+        srv.stop()
+
+
+def test_kv_barrier_dead_rank_fn_errors_do_not_fail_the_barrier(
+        tmp_path):
+    """No evidence, no verdict: a dead_ranks_fn that raises (health
+    aggregator down) must not fail a barrier whose peers DO arrive."""
+    from paddle_tpu.distributed.fleet.utils import KVServer
+
+    srv = KVServer(0)
+    srv.start()
+    try:
+        ep = f"127.0.0.1:{srv.port}"
+
+        def broken():
+            raise OSError("aggregator down")
+
+        bs = [KVBarrier(ep, rank=r, world_size=2, timeout=30,
+                        dead_ranks_fn=broken) for r in range(2)]
+        errs = []
+
+        def run(r):
+            try:
+                bs[r]("t")
+            except BaseException as e:  # noqa: BLE001
+                errs.append(e)
+
+        ts = [threading.Thread(target=run, args=(r,)) for r in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30)
+        assert not errs, errs
+    finally:
+        srv.stop()
+
+
 def test_kv_barrier_stalled_server_times_out_as_checkpoint_error():
     """A server that ACCEPTS the connection but never responds raises a
     raw TimeoutError from urlopen (not URLError) — it must still be

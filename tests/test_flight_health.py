@@ -613,6 +613,109 @@ class TestClusterHealth:
         assert r.failures == 1
         assert stat_get("health_heartbeat_failures") >= 1
 
+    def test_restarted_rank_rejoins_alive_with_bumped_epoch(self):
+        """ISSUE 14 satellite: a dead-listed rank that RESUMES
+        heartbeating re-enters alive_ranks and clears from dead_ranks,
+        and its restart (new pid, dispatched counter reset) bumps a
+        MONOTONIC rank-epoch — so the supervisor can tell a restarted
+        rank from a straggler whose counters 'went backwards'."""
+        book = {}
+        now = time.time()
+
+        def hb(rank, ts, pid, disp, p50):
+            return json.dumps(
+                {"rank": rank, "ts": ts, "interval_s": 1.0, "pid": pid,
+                 "dispatched": disp, "drained": disp,
+                 "step_time_p50_s": p50}).encode()
+
+        # scrape 1: rank 1's beat is stale -> dead-listed
+        kv = {"health/rank/0": hb(0, now, 100, 50, 0.1),
+              "health/rank/1": hb(1, now - 100.0, 200, 40, 0.1)}
+        d1 = health.cluster_health(kv, world_size=2, now=now, book=book)
+        assert d1["dead_ranks"] == [1]
+        assert d1["rank_epochs"] == {"0": 0, "1": 0}
+
+        # scrape 2: rank 1 restarted — fresh pid, counters reset, live
+        # beat.  It must REJOIN alive, leave dead_ranks, and bump its
+        # epoch; its reset step-time must NOT enter the skew gauge.
+        kv = {"health/rank/0": hb(0, now + 1, 100, 60, 0.1),
+              "health/rank/1": hb(1, now + 1, 201, 2, 9.9)}
+        d2 = health.cluster_health(kv, world_size=2, now=now + 1,
+                                   book=book)
+        assert d2["dead_ranks"] == [] and d2["alive_ranks"] == 2
+        assert d2["ranks"]["1"]["epoch"] == 1
+        assert d2["ranks"]["1"]["restarted"] is True
+        assert d2["rank_epochs"]["1"] == 1
+        assert d2["step_time_skew"] == 0.0  # restarted rank excluded
+        assert stat_get("cluster_rank_restarts") >= 1
+
+        # scrape 2b: the restarted rank has NOT dispatched a step yet
+        # (counters unchanged) — the exclusion must be STICKY, not a
+        # single-scrape flag, or the cold p50 pollutes the skew gauge
+        # one scrape after detection
+        kv = {"health/rank/0": hb(0, now + 1.5, 100, 65, 0.1),
+              "health/rank/1": hb(1, now + 1.5, 201, 2, 9.9)}
+        d2b = health.cluster_health(kv, world_size=2, now=now + 1.5,
+                                    book=book)
+        assert d2b["ranks"]["1"]["epoch"] == 1  # no double bump
+        assert d2b["ranks"]["1"]["restarted"] is True
+        assert d2b["step_time_skew"] == 0.0
+
+        # scrape 3: the restarted rank's counters move FORWARD again —
+        # no further bump, and it re-enters the skew computation
+        kv = {"health/rank/0": hb(0, now + 2, 100, 70, 0.1),
+              "health/rank/1": hb(1, now + 2, 201, 12, 0.3)}
+        d3 = health.cluster_health(kv, world_size=2, now=now + 2,
+                                   book=book)
+        assert d3["ranks"]["1"]["epoch"] == 1
+        assert "restarted" not in d3["ranks"]["1"]
+        assert d3["step_time_skew"] == pytest.approx(2.0)
+
+    def test_counter_regression_alone_bumps_epoch(self):
+        """A rank whose cumulative dispatched counter went backwards
+        restarted even if its pid looks unchanged (pid reuse / missing
+        pid field): the epoch must still bump exactly once."""
+        book = {}
+        now = time.time()
+
+        def hb(disp):
+            return json.dumps({"rank": 0, "ts": now, "interval_s": 1.0,
+                               "dispatched": disp}).encode()
+
+        for disp, want_epoch in ((30, 0), (31, 0), (4, 1), (5, 1)):
+            doc = health.cluster_health(
+                {"health/rank/0": hb(disp)}, world_size=1, now=now,
+                book=book)
+            assert doc["rank_epochs"]["0"] == want_epoch, disp
+
+    def test_heartbeat_blackhole_chaos_dead_lists_then_recovers(self):
+        """fleet.elastic.chaos 'heartbeat_blackhole' drops a live
+        rank's beats (the injected dead-rank path); clearing the fault
+        lets the next beat through."""
+        from paddle_tpu.distributed.fleet.elastic import chaos
+
+        from paddle_tpu.distributed.fleet.utils.http_server import \
+            KVServer
+
+        srv = KVServer(0)
+        srv.start()
+        try:
+            r = health.HealthReporter(f"127.0.0.1:{srv.port}", rank=0,
+                                      interval_s=5.0)
+            chaos.inject("heartbeat_blackhole", rank=0, count=-1)
+            try:
+                assert r.publish_once() is False
+                assert r.publish_once() is False
+                assert srv.kv_snapshot(health.HEALTH_KEY_PREFIX) == {}
+                assert stat_get("health_heartbeat_blackholed") >= 2
+            finally:
+                chaos.clear()
+            assert r.publish_once() is True
+            assert "health/rank/0" in srv.kv_snapshot(
+                health.HEALTH_KEY_PREFIX)
+        finally:
+            srv.stop()
+
 
 # ---------------------------------------------------------------------------
 # /metrics scrape thread-safety under live recording (satellite)

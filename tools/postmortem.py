@@ -102,6 +102,20 @@ def render(bundle: str, tail: int = 15, stacks: bool = False,
     extra = meta.get("extra")
     if extra:
         w(f"  extra:    {json.dumps(extra)[:500]}\n")
+
+    # -- elastic supervisor restart history (fleet/elastic) ----------------
+    hist = (extra or {}).get("restart_history")
+    if hist:
+        w(f"\nelastic restart history ({len(hist)} attempt(s)):\n")
+        for h in hist:
+            line = (f"  #{h.get('attempt', '?')}  "
+                    f"world={h.get('world_size', '?')}  "
+                    f"kind={h.get('kind', '?')}  "
+                    f"step={h.get('step', '?')}  "
+                    f"{str(h.get('error', ''))[:100]}")
+            if h.get("dead_ranks"):
+                line += f"  dead_ranks={h['dead_ranks']}"
+            w(line + "\n")
     errs = meta.get("section_errors") or {}
     if errs:
         w(f"  section errors: {errs}\n")
@@ -223,7 +237,7 @@ def render(bundle: str, tail: int = 15, stacks: bool = False,
                 "pass_layer_scan", "decode_", "ttft_", "tpot_",
                 "spec_accept_rate", "prefill_chunks", "slo_burn_rate",
                 "slo_budget_remaining", "goodput", "request_trace",
-                "quant_", "pass_weight_quant")
+                "quant_", "pass_weight_quant", "elastic_", "chaos_")
         for ln in rows:
             if metrics or any(k in ln for k in keys):
                 w(f"  {ln}\n")

@@ -38,6 +38,7 @@ MODULES = [
     "paddle_tpu.distribution",
     "paddle_tpu.distributed",
     "paddle_tpu.distributed.fleet",
+    "paddle_tpu.distributed.fleet.elastic",
     "paddle_tpu.layers",
     "paddle_tpu.profiler",
     "paddle_tpu.text",
