@@ -579,6 +579,292 @@ def bench_transformer_depth(pt, jax):
     return out
 
 
+# 3D-parallelism / overlap flagship (ISSUE 15): dims tiny — the
+# quantities under test are schedule ratios and placement, not raw
+# throughput
+P3D_HIDDEN = 32
+P3D_BATCH = 16
+P3D_MICRO = 4
+P3D_STEPS = 8
+
+
+def _megatron_pp_program(pt, use_tp, n_micro=P3D_MICRO, hidden=P3D_HIDDEN):
+    """2-stage GPipe program of Megatron ffn pairs (names match
+    DEFAULT_MEGATRON_RULES: ffn1 column-parallel, ffn2 row-parallel),
+    built through the REAL production path when ``use_tp``
+    (strategy.tensor_parallel + strategy.pipeline -> the dp×mp×pp
+    composition in distributed/pipeline.py)."""
+    from paddle_tpu import layers
+    from paddle_tpu.framework import unique_name
+    from paddle_tpu.framework.program import (Program, device_guard,
+                                              program_guard)
+    from paddle_tpu.initializer import ConstantInitializer
+    from paddle_tpu.optimizer import MomentumOptimizer, PipelineOptimizer
+    from paddle_tpu.param_attr import ParamAttr
+
+    def attr(v):
+        return ParamAttr(initializer=ConstantInitializer(v))
+
+    H = hidden
+    main, startup = Program(), Program()
+    main.random_seed = 1
+    with unique_name.guard(), program_guard(main, startup):
+        x = layers.data("x", [H])
+        y = layers.data("y", [1])
+        h = x
+        for s in range(2):
+            with device_guard(f"stage:{s}"):
+                h = layers.fc(h, 4 * H, act="relu", name=f"b{s}_ffn1",
+                              param_attr=attr(0.02), bias_attr=attr(0.0))
+                h = layers.fc(h, H, name=f"b{s}_ffn2",
+                              param_attr=attr(0.02), bias_attr=attr(0.0))
+        with device_guard("stage:1"):
+            pred = layers.fc(h, 1, name="head", param_attr=attr(0.05),
+                             bias_attr=False)
+            loss = layers.mean(layers.square_error_cost(pred, y))
+        opt = MomentumOptimizer(0.02, 0.9)
+        if use_tp:
+            from paddle_tpu.distributed import fleet
+
+            strat = fleet.DistributedStrategy()
+            strat.tensor_parallel = True
+            strat.pipeline = True
+            strat.pipeline_configs = {"micro_batch": n_micro}
+            fleet.init(is_collective=True, strategy=strat)
+            fleet.distributed_optimizer(opt)
+            fleet.minimize(loss)
+        else:
+            PipelineOptimizer(opt, num_microbatches=n_micro).minimize(loss)
+    rng = np.random.RandomState(0)
+    X = rng.randn(P3D_BATCH, H).astype("f4")
+    Y = (X.sum(1, keepdims=True) * 0.1).astype("f4")
+    return main, startup, loss, {"x": X, "y": Y}
+
+
+def bench_overlap_3d(pt, jax):
+    """ISSUE 15 acceptance legs.
+
+    (A) **overlap A/B** on the transformer flagship: the depth-8
+    layer-scanned BERT-style step under the fleet dp transpile, run at
+    identical config with FLAGS_overlap_grad_allreduce off (sequential
+    schedule: one greedy bucket drags the stacked grad carrier's
+    allreduce to the end of the unrolled backward tail) vs on
+    (stretched buckets: the carrier dispatches at the scan boundary,
+    under the remaining backward compute).  Emits
+    ``overlap_step_time_ratio`` (on/off p50) and
+    ``overlap_hidden_comm_seconds`` (per-step comm wall hidden =
+    max(0, seq_p50 - ovl_p50); ~0 on a CPU host whose per-device
+    streams are synchronous — the placement is asserted structurally
+    and the wire-time win realizes on hardware with async collectives).
+    Loss equality between the two schedules is ASSERTED (the rewrite
+    is placement-only).
+
+    (B) **pp×tp leg**: the 2-stage Megatron-ffn GPipe program on a
+    ('mp','pp') — or ('dp','mp','pp') with 8+ devices — mesh through
+    strategy.tensor_parallel × strategy.pipeline, loss parity ≤1e-4
+    ASSERTED vs the SAME schedule with mp replicated, emitting
+    ``bert_3d_tokens_per_sec`` (rows/sec through the stacked ffn
+    blocks), ``pp_bubble_fraction`` (the GPipe (S-1)/(K+S-1) schedule
+    cost, also a _ppm gauge), and the MFU estimate when a peak is
+    configured."""
+    from paddle_tpu import observe
+    from paddle_tpu.distributed.parallel_env import reset_mesh, set_mesh
+    from paddle_tpu.framework.place import _default_place
+    from paddle_tpu.monitor import stat_get, stat_reset, stat_set
+
+    devs = jax.devices()
+    n = len(devs)
+    if n < 2:
+        raise RuntimeError(f"bench_overlap_3d needs >= 2 devices, have {n}")
+    out = {}
+
+    # ---- (A) overlap A/B on the scanned transformer ----------------------
+    dp = min(n, 8)
+    mesh_dp = jax.sharding.Mesh(np.array(devs[:dp]), ("dp",))
+
+    def run_overlap(overlap):
+        from paddle_tpu import layers
+        from paddle_tpu.distributed import fleet
+        from paddle_tpu.framework import unique_name
+        from paddle_tpu.framework.program import Program, program_guard
+        from paddle_tpu.initializer import ConstantInitializer
+        from paddle_tpu.optimizer import MomentumOptimizer
+        from paddle_tpu.param_attr import ParamAttr
+
+        pt.set_flags({"FLAGS_overlap_grad_allreduce": overlap,
+                      "FLAGS_layer_scan": True})
+        reset_mesh()
+        set_mesh(mesh_dp)
+        try:
+            # the transformer flagship's SCANNED region: a depth-8
+            # isomorphic ffn stack (the shard_map dp path needs
+            # per-shard-shapeable programs, which rules out the BERT
+            # builder's static global-batch reshapes), plus unrolled
+            # head/loss edges whose grads form the post-scan tail
+            H, depth = DEPTH_FFN, DEPTH_SHALLOW
+            main_p, startup = Program(), Program()
+            main_p.random_seed = 1
+            with unique_name.guard(), program_guard(main_p, startup):
+                x = layers.data("x", [H])
+                y = layers.data("y", [1])
+                h = x
+                for i in range(depth):
+                    h = layers.fc(h, H, act="relu", name=f"ffn_{i}",
+                                  param_attr=ParamAttr(
+                                      initializer=ConstantInitializer(
+                                          0.02)),
+                                  bias_attr=False)
+                pred = layers.fc(h, 1, name="head",
+                                 param_attr=ParamAttr(
+                                     initializer=ConstantInitializer(
+                                         0.05)),
+                                 bias_attr=False)
+                loss = layers.mean(layers.square_error_cost(pred, y))
+                fleet.init(is_collective=True)
+                fleet.distributed_optimizer(MomentumOptimizer(0.02, 0.9))
+                fleet.minimize(loss)
+            rng = np.random.RandomState(0)
+            X = rng.randn(P3D_BATCH * dp, H).astype("f4")
+            feed = {"x": X,
+                    "y": (X.sum(1, keepdims=True) * 0.05).astype("f4")}
+            exe = pt.Executor(_default_place(), mesh=mesh_dp)
+            try:
+                scope = pt.framework.Scope()
+                exe.run(startup, scope=scope)
+                stat_reset("pass_overlap_stretched_buckets")
+                warm = np.asarray(exe.run(main_p, feed=feed,
+                                          fetch_list=[loss],
+                                          scope=scope)[0]).item()
+                exe.drain()
+                stretched = int(
+                    stat_get("pass_overlap_stretched_buckets"))
+                return exe, scope, main_p, loss, feed, warm, stretched
+            except BaseException:
+                try:
+                    exe.close()
+                finally:
+                    raise
+        finally:
+            pt.set_flags({"FLAGS_overlap_grad_allreduce": True,
+                          "FLAGS_layer_scan": False})
+            reset_mesh()
+
+    # interleaved A/B (the request-trace bench pattern): one timed step
+    # per schedule per round so host drift cancels; median per-step
+    # wall time is the schedule's number.  The leg's OWN flags are
+    # re-set before each timed step — both are affects_lowering, so a
+    # step run under the other leg's flag state would re-key the pass/
+    # compile caches and silently recompile BOTH legs onto one schedule
+    # (the warm-up compiled each leg under its own state; matching it
+    # here makes every timed call a cache hit)
+    legs = {}
+    times = {False: [], True: []}
+    try:
+        legs[False] = run_overlap(False)
+        legs[True] = run_overlap(True)
+        losses = {False: [legs[False][5]], True: [legs[True][5]]}
+        compiles_before = stat_get("executor_compile")
+        for _ in range(2 * P3D_STEPS):
+            for ov in (False, True):
+                exe, scope, main_p, loss, feed, _, _ = legs[ov]
+                pt.set_flags({"FLAGS_overlap_grad_allreduce": ov,
+                              "FLAGS_layer_scan": True})
+                t0 = time.perf_counter()
+                v = exe.run(main_p, feed=feed, fetch_list=[loss],
+                            scope=scope)[0]
+                losses[ov].append(np.asarray(v).item())
+                times[ov].append(time.perf_counter() - t0)
+        if stat_get("executor_compile") != compiles_before:
+            raise RuntimeError(
+                "overlap A/B timed steps recompiled — a leg ran under "
+                "the other leg's flag state; the ratio would compare "
+                "one schedule against itself")
+    finally:
+        pt.set_flags({"FLAGS_overlap_grad_allreduce": True,
+                      "FLAGS_layer_scan": False})
+        for leg in legs.values():
+            # close even on the error paths: a leaked Executor keeps
+            # its compiled fns + buffers alive for the rest of the
+            # bench process
+            try:
+                leg[0].close()
+            except Exception:  # noqa: BLE001 — closing is best-effort
+                pass
+    if losses[False] != losses[True]:
+        raise RuntimeError(
+            f"overlap A/B losses diverged — the bucket stretch must be "
+            f"placement-only: {losses[False][:3]} vs {losses[True][:3]}")
+    stretched = legs[True][6]
+    if stretched < 1:
+        raise RuntimeError(
+            "overlapped schedule did not stretch any bucket at the "
+            "scan boundary (pass_overlap_stretched_buckets == 0)")
+    seq_p50 = float(np.median(times[False]))
+    ovl_p50 = float(np.median(times[True]))
+    hidden = max(seq_p50 - ovl_p50, 0.0)
+    out["overlap_step_time_ms_p50"] = round(ovl_p50 * 1e3, 3)
+    out["overlap_sequential_step_time_ms_p50"] = round(seq_p50 * 1e3, 3)
+    if seq_p50 > 0:
+        out["overlap_step_time_ratio"] = round(ovl_p50 / seq_p50, 4)
+    out["overlap_hidden_comm_seconds"] = round(hidden, 6)
+    out["overlap_stretched_buckets"] = stretched
+    stat_set("overlap_hidden_comm_seconds_micro", int(hidden * 1e6))
+
+    # ---- (B) pp×tp leg ---------------------------------------------------
+    if n >= 4:
+        if n >= 8:
+            mesh_3d = jax.sharding.Mesh(
+                np.array(devs[:8]).reshape(2, 2, 2), ("dp", "mp", "pp"))
+            mesh_oracle = jax.sharding.Mesh(
+                np.array(devs[:4]).reshape(2, 2), ("dp", "pp"))
+        else:
+            mesh_3d = jax.sharding.Mesh(
+                np.array(devs[:4]).reshape(2, 2), ("mp", "pp"))
+            mesh_oracle = jax.sharding.Mesh(np.array(devs[:2]), ("pp",))
+
+        def run_3d(mesh, use_tp, timed=False):
+            reset_mesh()
+            if use_tp:
+                set_mesh(mesh)
+            try:
+                main_p, startup, loss, feed = _megatron_pp_program(
+                    pt, use_tp=use_tp)
+                exe = pt.Executor(_default_place(), mesh=mesh)
+                scope = pt.framework.Scope()
+                exe.run(startup, scope=scope)
+                losses = [np.asarray(exe.run(
+                    main_p, feed=feed, fetch_list=[loss],
+                    scope=scope)[0]).item()]
+                if timed:
+                    observe.reset_step_stats()
+                t0 = time.perf_counter()
+                for _ in range(P3D_STEPS):
+                    losses.append(np.asarray(exe.run(
+                        main_p, feed=feed, fetch_list=[loss],
+                        scope=scope)[0]).item())
+                exe.drain()
+                dt = time.perf_counter() - t0
+                mfu = observe.step_timer().summary().get("mfu") \
+                    if timed else None
+                exe.close()
+                return losses, dt, mfu
+            finally:
+                reset_mesh()
+
+        oracle, _, _ = run_3d(mesh_oracle, use_tp=False)
+        got, dt, mfu = run_3d(mesh_3d, use_tp=True, timed=True)
+        np.testing.assert_allclose(got, oracle, rtol=1e-4, atol=1e-6)
+        out["bert_3d_tokens_per_sec"] = round(
+            P3D_BATCH * P3D_STEPS / dt, 1)
+        out["bert_3d_mesh"] = list(mesh_3d.devices.shape)
+        out["bert_3d_loss_parity"] = True
+        out["pp_bubble_fraction"] = round(
+            stat_get("pp_bubble_fraction_ppm") / 1e6, 4)
+        if mfu is not None:
+            out["bert_3d_mfu_estimate"] = mfu
+    return out
+
+
 SERVE_CLIENTS = 32
 SERVE_REQS = 256
 SERVE_FEAT = 64
@@ -1529,6 +1815,14 @@ def main():
             result.update(bench_bert_tp(pt, jax))
         except Exception as e:
             errors["bert_tp"] = f"{type(e).__name__}: {e}"[:500]
+        try:
+            # 3D parallelism + overlap A/B (ISSUE 15): stretched-bucket
+            # schedule ratio on the scanned transformer and the pp×tp
+            # composition leg with loss parity vs the mp-replicated
+            # oracle
+            result.update(bench_overlap_3d(pt, jax))
+        except Exception as e:
+            errors["overlap_3d"] = f"{type(e).__name__}: {e}"[:500]
 
     ratios = []
     if ips is not None:

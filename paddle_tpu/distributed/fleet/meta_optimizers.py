@@ -823,10 +823,16 @@ class TensorParallelMetaOptimizer(MetaOptimizerBase):
         from ..parallel_env import get_mesh
 
         strat = self.user_strategy
-        if strat.pipeline or strat.localsgd:
+        if strat.localsgd:
+            # pipeline now COMPOSES (the dp×mp×pp mesh: pipeline stages
+            # partition the block, tp rules shard within each stage's
+            # blocks — distributed/pipeline.py manual Megatron path);
+            # localsgd remains genuinely unsupported: its periodic
+            # host-side parameter averaging runs between executor calls
+            # and has no mp-sharded form here
             raise NotImplementedError(
                 "strategy.tensor_parallel does not compose with "
-                "strategy.pipeline/localsgd yet: both re-own program "
+                "strategy.localsgd yet: both re-own program "
                 "execution; unset one")
         mesh = get_mesh()
         if mesh is not None and "mp" not in mesh.axis_names:
@@ -834,6 +840,13 @@ class TensorParallelMetaOptimizer(MetaOptimizerBase):
                 "strategy.tensor_parallel needs a mesh with an 'mp' "
                 "axis; build it with init_parallel_env(mesh_shape="
                 "(dp, mp), axis_names=('dp', 'mp'))")
+        if strat.pipeline and mesh is not None \
+                and "pp" not in mesh.axis_names:
+            raise ValueError(
+                "strategy.tensor_parallel + strategy.pipeline needs a "
+                "mesh with BOTH 'mp' and 'pp' axes; build it with "
+                "init_parallel_env(mesh_shape=(dp, mp, pp), "
+                "axis_names=('dp', 'mp', 'pp'))")
 
         ops, params_grads = self.inner_opt.minimize(
             loss, startup_program, parameter_list, no_grad_set)

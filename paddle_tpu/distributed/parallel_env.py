@@ -25,7 +25,7 @@ _ring_axes: Dict[int, object] = {}
 
 
 def init_parallel_env(mesh_shape: Optional[Sequence[int]] = None,
-                      axis_names: Sequence[str] = ("dp",)):
+                      axis_names: Optional[Sequence[str]] = None):
     """Bootstrap multi-process (if env says so) and build the global mesh.
 
     Single process: mesh over all visible devices.  Multi process: after
@@ -59,8 +59,29 @@ def init_parallel_env(mesh_shape: Optional[Sequence[int]] = None,
 
     devices = jax.devices()
     if mesh_shape is None:
-        mesh_shape = [len(devices)]
-        axis_names = tuple(axis_names)[:1] or ("dp",)
+        from ..framework import flags as _flags
+
+        pp = int(_flags.flag("pp_degree") or 0)
+        if pp > 1 and axis_names is None:
+            # FLAGS_pp_degree: carve a (dp, pp) mesh out of the visible
+            # devices so stage-annotated programs run the GPipe
+            # schedule without an explicit mesh_shape.  The pipeline
+            # degree a program runs with is ALWAYS the mesh's 'pp'
+            # size; this default only shapes meshes built fully
+            # shapeless — an EXPLICIT axis_names argument wins over the
+            # flag (the caller named its axes for a reason).
+            if len(devices) % pp != 0:
+                raise ValueError(
+                    f"FLAGS_pp_degree={pp} does not divide the "
+                    f"{len(devices)} visible devices; pass an explicit "
+                    f"mesh_shape or fix the flag")
+            mesh_shape = [len(devices) // pp, pp]
+            axis_names = ("dp", "pp")
+        else:
+            mesh_shape = [len(devices)]
+            axis_names = tuple(axis_names or ("dp",))[:1] or ("dp",)
+    elif axis_names is None:
+        axis_names = ("dp",)
     import numpy as np
 
     n = int(np.prod(mesh_shape))

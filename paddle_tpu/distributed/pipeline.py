@@ -41,9 +41,38 @@ v2 capabilities retained: dropout-safe per-(stage, microbatch) RNG,
 carried batch-norm stats, multi-tensor/ragged/skip boundaries via the
 packed activation carrier, dp x pp meshes.
 
+v4 — dp×mp×pp composition + collective–compute overlap:
+- tensor parallelism INSIDE each stage (Megatron-style, manual): when
+  the program carries a ShardingPropagationPass plan (the
+  TensorParallelMetaOptimizer now composes with pipeline), rule-matched
+  params and their optimizer slots are packed as per-mp-rank SHARDS —
+  the packed buffer grows an mp dimension, (n_stages, mp, width)
+  sharded ``P('pp','mp')`` — and the stage trace applies the Megatron
+  f/g operators at the pass's constraint anchors: a column-parallel
+  matmul's input rides ``f`` (identity fwd / mp-psum bwd), a
+  row-parallel (contracted, "\\tP"-flagged) matmul's partial output
+  rides ``g`` (mp-psum fwd / identity bwd).  Both are explicit
+  ``custom_vjp``s, so ``jax.vjp`` of the staged forward produces exact
+  shard gradients with no dependence on psum-transpose conventions.
+- scan-over-layers INSIDE each stage: isomorphic per-layer op runs
+  within one stage's forward (and its optimizer partition) are traced
+  as ONE ``lax.scan`` over stacked per-layer weights (same detection
+  machinery as framework/passes.py LayerScanPass, same RNG-threading
+  contract, bitwise vs the unrolled trace) — trace/compile cost per
+  stage becomes ~constant in stage depth.
+- latency-hiding collective matmul: with
+  ``FLAGS_collective_matmul_chunks`` > 1, each row-parallel
+  matmul+psum decomposes into k output-row chunks whose per-chunk mp
+  reduces overlap the remaining chunk matmuls
+  (ops/collective_matmul.py).
+
 Remaining restrictions (loud errors): float32 training state; boundary
 tensors must be floating point; no cross-stage optimizer reductions
-(global grad clip); shared (multi-stage) parameters.
+(global grad clip); shared (multi-stage) parameters; mp-sharded
+activations may only flow through the matmul/elementwise/activation
+family (softmax/dropout/layer_norm and friends need replicated inputs
+— the Megatron block shape, where the row-parallel reduce precedes
+them, satisfies this by construction).
 """
 from __future__ import annotations
 
@@ -52,6 +81,117 @@ from typing import Dict, List
 import numpy as np
 
 PACKED_STATE_VAR = "@PP_PACKED_STATE@"
+
+_TP_MATMUL_TYPES = ("mul", "matmul", "matmul_v2")
+
+# ops that provably keep a value's mp layout (elementwise over the
+# local shard); everything NOT here and not handled structurally must
+# see replicated inputs under pipeline×mp — validated at plan time
+_MP_PRESERVING = {"relu", "gelu", "tanh", "sigmoid", "cast", "scale",
+                  "assign", "c_identity", "recompute_barrier"}
+
+
+def _mp_only(spec):
+    return tuple("mp" if s == "mp" else None for s in (spec or ()))
+
+
+def _validate_mp_flow(block, stage_ops, tp_plan):
+    """Strict mp-layout walk over the staged FORWARD ops (compile
+    time).  The manual pipeline×mp trace runs each op on LOCAL shard
+    values, so any op outside the understood family that consumes an
+    mp-sharded value would compute a silently-wrong local result —
+    refuse loudly instead.  Returns the final name -> mp-spec map (the
+    fetch/boundary checks read it)."""
+    from ..framework.passes import TP_CONSTRAINT_ATTR, decode_anchor
+
+    known: Dict[str, tuple] = {
+        n: _mp_only(s) for n, s in tp_plan.specs.items()
+        if any(x == "mp" for x in s)}
+
+    def has_mp(n):
+        return any(x == "mp" for x in known.get(n, ()))
+
+    for si, ops in enumerate(stage_ops):
+        for op in ops:
+            anchors = [decode_anchor(e)
+                       for e in (op.attr(TP_CONSTRAINT_ATTR, []) or [])]
+            if op.type in _TP_MATMUL_TYPES:
+                outs = op.output_arg_names()
+                if anchors:
+                    for n, spec, partial in anchors:
+                        sp = _mp_only(spec)
+                        if partial or not any(x == "mp" for x in sp):
+                            known.pop(n, None)  # g-psum'd -> replicated
+                        else:
+                            known[n] = sp
+                elif any(has_mp(n) for n in op.input_arg_names()):
+                    raise NotImplementedError(
+                        f"pipeline×mp: un-anchored {op.type!r} in stage "
+                        f"{si} reads an mp-sharded value; the sharding "
+                        f"pass could not classify it — adjust the "
+                        f"partition rules")
+                else:
+                    for n in outs:
+                        known.pop(n, None)
+                continue
+            if op.type in ("transpose", "transpose2"):
+                xs = op.inputs.get("X", [])
+                outs = op.output_arg_names()
+                spec = known.get(xs[0]) if len(xs) == 1 else None
+                axes = [int(a) for a in (op.attr("axis", []) or [])]
+                if spec is not None and len(axes) == len(spec) and outs:
+                    known[outs[0]] = tuple(spec[a] for a in axes)
+                    continue
+                if any(has_mp(n) for n in op.input_arg_names()):
+                    raise NotImplementedError(
+                        f"pipeline×mp: transpose of an mp-sharded value "
+                        f"with unknown axes in stage {si}")
+                for n in outs:
+                    known.pop(n, None)
+                continue
+            if op.type.startswith("elementwise_") \
+                    and not op.type.endswith("_grad"):
+                xs = op.inputs.get("X", [])
+                ys = op.inputs.get("Y", [])
+                xsp = known.get(xs[0]) if xs else None
+                ysp = known.get(ys[0]) if ys else None
+                if ysp is not None and any(x == "mp" for x in ysp):
+                    # broadcast operand sharded (a column-parallel
+                    # bias): valid only when X is sharded the same way
+                    # on its trailing dim
+                    if xsp is None or xsp[-1] != ysp[-1]:
+                        raise NotImplementedError(
+                            f"pipeline×mp: {op.type!r} in stage {si} "
+                            f"broadcasts mp-sharded {ys[0]!r} into a "
+                            f"differently-laid-out operand")
+                for n in op.output_arg_names():
+                    if xsp is not None and any(x == "mp" for x in xsp):
+                        known[n] = xsp
+                    else:
+                        known.pop(n, None)
+                continue
+            if op.type in _MP_PRESERVING:
+                xs = op.inputs.get("X", [])
+                spec = known.get(xs[0]) if len(xs) == 1 else None
+                for n in op.output_arg_names():
+                    if spec is not None:
+                        known[n] = spec
+                    else:
+                        known.pop(n, None)
+                continue
+            bad = sorted(n for n in op.input_arg_names() if has_mp(n))
+            if bad:
+                raise NotImplementedError(
+                    f"pipeline×mp: op {op.type!r} in stage {si} reads "
+                    f"mp-sharded value(s) {bad}; only the matmul/"
+                    f"elementwise/activation family may touch sharded "
+                    f"activations — end the sharded region with a "
+                    f"row-parallel matmul (the Megatron pattern puts "
+                    f"softmax/dropout/layer_norm after the mp reduce) "
+                    f"or drop the partition rule for these weights")
+            for n in op.output_arg_names():
+                known.pop(n, None)
+    return known
 
 
 def analyze_stages(program, n_stages: int):
@@ -118,7 +258,8 @@ class PackPlan:
     """
 
     def __init__(self, n_stages, owned_stage, params_by_stage,
-                 stage_opt_ops, shared_opt_ops, stage_ops, boundaries):
+                 stage_opt_ops, shared_opt_ops, stage_ops, boundaries,
+                 mp_degree=1, tp_dims=None, mp_specs=None):
         self.n_stages = n_stages
         self.owned_stage: Dict[str, int] = owned_stage
         self.owned_names = frozenset(owned_stage)
@@ -129,19 +270,36 @@ class PackPlan:
         # compiled fn uses the identical view instead of re-deriving one
         self.stage_ops = stage_ops
         self.boundaries = boundaries
-        # filled by _build_layout on first ensure_packed
-        self.entries = None  # per stage: [(name, off, size, shape), ...]
-        self.layout = None   # name -> (stage, off, size, shape)
+        # dp×mp×pp composition: tensor-parallel degree, per-var sharded
+        # dim of the owned state (params + inheriting slots), and the
+        # strict mp-layout walk's final spec map (fetch validation)
+        self.mp_degree = int(mp_degree)
+        self.tp_dims: Dict[str, int] = dict(tp_dims or {})
+        self.mp_specs: Dict[str, tuple] = dict(mp_specs or {})
+        # filled by _build_layout on first ensure_packed; entry shapes
+        # are LOCAL (per-mp-rank shard) shapes, gshapes the global ones
+        self.entries = None  # per stage: [(name, off, size, lshape), ...]
+        self.layout = None   # name -> (stage, off, size, lshape)
+        self.gshapes: Dict[str, tuple] = {}
         self.width = None
 
     # -- layout --------------------------------------------------------
+    def _local_shape(self, name, gshape):
+        d = self.tp_dims.get(name)
+        if d is None or self.mp_degree <= 1:
+            return tuple(gshape)
+        ls = list(gshape)
+        ls[d] = int(ls[d]) // self.mp_degree
+        return tuple(ls)
+
     def _build_layout(self, shapes: Dict[str, tuple]):
         entries = [[] for _ in range(self.n_stages)]
         layout = {}
         cursor = [0] * self.n_stages
         for n in sorted(self.owned_stage):
             s = self.owned_stage[n]
-            shape = shapes[n]
+            gshape = tuple(shapes[n])
+            shape = self._local_shape(n, gshape)
             size = 1
             for d in shape:
                 size *= int(d)
@@ -149,6 +307,7 @@ class PackPlan:
             cursor[s] += size
             entries[s].append((n, off, size, shape))
             layout[n] = (s, off, size, shape)
+            self.gshapes[n] = gshape
         self.entries = entries
         self.layout = layout
         self.width = max(cursor) if max(cursor) > 0 else 1
@@ -192,19 +351,19 @@ class PackPlan:
                         f"float32 training state; {n!r} is {dt}")
                 shapes[n] = tuple(int(d) for d in v.shape)
             self._build_layout(shapes)
+        S, W, MP = self.n_stages, self.width, self.mp_degree
+        buf_shape = (S, W) if MP <= 1 else (S, MP, W)
         if has_buf:
-            buf_shape = tuple(scope.get_var(PACKED_STATE_VAR).shape)
-            if buf_shape != (self.n_stages, self.width):
+            have = tuple(scope.get_var(PACKED_STATE_VAR).shape)
+            if have != buf_shape:
                 raise RuntimeError(
                     f"existing packed pipeline buffer has shape "
-                    f"{buf_shape}, expected "
-                    f"{(self.n_stages, self.width)}; the program's "
+                    f"{have}, expected {buf_shape}; the program's "
                     f"stage-owned state changed — rebuild the scope")
         if has_buf and not concrete:
             return
 
-        S, W = self.n_stages, self.width
-        buf = np.zeros((S, W), np.float32)
+        buf = np.zeros(buf_shape, np.float32)
         if has_buf:
             buf[:] = np.asarray(scope.get_var(PACKED_STATE_VAR))
         elif len(concrete) != len(self.owned_stage):
@@ -214,23 +373,46 @@ class PackPlan:
                 f"packed buffer exists in this scope")
         for n, v in concrete.items():
             s, off, size, shape = self.layout[n]
-            if tuple(v.shape) != tuple(shape):
+            gshape = self.gshapes[n]
+            if tuple(v.shape) != tuple(gshape):
                 raise ValueError(
                     f"pipeline state var {n!r} has shape {v.shape}, "
-                    f"expected {shape}")
-            buf[s, off:off + size] = v.astype(np.float32).ravel()
-        sharding = NamedSharding(mesh, P("pp"))
+                    f"expected {gshape}")
+            v = v.astype(np.float32)
+            if MP <= 1:
+                buf[s, off:off + size] = v.ravel()
+                continue
+            d = self.tp_dims.get(n)
+            for r in range(MP):
+                if d is None:
+                    shard = v  # replicated: same bytes on every mp rank
+                else:
+                    k = int(gshape[d]) // MP
+                    sl = [slice(None)] * len(gshape)
+                    sl[d] = slice(r * k, (r + 1) * k)
+                    shard = v[tuple(sl)]
+                buf[s, r, off:off + size] = shard.ravel()
+        sharding = NamedSharding(mesh, P("pp") if MP <= 1
+                                 else P("pp", "mp"))
         arr = jax.make_array_from_callback(
-            (S, W), sharding, lambda idx: buf[idx])
+            buf_shape, sharding, lambda idx: buf[idx])
         scope.set_var(PACKED_STATE_VAR, arr)
         for n, (s, off, size, shape) in self.layout.items():
-            scope.set_var(n, PackedParamRef(scope, PACKED_STATE_VAR, s, off,
-                                            shape, np.float32))
+            scope.set_var(n, PackedParamRef(
+                scope, PACKED_STATE_VAR, s, off, self.gshapes[n],
+                np.float32, mp_degree=MP,
+                mp_dim=self.tp_dims.get(n)))
 
 
-def plan_packing(program, n_stages, state_in, state_out, pipe):
+def plan_packing(program, n_stages, state_in, state_out, pipe,
+                 tp_plan=None):
     """Compute stage ownership of params + optimizer slots and partition
-    the optimizer ops per stage (compile-time; shapes come later)."""
+    the optimizer ops per stage (compile-time; shapes come later).
+
+    ``tp_plan`` (the ShardingPropagationPass output on the post-pass
+    program) turns on the dp×mp×pp composition: rule-matched owned vars
+    are packed as per-mp-rank shards and the strict mp-flow walk
+    validates that sharded activations only meet understood ops."""
     from ..framework.lowering import PSEUDO_OPS
 
     stage_ops, boundaries = analyze_stages(program, n_stages)
@@ -322,8 +504,164 @@ def plan_packing(program, n_stages, state_in, state_out, pipe):
 
     params_by_stage = [[p for p in sorted(grad_of) if param_stage[p] == s]
                        for s in range(n_stages)]
+
+    # dp×mp×pp: per-owned-var sharded dim from the tp plan + the strict
+    # mp-flow validation of the staged forward
+    mp_degree = 1
+    tp_dims: Dict[str, int] = {}
+    mp_specs: Dict[str, tuple] = {}
+    if tp_plan is not None and tp_plan.mp_degree > 1:
+        mp_degree = tp_plan.mp_degree
+        for n in owned_stage:
+            spec = tuple(tp_plan.specs.get(n, ()))
+            dims = [i for i, x in enumerate(spec) if x == "mp"]
+            if len(dims) > 1:
+                raise NotImplementedError(
+                    f"pipeline×mp: {n!r} is mp-sharded on several dims "
+                    f"({spec}); one 'mp' dim per var is supported")
+            if dims:
+                tp_dims[n] = dims[0]
+        mp_specs = _validate_mp_flow(block, stage_ops, tp_plan)
+
     return PackPlan(n_stages, owned_stage, params_by_stage, stage_opt_ops,
-                    shared_opt_ops, stage_ops, boundaries)
+                    shared_opt_ops, stage_ops, boundaries,
+                    mp_degree=mp_degree, tp_dims=tp_dims,
+                    mp_specs=mp_specs)
+
+
+def _plan_stage_scans(program, plan, extra_needed):
+    """Scan-over-layers INSIDE each pipeline stage: detect isomorphic
+    per-layer op runs in every stage's forward partition (and its
+    optimizer partition) with the LayerScanPass machinery, and plan
+    them for a trace-level ``lax.scan`` — the stage body is traced once
+    per run instead of once per layer, so trace+compile cost per stage
+    stays ~constant in stage depth while numerics are bitwise (same
+    ops, same order, same RNG-split chain threaded through the carry).
+
+    Returns ``(fwd_runs, opt_runs, policy)``; ``None`` lists when the
+    scan gate (FLAGS_layer_scan / recompute_configs stamps) is off.
+    Rejected runs fall back to the unrolled trace, counted
+    ``pipeline_scan_skipped_<reason>``."""
+    from ..framework.passes import LayerScanPass
+    from ..monitor import stat_add, stat_set
+
+    enabled, min_layers, policy = LayerScanPass._config(program)
+    if not enabled:
+        return None, None, ""
+    lsp = LayerScanPass()
+    block = program.global_block
+
+    def plan_list(ops_seq, base_need):
+        ops_list = list(ops_seq)
+        runs = []
+        for (start, L, M) in lsp._find_runs(block, ops_list, min_layers):
+            cplan, reason = lsp._classify(ops_list, start, L, M)
+            if cplan is None:
+                stat_add("pipeline_scan_skipped")
+                stat_add(f"pipeline_scan_skipped_{reason}")
+                continue
+            need = set(base_need)
+            for i, op in enumerate(ops_list):
+                if not (cplan.start <= i < cplan.end):
+                    need.update(op.input_arg_names())
+            # carry INTERMEDIATES never materialize per layer: a mid-
+            # chain value consumed outside the run keeps it unrolled
+            bad = False
+            for (t, w) in cplan.carries:
+                mem_in = [sg[t] for sg in cplan.sigmas]
+                mem_out = [sg[w] for sg in cplan.sigmas]
+                if (set(mem_in[1:]) | set(mem_out[:-1])) & need:
+                    bad = True
+                    break
+            if bad:
+                stat_add("pipeline_scan_skipped")
+                stat_add("pipeline_scan_skipped_carry_read")
+                continue
+            ys_emit = []
+            for fam in cplan.ys:
+                idxs = [i for i, m in enumerate(fam["members"])
+                        if m in need]
+                if idxs:
+                    ys_emit.append((fam, idxs))
+            runs.append({"start": cplan.start, "end": cplan.end,
+                         "plan": cplan, "ys_emit": ys_emit})
+        return runs
+
+    fwd_runs = [plan_list(plan.stage_ops[s], extra_needed)
+                for s in range(plan.n_stages)]
+    # optimizer partitions: every owned per-layer state member is read
+    # back by the packed-row update, so all ys materialize
+    opt_need = set(plan.owned_names) | set(extra_needed)
+    opt_runs = [plan_list(plan.stage_opt_ops[s], opt_need)
+                for s in range(plan.n_stages)]
+    n_runs = sum(len(r) for r in fwd_runs) + sum(len(r) for r in opt_runs)
+    stat_set("pipeline_scan_segments", n_runs)
+    return fwd_runs, opt_runs, policy
+
+
+def _emit_stage_scan(ctx, run, lower_one, policy):
+    """Trace one planned isomorphic run as a single ``lax.scan`` over
+    stacked per-layer values (stacking env entries at trace time keeps
+    the op semantics byte-for-byte: each iteration lowers exactly the
+    template ops the unrolled trace would, with the same key chain)."""
+    import jax.numpy as jnp
+
+    from ..framework import jax_compat as _jc
+    from ..framework.lowering import LoweringContext
+
+    plan = run["plan"]
+    env = ctx.env
+    carry_t = [t for t, _ in plan.carries]
+    carry_w = [w for _, w in plan.carries]
+    xs_tpls = [f["tpl"] for f in plan.xs]
+    xs_stacks = tuple(
+        jnp.stack([jnp.asarray(env[m]) for m in f["members"]])
+        for f in plan.xs)
+    shared_vals = {n: env[n] for n in plan.shared}
+    init = tuple(jnp.asarray(env[t]) for t in carry_t)
+    ys_emit = run["ys_emit"]
+    ys_tpls = [f["tpl"] for f, _ in ys_emit]
+    has_key = ctx.rng_key is not None
+    consumed = [False]
+
+    def body(carry, x):
+        key, cvals = (carry[0], carry[1:]) if has_key else (None, carry)
+        benv = dict(shared_vals)
+        benv.update(zip(carry_t, cvals))
+        if xs_tpls:
+            benv.update(zip(xs_tpls, x))
+        bctx = LoweringContext(ctx.block, benv, rng_key=key,
+                               mesh=ctx.mesh, axis_env=ctx.axis_env,
+                               ring_axes=ctx.ring_axes,
+                               fold_axes=ctx.fold_axes)
+        for top in plan.tpl:
+            lower_one(bctx, top)
+        consumed[0] = consumed[0] or bctx.rng_consumed
+        ys = tuple(jnp.asarray(benv[t]) for t in ys_tpls)
+        nc = tuple(benv[w] for w in carry_w)
+        if has_key:
+            new_key = bctx.rng_key if bctx.rng_consumed else key
+            return (new_key,) + nc, ys
+        return nc, ys
+
+    body = _jc.wrap_checkpoint(body, policy or "")
+    init_carry = ((ctx.rng_key,) + init) if has_key else init
+    final, ys_stacks = _jc.scan(body, init_carry,
+                                xs_stacks if xs_stacks else None,
+                                length=plan.M)
+    if has_key:
+        new_key, fvals = final[0], final[1:]
+        if consumed[0]:
+            ctx._rng = new_key
+            ctx.rng_consumed = True
+    else:
+        fvals = final
+    sigN = plan.sigmas[-1]
+    for w, v in zip(carry_w, fvals):
+        env[sigN[w]] = v
+    for (fam, idxs), stack in zip(ys_emit, ys_stacks):
+        for i in idxs:
+            env[fam["members"][i]] = stack[i]
 
 
 def build_pipeline_fn(program, mesh, feed_names, state_mut, state_const,
@@ -354,6 +692,21 @@ def build_pipeline_fn(program, mesh, feed_names, state_mut, state_const,
             f"{mesh.axis_names}")
     dp_axis = "dp" if "dp" in mesh.axis_names else None
     dp_size = int(mesh.shape[dp_axis]) if dp_axis else 1
+    # dp×mp×pp composition: the mp axis is live when the sharding pass
+    # planned per-mp-rank shards (plan.mp_degree > 1); a mesh with an
+    # 'mp' axis but no tp plan just replicates over it
+    mp_axis = "mp" if (plan.mp_degree > 1
+                       and "mp" in mesh.axis_names) else None
+    if plan.mp_degree > 1 and mp_axis is None:
+        raise ValueError(
+            f"pipeline×mp: the sharding plan wants mp="
+            f"{plan.mp_degree} but the mesh has no 'mp' axis "
+            f"({mesh.axis_names})")
+    if mp_axis and int(mesh.shape[mp_axis]) != plan.mp_degree:
+        raise ValueError(
+            f"pipeline×mp: mesh 'mp' axis has "
+            f"{int(mesh.shape[mp_axis])} devices but the sharding plan "
+            f"packed {plan.mp_degree}-way shards")
     S = int(mesh.shape[pp_axis])
     K = int(n_microbatches)
     stage_ops, boundaries = plan.stage_ops, plan.boundaries
@@ -362,6 +715,19 @@ def build_pipeline_fn(program, mesh, feed_names, state_mut, state_const,
     assert state_out and state_out[0] == PACKED_STATE_VAR
     rest_mut = state_mut[1:]
     rest_out = state_out[1:]
+
+    from ..framework import flags as _flags
+    from ..framework.passes import TP_CONSTRAINT_ATTR, decode_anchor
+    from ..monitor import stat_set as _stat_set
+    from ..observe import tracer as otrace
+    from ..ops.collective_matmul import chunked_lower, f_identity, g_psum
+
+    # GPipe's schedule cost, published for the overlap/telemetry plane:
+    # of the K + S - 1 forward (and backward) ticks, S - 1 are fill/
+    # drain bubbles on any given rank
+    _stat_set("pp_stages", S)
+    _stat_set("pp_bubble_fraction_ppm",
+              int(round((S - 1) / float(K + S - 1) * 1e6)))
 
     grad_of = {(p if isinstance(p, str) else p.name):
                (g if isinstance(g, str) else g.name)
@@ -380,6 +746,14 @@ def build_pipeline_fn(program, mesh, feed_names, state_mut, state_const,
                 f"pipeline fetch {f!r} is not produced by any forward "
                 f"stage op; fetchable values are forward activations and "
                 f"the loss")
+    if plan.mp_specs:
+        bad = [f for f in extra_fetches
+               if any(x == "mp" for x in plan.mp_specs.get(f, ()))]
+        if bad:
+            raise NotImplementedError(
+                f"pipeline×mp: fetches {bad} are mp-sharded "
+                f"activations; fetch a value downstream of the "
+                f"row-parallel reduce instead")
 
     # state written inside staged forwards (batch_norm running stats):
     # carried tick-to-tick on the owning stage's rank, published at the end
@@ -395,19 +769,106 @@ def build_pipeline_fn(program, mesh, feed_names, state_mut, state_const,
                     carried_owner[n] = s
     carried_names = sorted(carried_owner)
 
-    def trace_ops(ops, env, rng_key=None):
-        axes = (pp_axis,) + ((dp_axis,) if dp_axis else ())
+    # scan-over-layers inside each stage (trace-level): names any run's
+    # stacked outputs must still materialize into the env for
+    scan_needed = set(carried_names) | set(fetch_names) | {loss_name}
+    for b in boundaries:
+        scan_needed.update(b)
+    for ops_l in ([plan.shared_opt_ops] + list(plan.stage_opt_ops)):
+        for op_ in ops_l:
+            scan_needed.update(op_.input_arg_names())
+    fwd_runs, opt_runs, scan_policy = _plan_stage_scans(
+        program, plan, scan_needed)
+
+    anchored = plan.mp_degree > 1 and mp_axis is not None
+    cm_chunks = int(_flags.flag("collective_matmul_chunks") or 0)
+
+    def _lower_one(ctx, op):
+        """One op through its registered lowering, with the manual
+        Megatron f/g handling at the sharding pass's anchors: a
+        column-parallel matmul's row operand rides f (bwd mp-psum of
+        dx), a contracted (partial) anchor's output rides g (fwd
+        mp-psum) — optionally decomposed into latency-hiding
+        collective-matmul chunks."""
+        env2 = ctx.env
+        try:
+            ents = (op.attr(TP_CONSTRAINT_ATTR, []) or []) \
+                if anchored else []
+            if not ents:
+                get_lowering(op.type)(ctx, op)
+                return
+            anchors = [decode_anchor(e) for e in ents]
+            partials = {n for n, sp, p in anchors if p}
+            cols = [n for n, sp, p in anchors
+                    if not p and any(x == "mp" for x in sp)]
+            wrapped = None
+            if cols and op.type in _TP_MATMUL_TYPES:
+                xn = op.inputs.get("X", [None])[0]
+                if xn is not None and xn in env2 \
+                        and xn not in op.output_arg_names() \
+                        and not any(x == "mp" for x in
+                                    plan.mp_specs.get(xn, ())):
+                    # f is scoped to THIS op: each consumer of a
+                    # replicated activation psums its own cotangent
+                    # branch (psum(a)+psum(b) == psum(a+b))
+                    wrapped = (xn, env2[xn])
+                    env2[xn] = f_identity(env2[xn], mp_axis)
+            try:
+                done = False
+                outs = op.output_arg_names()
+                if partials and cm_chunks > 1 \
+                        and op.type in _TP_MATMUL_TYPES \
+                        and len(outs) == 1 and outs[0] in partials:
+                    done = chunked_lower(
+                        ctx, op, cm_chunks,
+                        lambda v, _i: g_psum(v, mp_axis))
+                if not done:
+                    get_lowering(op.type)(ctx, op)
+                    for n in partials:
+                        if n in env2:
+                            env2[n] = g_psum(env2[n], mp_axis)
+            finally:
+                if wrapped is not None:
+                    env2[wrapped[0]] = wrapped[1]
+        except Exception as e:
+            site = op.callstack[-1] if op.callstack else "<unknown>"
+            raise type(e)(
+                f"while lowering pipeline op {op.type!r} (built at "
+                f"{site}): {e}") from e
+
+    def trace_ops(ops, env, rng_key=None, runs=None, stage=None):
+        axes = (pp_axis,) \
+            + ((mp_axis,) if mp_axis else ()) \
+            + ((dp_axis,) if dp_axis else ())
         ctx = LoweringContext(block, env, rng_key=rng_key, mesh=mesh,
                               axis_env=axes,
                               fold_axes=(dp_axis,) if dp_axis else ())
-        for op in ops:
-            try:
-                get_lowering(op.type)(ctx, op)
-            except Exception as e:
-                site = op.callstack[-1] if op.callstack else "<unknown>"
-                raise type(e)(
-                    f"while lowering pipeline op {op.type!r} (built at "
-                    f"{site}): {e}") from e
+        span = otrace.span("pipeline/stage", stage=stage,
+                           ops=len(ops)) \
+            if stage is not None else otrace.NULL_SPAN
+        with span:
+            if not runs:
+                for op in ops:
+                    _lower_one(ctx, op)
+                return env
+            ops_l = list(ops)
+            run_at = {r["start"]: r for r in runs}
+            i = 0
+            while i < len(ops_l):
+                r = run_at.get(i)
+                if r is not None and all(
+                        m in env for f_ in r["plan"].xs
+                        for m in f_["members"]) \
+                        and all(n in env for n in r["plan"].shared) \
+                        and all(t in env for t, _ in r["plan"].carries):
+                    _emit_stage_scan(ctx, r, _lower_one, scan_policy)
+                    i = r["end"]
+                else:
+                    # an input the plan expected is absent from THIS
+                    # env (e.g. a probe with a reduced view): the run
+                    # traces unrolled — numerics identical either way
+                    _lower_one(ctx, ops_l[i])
+                    i += 1
         return env
 
     def unpack_stage(s, buf):
@@ -416,7 +877,11 @@ def build_pipeline_fn(program, mesh, feed_names, state_mut, state_const,
                 for (n, off, size, shape) in plan.entries[s]}
 
     def traced(feed_vals, mut_vals, const_vals, rng):
-        lbuf = mut_vals[0][0]  # local (1, W) shard -> (W,)
+        # local packed-state shard -> (W,): (1, W) over P('pp'), or
+        # (1, 1, W) over P('pp', 'mp') in the dp×mp×pp composition
+        lbuf = mut_vals[0][0]
+        if mp_axis:
+            lbuf = lbuf[0]
         base_env = {}
         base_env.update(zip(rest_mut, mut_vals[1:]))
         base_env.update(zip(state_const, const_vals))
@@ -450,7 +915,8 @@ def build_pipeline_fn(program, mesh, feed_names, state_mut, state_const,
                 if s > 0:
                     env.update(dict(zip(boundaries[s - 1], acts_in)))
                 trace_ops(stage_ops[s], env,
-                          rng_key=jax.random.PRNGKey(0))
+                          rng_key=jax.random.PRNGKey(0),
+                          runs=fwd_runs[s] if fwd_runs else None)
                 bnd = tuple(jnp.asarray(env[n]) for n in boundaries[s]) \
                     if s < S - 1 else ()
                 fts = tuple(jnp.asarray(env[f]) for f in fetch_by_stage[s])
@@ -546,7 +1012,8 @@ def build_pipeline_fn(program, mesh, feed_names, state_mut, state_const,
                                                   keepdims=False)
             if s > 0:
                 env.update(dict(zip(boundaries[s - 1], unpack(s - 1, act_buf))))
-            trace_ops(stage_ops[s], env, rng_key=stage_key(rng_key, s, mb_idx))
+            trace_ops(stage_ops[s], env, rng_key=stage_key(rng_key, s, mb_idx),
+                      runs=fwd_runs[s] if fwd_runs else None, stage=s)
             new_carried = {
                 n: (env[n] if carried_owner[n] == s else carried[n])
                 for n in carried_names
@@ -676,7 +1143,8 @@ def build_pipeline_fn(program, mesh, feed_names, state_mut, state_const,
                 for p in plan.params_by_stage[s]:
                     _, off, size, shape = plan.layout[p]
                     env[grad_of[p]] = gbuf[off:off + size].reshape(shape)
-                trace_ops(plan.stage_opt_ops[s], env)
+                trace_ops(plan.stage_opt_ops[s], env,
+                          runs=opt_runs[s] if opt_runs else None, stage=s)
                 newb = buf
                 for (n, off, size, shape) in plan.entries[s]:
                     newb = newb.at[off:off + size].set(
@@ -709,22 +1177,24 @@ def build_pipeline_fn(program, mesh, feed_names, state_mut, state_const,
         fetches = tuple(mean_loss if f == loss_name else computed[f]
                         for f in fetch_names)
 
-        new_state = (new_buf[None, :],) \
+        out_buf = new_buf[None, None, :] if mp_axis else new_buf[None, :]
+        new_state = (out_buf,) \
             + tuple(env_shared[n] for n in rest_out)
         new_rng = jax.random.split(rng, 2)[0]
         return fetches, new_state, new_rng
 
     in_feed_specs = tuple(
         (P(dp_axis) if dp_axis else P()) for _ in feed_names)
+    buf_spec = P(pp_axis, mp_axis) if mp_axis else P(pp_axis)
     return shard_map(
         traced,
         mesh=mesh,
         in_specs=(in_feed_specs,
-                  (P(pp_axis),) + tuple(P() for _ in rest_mut),
+                  (buf_spec,) + tuple(P() for _ in rest_mut),
                   tuple(P() for _ in state_const),
                   P()),
         out_specs=(tuple(P() for _ in fetch_names),
-                   (P(pp_axis),) + tuple(P() for _ in rest_out),
+                   (buf_spec,) + tuple(P() for _ in rest_out),
                    P()),
         check_vma=False,
     )

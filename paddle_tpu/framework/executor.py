@@ -1345,9 +1345,19 @@ class Executor:
         from . import flags
         from . import passes as passes_mod
 
-        if getattr(program, "_pipeline", None) is not None:
-            return program  # the pipeline executor owns its own rewrite
-        if not flags.flag("fuse_passes"):
+        pipe_meta = getattr(program, "_pipeline", None)
+        if pipe_meta is not None:
+            # the pipeline executor owns its schedule rewrite, but the
+            # dp×mp×pp composition still needs ShardingPropagationPass:
+            # its plan + partial anchors drive the manual Megatron mp
+            # sharding inside the GPipe shard_map
+            # (distributed/pipeline.py).  The fuse/cast/DCE passes stay
+            # off — the pipeline splits the op stream per stage itself.
+            if not passes_mod.has_tp_marks(program):
+                return program
+            pipeline = passes_mod.PassPipeline(
+                [passes_mod.ShardingPropagationPass()])
+        elif not flags.flag("fuse_passes"):
             # FLAGS_fuse_passes gates the OPTIMIZATION passes only.  Two
             # passes answer to their own switches and still run: a
             # tensor-parallel program needs its sharding plan (the dp
@@ -1385,6 +1395,11 @@ class Executor:
                                      feed_names=tuple(feed), scope=scope,
                                      mesh=mesh)
         out = pipeline.apply(program, ctx)
+        if out is not program and pipe_meta is not None:
+            # clone() is a proto round-trip: the pipeline metadata is a
+            # python attr and must ride onto the rewritten clone or the
+            # compile path would fall through to the non-pipeline branch
+            out._pipeline = pipe_meta
         self._pass_cache[key] = out
         return out
 
@@ -1564,14 +1579,31 @@ class Executor:
             ctx = LoweringContext(block, env, rng_key=rng, mesh=mesh,
                                   axis_env=axis_env, ring_axes=ring_axes,
                                   fold_axes=fold_axes)
+            from . import flags as _flags_mod
             from .lowering import apply_tp_constraints
             from .passes import TP_CONSTRAINT_ATTR
+
+            # latency-hiding collective matmul: row-chunk anchored
+            # row-parallel matmuls so XLA emits one mp reduce per chunk
+            # (ops/collective_matmul.py); 0/1 keeps the plain lowering
+            cm_chunks = int(_flags_mod.flag("collective_matmul_chunks")
+                            or 0) if tp_plan is not None else 0
 
             flags = []
             with otrace.span("executor/lowering", ops=len(op_list)):
                 for op in op_list:
                     try:
-                        if op.type in COLLECTIVE_OPS:
+                        chunked = False
+                        if cm_chunks > 1 and mesh is not None \
+                                and op.has_attr(TP_CONSTRAINT_ATTR):
+                            from ..ops.collective_matmul import (
+                                maybe_chunked_gspmd)
+
+                            chunked = maybe_chunked_gspmd(
+                                ctx, op, mesh, cm_chunks)
+                        if chunked:
+                            pass  # lowering + constraints emitted chunked
+                        elif op.type in COLLECTIVE_OPS:
                             # per-collective span: payload bytes + dtype
                             # read off the traced value (host time ==
                             # trace cost; the args are what the timeline
@@ -1582,7 +1614,7 @@ class Executor:
                                 get_lowering(op.type)(ctx, op)
                         else:
                             get_lowering(op.type)(ctx, op)
-                        if tp_plan is not None \
+                        if not chunked and tp_plan is not None \
                                 and op.has_attr(TP_CONSTRAINT_ATTR):
                             # sharding anchors: pin the propagated spec
                             # so XLA places the mp partial-sum reduce at
@@ -1625,7 +1657,7 @@ class Executor:
                                                 plan_packing)
 
             plan = plan_packing(program, int(mesh.shape["pp"]), state_in,
-                                state_out, pipe)
+                                state_out, pipe, tp_plan=tp_plan)
             owned = plan.owned_names
             ro_owned = sorted(owned & set(state_const))
             if ro_owned:

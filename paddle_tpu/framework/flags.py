@@ -361,6 +361,44 @@ define_flag("decode_kv_quant", False,
             "pool byte budget holds ~2x the pages -> ~2x decode slots; "
             "attention dequantizes pages inline in both the reference "
             "and Pallas paths")
+define_flag("pp_degree", 0,
+            "default pipeline-parallel degree for shapeless mesh "
+            "building: parallel_env.init_parallel_env() called with "
+            "NEITHER mesh_shape NOR axis_names factors the visible "
+            "devices into a (dp, pp) named mesh with this many "
+            "pipeline stages (0 = no pipeline axis; a non-divisor "
+            "device count is rejected loudly).  The stage COUNT a "
+            "program runs with is always the mesh's 'pp' axis size — "
+            "this flag only sizes meshes built without an explicit "
+            "shape, and an explicit axis_names argument wins over it; "
+            "3-axis (dp, mp, pp) meshes are built with an explicit "
+            "mesh_shape")
+define_flag("overlap_grad_allreduce", True,
+            "stretch FuseAllReducePass buckets across the layer-scan "
+            "boundary (framework/passes.py): a bucket holding a stacked "
+            "grad-carrier allreduce (the LayerScanPass pulled-out "
+            "collective carrying num_layers x per-layer bytes) closes "
+            "at its producing backward segment instead of being dragged "
+            "to the last collective of the whole backward — the bulk "
+            "grad payload dispatches as soon as the backward scan "
+            "finishes and overlaps the remaining (unrolled edge-layer) "
+            "backward compute.  Off = one greedy bucket stream anchored "
+            "at its last member (the pre-overlap sequential schedule, "
+            "the bench A/B baseline)",
+            affects_lowering=True)
+define_flag("collective_matmul_chunks", 0,
+            "latency-hiding collective matmul (ops/collective_matmul."
+            "py): decompose each tensor-parallel ROW-PARALLEL matmul + "
+            "mp partial-sum reduce (the ops ShardingPropagationPass "
+            "anchored as contracted) into this many output-row chunks — "
+            "chunk k's reduce overlaps chunk k+1's matmul on hardware "
+            "with async collectives (Wang et al., ASPLOS 2023).  "
+            "Applies to the GSPMD tensor-parallel path AND the manual "
+            "pipeline×mp path; a shape not divisible by the chunk count "
+            "(x its sharded mesh axes) falls back to the unchunked "
+            "lowering, counted collective_matmul_fallback.  0/1 = off; "
+            "pure-jnp semantics, so CPU tier-1 runs stay exact",
+            affects_lowering=True)
 define_flag("decode_spec_k", 0,
             "decode engine: speculative decoding window — a draft "
             "model (DecodeEngine(draft_model=, draft_weights=)) "

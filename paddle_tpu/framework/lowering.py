@@ -50,14 +50,13 @@ def apply_tp_constraints(env, op, mesh):
     import jax
     from jax.sharding import NamedSharding, PartitionSpec
 
-    from .passes import TP_CONSTRAINT_ATTR, decode_spec
+    from .passes import TP_CONSTRAINT_ATTR, decode_anchor
 
     from ..monitor import stat_add
 
     for ent in op.attr(TP_CONSTRAINT_ATTR, []) or []:
-        name, _, enc = ent.partition("\t")
+        name, spec, _partial = decode_anchor(ent)
         v = env.get(name)
-        spec = decode_spec(enc)
         if v is None or getattr(v, "ndim", None) != len(spec):
             # visible on /metrics: a program rewrite that silently
             # dropped an anchor shows up as a skip count, not as an

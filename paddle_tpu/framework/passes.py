@@ -69,6 +69,7 @@ __all__ = [
     "TP_DEGREE_ATTR",
     "TP_SPEC_ATTR",
     "TP_CONSTRAINT_ATTR",
+    "decode_anchor",
     "DP_LOSS_SCALE_ATTR",
     "LAYER_SCAN_ATTR",
     "LAYER_SCAN_POLICY_ATTR",
@@ -147,6 +148,20 @@ def decode_spec(enc: str):
     if not enc:
         return ()
     return tuple(None if tok == "None" else tok for tok in enc.split(","))
+
+
+def decode_anchor(ent: str):
+    """Parse one ``TP_CONSTRAINT_ATTR`` entry -> (var, spec tuple,
+    partial).  Entries are "var\\tspec" (layout anchor) or
+    "var\\tspec\\tP" (PARTIAL-SUM anchor: the op's mp-sharded
+    contraction makes the output a partial sum, so the manual
+    pipeline×mp path must psum it over 'mp' and the GSPMD path may
+    decompose it into latency-hiding collective-matmul chunks)."""
+    parts = str(ent).split("\t")
+    name = parts[0]
+    spec = decode_spec(parts[1]) if len(parts) > 1 else ()
+    partial = len(parts) > 2 and parts[2] == "P"
+    return name, spec, partial
 
 
 # Megatron-LM style defaults over this framework's parameter naming
@@ -680,9 +695,14 @@ class ShardingPropagationPass(Pass):
         known[outs[0]] = spec
         if contracted or any(s == "mp" for s in spec):
             # anchor: pin the output layout so the partial-sum reduce
-            # (or the sharded-activation layout) lands at this op
+            # (or the sharded-activation layout) lands at this op.
+            # Contracted anchors carry a "\tP" partial flag: the manual
+            # pipeline×mp path psums them over 'mp' (Megatron's g
+            # operator) and the chunked collective-matmul lowering
+            # targets exactly these ops
             ents = list(op.attrs.get(TP_CONSTRAINT_ATTR, []) or [])
-            ents.append(f"{outs[0]}\t{encode_spec(spec)}")
+            ents.append(f"{outs[0]}\t{encode_spec(spec)}"
+                        + ("\tP" if contracted else ""))
             op.attrs[TP_CONSTRAINT_ATTR] = ents
 
     @staticmethod
@@ -1734,7 +1754,26 @@ class FuseAllReducePass(Pass):
             e["first_read"] = next(
                 (j for j in readers.get(e["grad"], ())
                  if j > e["anchor"] and j not in skip), len(ops))
-        buckets = self._bucketize(entries)
+        # overlap stretch (FLAGS_overlap_grad_allreduce): chain-adjacency
+        # between consecutive entries — True when ONLY bucket-member ops
+        # (the marked allreduces + their cast pairs) sit between them in
+        # the op stream.  A gap means backward COMPUTE separates the two
+        # collectives: fusing a stacked grad carrier across that gap
+        # would drag the bulk payload's dispatch past the remaining
+        # backward segment, serializing the very comm the scan boundary
+        # lets us hide.
+        member_idx = {i for e in entries for i in e["remove"]}
+        for k in range(len(entries) - 1):
+            lo = max(entries[k]["remove"])
+            hi = min(entries[k + 1]["remove"])
+            entries[k]["adj_next"] = all(
+                j in member_idx for j in range(lo + 1, hi))
+        if entries:
+            entries[-1]["adj_next"] = False
+        from . import flags as _flags
+
+        buckets = self._bucketize(
+            entries, overlap=bool(_flags.flag("overlap_grad_allreduce")))
         fuse_buckets = [b for b in buckets if len(b["items"]) >= 2]
         if not fuse_buckets:
             return False
@@ -1804,6 +1843,7 @@ class FuseAllReducePass(Pass):
             if stack > 1:
                 shape = (stack,) + shape
             entries.append({
+                "stacked": stack > 1,
                 "grad": g,
                 "shape": shape,
                 "dtype": dtype,
@@ -1824,13 +1864,26 @@ class FuseAllReducePass(Pass):
         return entries
 
     @staticmethod
-    def _bucketize(entries) -> List[dict]:
+    def _bucketize(entries, overlap=False) -> List[dict]:
         """Greedy size-capped bucketing in program order, one bucket
         stream per (dtype, ring, fp16) key — mixed-dtype grads never
-        share a fused buffer."""
+        share a fused buffer.
+
+        ``overlap`` (FLAGS_overlap_grad_allreduce): a bucket holding a
+        LayerScanPass-STACKED grad carrier (num_layers x per-layer
+        bytes, produced whole by the backward scan) refuses to admit an
+        UNSTACKED entry that sits past intervening backward compute —
+        the unrolled edge-layer tail.  Fusing across that scan
+        boundary would delay the bulk payload's allreduce until the
+        last edge-layer grad instead of dispatching it under the
+        remaining backward compute.  Everything else keeps the plain
+        greedy stream: unrolled programs (ResNet's 161→4) and
+        stacked-with-stacked fusion are untouched."""
+        from ..monitor import stat_add
+
         buckets: List[dict] = []
         open_buckets: Dict[tuple, dict] = {}
-        for e in entries:
+        for pos, e in enumerate(entries):
             key = (e["dtype"], e["ring_id"], e["fp16"], e["tp_spec"])
             if e["bytes"] > e["cap"]:
                 # an over-cap grad gets its own CLOSED bucket without
@@ -1847,13 +1900,32 @@ class FuseAllReducePass(Pass):
                 # value.  Close at the read barrier instead.
                 open_buckets.pop(key)
                 b = None
+            if b is not None and overlap and b["has_stacked"] \
+                    and not e.get("stacked", False) \
+                    and not all(entries[j].get("adj_next", False)
+                                for j in range(b["last_pos"], pos)):
+                # scan-boundary stretch: the open bucket carries a
+                # stacked grad whose backward segment (the scan)
+                # already finished, and this UNSTACKED edge-layer grad
+                # sits past intervening backward compute — close the
+                # bucket so the carrier's bulk allreduce dispatches now
+                # and overlaps that compute, instead of being dragged
+                # to the tail.  Stacked-with-stacked fusion across
+                # compute keeps the old greedy semantics (their byte
+                # ratio makes the delay symmetric).
+                open_buckets.pop(key)
+                b = None
+                stat_add("pass_overlap_stretched_buckets")
             if b is None or b["bytes"] + e["bytes"] > e["cap"]:
                 b = {"key": key, "items": [], "bytes": 0,
-                     "min_read": float("inf")}
+                     "min_read": float("inf"), "has_stacked": False,
+                     "last_pos": pos}
                 open_buckets[key] = b
                 buckets.append(b)
             b["items"].append(e)
             b["bytes"] += e["bytes"]
+            b["has_stacked"] = b["has_stacked"] or e.get("stacked", False)
+            b["last_pos"] = pos
             b["min_read"] = min(b["min_read"],
                                 e.get("first_read", float("inf")))
         return buckets

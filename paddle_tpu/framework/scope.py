@@ -66,15 +66,22 @@ class PackedParamRef:
     """
 
     __slots__ = ("_scope", "_packed_name", "stage", "offset", "shape",
-                 "dtype")
+                 "dtype", "mp_degree", "mp_dim")
 
-    def __init__(self, scope, packed_name, stage, offset, shape, dtype):
+    def __init__(self, scope, packed_name, stage, offset, shape, dtype,
+                 mp_degree=1, mp_dim=None):
         self._scope = scope
         self._packed_name = packed_name
         self.stage = int(stage)
         self.offset = int(offset)
+        # DECLARED (global) shape: the view always materializes the
+        # true full value, even when the packed buffer holds per-mp-rank
+        # shards (the dp×mp×pp composition, distributed/pipeline.py) —
+        # checkpoints and inspection stay topology-independent
         self.shape = tuple(int(d) for d in shape)
         self.dtype = np.dtype(dtype)
+        self.mp_degree = int(mp_degree)
+        self.mp_dim = mp_dim if mp_dim is None else int(mp_dim)
 
     @property
     def size(self):
@@ -83,16 +90,46 @@ class PackedParamRef:
             n *= d
         return n
 
+    @property
+    def local_shape(self):
+        """Shape of ONE packed entry: the per-mp-rank shard for a
+        tensor-parallel-sharded var, the full shape otherwise."""
+        if self.mp_dim is None:
+            return self.shape
+        ls = list(self.shape)
+        ls[self.mp_dim] //= self.mp_degree
+        return tuple(ls)
+
     def __array__(self, dtype=None, copy=None):
         buf = self._scope.get_var(self._packed_name)
-        row = np.asarray(buf[self.stage])
-        arr = row[self.offset:self.offset + self.size] \
-            .reshape(self.shape).astype(self.dtype)
+        lshape = self.local_shape
+        lsize = 1
+        for d in lshape:
+            lsize *= d
+        if self.mp_degree <= 1:
+            row = np.asarray(buf[self.stage])
+            arr = row[self.offset:self.offset + lsize] \
+                .reshape(lshape).astype(self.dtype)
+        else:
+            rows = np.asarray(buf[self.stage])  # (MP, W)
+            if self.mp_dim is None:
+                # replicated across mp ranks: every row holds the same
+                # bytes (identical local updates keep them in lockstep)
+                arr = rows[0, self.offset:self.offset + lsize] \
+                    .reshape(lshape).astype(self.dtype)
+            else:
+                shards = [rows[r, self.offset:self.offset + lsize]
+                          .reshape(lshape)
+                          for r in range(self.mp_degree)]
+                arr = np.concatenate(shards, axis=self.mp_dim) \
+                    .astype(self.dtype)
         return arr.astype(dtype) if dtype is not None else arr
 
     def __repr__(self):
         return (f"PackedParamRef(stage={self.stage}, shape={self.shape}, "
-                f"dtype={self.dtype})")
+                f"dtype={self.dtype}"
+                + (f", mp={self.mp_degree}@{self.mp_dim}"
+                   if self.mp_degree > 1 else "") + ")")
 
 
 class StackedParamRef:

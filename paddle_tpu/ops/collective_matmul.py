@@ -1,0 +1,235 @@
+"""Latency-hiding collective matmul (FLAGS_collective_matmul_chunks).
+
+"Overlapping Communication with Dependent Computation via
+Decomposition" (Wang et al., ASPLOS 2023) applied to the Megatron
+row-parallel pattern this framework's ShardingPropagationPass anchors:
+a matmul whose contraction dim is mp-sharded produces a PARTIAL sum
+that must be reduced over 'mp'.  Lowered whole, the reduce serializes
+behind the full matmul — wire time fully exposed.  Decomposed into k
+output-row chunks, chunk i's reduce is independent of chunk i+1's
+matmul, so hardware with async collectives (TPU) overlaps them; the
+last chunk's reduce is the only exposed latency.
+
+Two consumers:
+
+- the GSPMD tensor-parallel path (``framework/executor.py``
+  trace_block): each chunk's partial output gets the anchor's
+  ``with_sharding_constraint``, so XLA places one mp reduce PER CHUNK
+  and its latency-hiding scheduler interleaves them with the remaining
+  chunk matmuls;
+- the manual pipeline×mp path (``distributed/pipeline.py``): each
+  chunk is psum'd over 'mp' through the Megatron g operator
+  (:func:`g_psum`) explicitly.
+
+The decomposition re-lowers the ORIGINAL op per chunk (the chunk rides
+the op's own registered lowering with a sliced X), so mul's
+flatten-dims and matmul's transpose handling are never re-implemented
+— and the math per output element is the unchanged contraction, which
+is why the jnp semantics stay exact on CPU tier-1 runs.
+
+Chunking is a pure trace-time rewrite: a shape the chunk count does
+not divide (including the chunked dim's mesh-axis sharding) falls back
+to the unchunked lowering, counted ``collective_matmul_fallback``.
+"""
+from __future__ import annotations
+
+import functools
+
+__all__ = ["f_identity", "g_psum", "chunk_row_axis", "chunked_lower",
+           "maybe_chunked_gspmd"]
+
+
+@functools.lru_cache(maxsize=None)
+def _g_fn(axis):
+    """Megatron's g operator: forward all-reduce over ``axis``, backward
+    identity (the cotangent of the replicated sum IS each shard's
+    cotangent — an explicit vjp, so the manual pipeline×mp backward
+    never depends on jax's psum-transpose conventions)."""
+    import jax
+    from jax import lax
+
+    @jax.custom_vjp
+    def g(x):
+        return lax.psum(x, axis)
+
+    def fwd(x):
+        return lax.psum(x, axis), None
+
+    def bwd(_, ct):
+        return (ct,)
+
+    g.defvjp(fwd, bwd)
+    return g
+
+
+@functools.lru_cache(maxsize=None)
+def _f_fn(axis):
+    """Megatron's f operator: forward identity, backward all-reduce —
+    wrapped around the replicated INPUT of a column-parallel matmul so
+    the input's cotangent (each mp rank contributes only its weight
+    shard's share) is summed to the full gradient."""
+    import jax
+    from jax import lax
+
+    @jax.custom_vjp
+    def f(x):
+        return x
+
+    def fwd(x):
+        return x, None
+
+    def bwd(_, ct):
+        return (lax.psum(ct, axis),)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+def g_psum(x, axis):
+    return _g_fn(axis)(x)
+
+
+def f_identity(x, axis):
+    return _f_fn(axis)(x)
+
+
+def chunk_row_axis(op, x):
+    """The axis of X that carries the op's output rows — the safe
+    chunking dim for the decomposition (chunking rows never touches the
+    contraction, so per-element numerics are unchanged).  None when the
+    op shape/attrs put row chunking out of scope (trans_x, mul with
+    x_num_col_dims != 1, vectors)."""
+    nd = getattr(x, "ndim", 0)
+    if op.type == "mul":
+        # x flattened at x_num_col_dims: only the single-row-dim form
+        # chunks cleanly (xs[:1] survives into the output shape)
+        if int(op.attr("x_num_col_dims", 1) or 1) != 1 or nd < 2:
+            return None
+        return 0
+    if op.type in ("matmul", "matmul_v2"):
+        if bool(op.attr("transpose_X", op.attr("trans_x", False))):
+            return None
+        if op.type == "matmul" and float(op.attr("alpha", 1.0)) != 1.0:
+            # alpha scales the whole product; chunk-exactness holds but
+            # keep the first cut conservative
+            return None
+        if nd < 2:
+            return None
+        return nd - 2
+    return None
+
+
+def chunked_lower(ctx, op, k, per_chunk, mesh=None, chunk_spec=None):
+    """Lower matmul-family ``op`` as ``k`` row chunks: slice X along its
+    row axis, re-run the op's own registered lowering per chunk, apply
+    ``per_chunk(value, index)`` to each chunk's output (the GSPMD
+    sharding constraint, or the manual mp psum), and concatenate.
+
+    Returns True when the chunked lowering was emitted; False when the
+    shape/attrs fall outside the decomposition's scope (the caller then
+    lowers unchunked — counted ``collective_matmul_fallback``).
+    ``chunk_spec`` (the anchor's partition tuple) guards divisibility:
+    the chunked output dim must still divide over its mesh axis."""
+    import jax.numpy as jnp
+
+    from ..framework.lowering import get_lowering as _get_lowering
+    from ..monitor import stat_add
+    from ..observe import tracer as otrace
+
+    k = int(k)
+    if k <= 1:
+        return False
+    xs = op.inputs.get("X", [])
+    outs = op.output_arg_names()
+    if len(xs) != 1 or len(outs) != 1:
+        return False
+    x = ctx.env.get(xs[0])
+    if x is None:
+        return False
+    axis = chunk_row_axis(op, x)
+    if axis is None:
+        return False
+    rows = int(x.shape[axis])
+    if rows % k != 0:
+        stat_add("collective_matmul_fallback")
+        return False
+    # the chunked OUTPUT dim: mul keeps row dim 0; matmul keeps ndim-2.
+    # When the anchor spec shards that dim over a mesh axis, every chunk
+    # must still divide over it or GSPMD degrades the layout per chunk.
+    if chunk_spec and mesh is not None:
+        out_axis = 0 if op.type == "mul" else max(len(chunk_spec) - 2, 0)
+        ax_name = chunk_spec[out_axis] if out_axis < len(chunk_spec) \
+            else None
+        if ax_name is not None and ax_name in mesh.axis_names \
+                and (rows // k) % int(mesh.shape[ax_name]) != 0:
+            stat_add("collective_matmul_fallback")
+            return False
+
+    step = rows // k
+    pieces = []
+    orig_x = x
+    orig_out = ctx.env.get(outs[0])
+    try:
+        for i in range(k):
+            sl = [slice(None)] * x.ndim
+            sl[axis] = slice(i * step, (i + 1) * step)
+            ctx.env[xs[0]] = x[tuple(sl)]
+            with otrace.span("overlap/chunk", i=i, op=op.type):
+                _get_lowering(op.type)(ctx, op)
+                pieces.append(per_chunk(ctx.env[outs[0]], i))
+    finally:
+        ctx.env[xs[0]] = orig_x
+        if orig_out is not None:
+            ctx.env[outs[0]] = orig_out
+        else:
+            ctx.env.pop(outs[0], None)
+    out_axis = 0 if op.type == "mul" else pieces[0].ndim - 2
+    ctx.env[outs[0]] = jnp.concatenate(pieces, axis=out_axis)
+    stat_add("collective_matmul_chunked")
+    return True
+
+
+def maybe_chunked_gspmd(ctx, op, mesh, k):
+    """GSPMD-path driver: chunk a matmul-family op whose SINGLE anchor
+    is a partial-sum (contracted) anchor on its own output, pinning
+    each chunk's partial with the anchor's sharding constraint so XLA
+    emits one mp reduce per chunk.  Returns True when the chunked
+    lowering replaced the normal one (the caller then skips both the
+    plain lowering and ``apply_tp_constraints``)."""
+    from ..framework.passes import TP_CONSTRAINT_ATTR, decode_anchor
+    from ..monitor import stat_add
+
+    ents = op.attr(TP_CONSTRAINT_ATTR, []) or []
+    anchors = [decode_anchor(e) for e in ents]
+    outs = op.output_arg_names()
+    partial = [(n, s) for n, s, p in anchors if p]
+    if len(anchors) != 1 or len(partial) != 1 or len(outs) != 1 \
+            or partial[0][0] != outs[0]:
+        return False  # not a chunk candidate (e.g. a layout anchor)
+    # GSPMD scope guard — checked only for REAL candidates so the
+    # fallback counter means "a chunkable op was not chunked": the
+    # decomposition is only emitted on an mp-ONLY tp mesh.  With a live
+    # dp axis, XLA's SPMD partitioner (probed on this jax/jaxlib)
+    # mis-partitions the sliced-operand + partial-constraint pattern —
+    # the chunk values come back scaled by the mp degree, and interior
+    # pins don't help because the dp layout of DOWNSTREAM consumers
+    # back-propagates into the chunk region.  The dp×mp(×pp)
+    # compositions get their chunked collective matmul through the
+    # pipeline's manual shard_map path instead, where the per-chunk
+    # psum is explicit and exact.
+    if any(a != "mp" and int(mesh.shape[a]) > 1 for a in mesh.axis_names):
+        stat_add("collective_matmul_fallback")
+        return False
+    spec = partial[0][1]
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    sh = NamedSharding(mesh, PartitionSpec(*spec))
+
+    def per_chunk(v, _i):
+        if getattr(v, "ndim", None) != len(spec):
+            return v
+        return jax.lax.with_sharding_constraint(v, sh)
+
+    return chunked_lower(ctx, op, k, per_chunk, mesh=mesh,
+                         chunk_spec=spec)

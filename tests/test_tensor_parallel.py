@@ -475,9 +475,16 @@ class TestMetaOptimizerComposition:
         w = scope.get_var("blk_ffn1.w_0")
         assert tuple(w.sharding.spec) == (None, "mp")
 
-    def test_tp_rejects_pipeline_combo(self, mesh_dp_mp):
+    def test_tp_pipeline_composes_localsgd_still_rejected(self,
+                                                          mesh_dp_mp):
+        """tensor_parallel × pipeline now COMPOSES (the dp×mp×pp mesh;
+        full numerics covered in tests/test_parallel_3d.py) — but a
+        dp×mp mesh without a 'pp' axis is rejected loudly, and the
+        localsgd combo keeps the pinned rejection."""
         from paddle_tpu.distributed import fleet
+        from paddle_tpu.distributed.parallel_env import set_mesh
 
+        set_mesh(mesh_dp_mp)  # has 'mp' but no 'pp'
         main, startup = Program(), Program()
         main.random_seed = 1
         with unique_name.guard(), program_guard(main, startup):
@@ -490,7 +497,27 @@ class TestMetaOptimizerComposition:
             strat.pipeline = True
             fleet.init(is_collective=True, strategy=strat)
             fleet.distributed_optimizer(MomentumOptimizer(0.05, 0.9))
-            with pytest.raises(NotImplementedError, match="pipeline"):
+            with pytest.raises(ValueError, match="'pp'"):
+                fleet.minimize(loss)
+
+    def test_tp_rejects_localsgd_combo(self, mesh_dp_mp):
+        from paddle_tpu.distributed import fleet
+
+        main, startup = Program(), Program()
+        main.random_seed = 1
+        with unique_name.guard(), program_guard(main, startup):
+            x = layers.data("x", [8])
+            y = layers.data("y", [1])
+            pred = layers.fc(x, 1, bias_attr=False)
+            loss = layers.mean(layers.square_error_cost(pred, y))
+            strat = fleet.DistributedStrategy()
+            strat.tensor_parallel = True
+            strat.localsgd = True
+            fleet.init(is_collective=True, strategy=strat)
+            fleet.distributed_optimizer(MomentumOptimizer(0.05, 0.9))
+            with pytest.raises(NotImplementedError,
+                               match="does not compose with "
+                                     "strategy.localsgd"):
                 fleet.minimize(loss)
 
     def test_degree_mismatch_raises(self, mesh_dp_mp):

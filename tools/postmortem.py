@@ -237,7 +237,9 @@ def render(bundle: str, tail: int = 15, stacks: bool = False,
                 "pass_layer_scan", "decode_", "ttft_", "tpot_",
                 "spec_accept_rate", "prefill_chunks", "slo_burn_rate",
                 "slo_budget_remaining", "goodput", "request_trace",
-                "quant_", "pass_weight_quant", "elastic_", "chaos_")
+                "quant_", "pass_weight_quant", "elastic_", "chaos_",
+                "overlap_", "pp_", "pipeline_scan",
+                "collective_matmul", "pass_overlap_stretched")
         for ln in rows:
             if metrics or any(k in ln for k in keys):
                 w(f"  {ln}\n")
