@@ -4,6 +4,7 @@ SURVEY §2.8/2.9: collective functions, fleet facade, parallel env (mesh),
 launcher.  The communication backend is XLA collectives over ICI/DCN —
 see ops/collective.py for the c_* lowerings.
 """
+from . import embedding  # noqa: F401
 from . import fleet  # noqa: F401
 from .collective import (  # noqa: F401
     ReduceOp,

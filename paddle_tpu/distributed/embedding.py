@@ -1,0 +1,129 @@
+"""paddle_tpu.distributed.embedding — sharded embedding tables.
+
+The TPU-native replacement for the reference's parameter-server
+distributed-embedding stack (fleet.distributed_embedding over gRPC PS
+workers, SelectedRows sparse gradients): large tables live ROW-SHARDED
+over the mesh's 'mp' axis and lookups route ids to their owning shards
+with one all-to-all (ops/embedding_ops.py is the engine; the
+ShardingPropagationPass stamps lookups whose table it row-sharded).
+
+Three entry levels:
+
+- :func:`distributed_embedding` — static-graph builder (the
+  ``fleet.distributed_embedding`` facade): a ``lookup_table_v2`` op
+  with ``is_sparse=True``, which the sharding pass row-shards over
+  'mp' by default (no partition rule needed).  Identical to
+  ``layers.embedding(is_sparse=True)``.
+- :func:`lookup` — eager/host helper over a concrete table (dense
+  custom_vjp reference), recording the ``emb_lookup_seconds``
+  histogram and the ``emb_oov_ids`` gauge.
+- :func:`sharded_lookup` — the raw per-shard engine for code already
+  inside shard_map (re-export of
+  :func:`~paddle_tpu.ops.embedding_ops.sharded_embedding_lookup`).
+
+:func:`partition_rules` builds explicit row-sharding rules for tables
+NOT flagged sparse; :func:`shard_info` reports the physical layout of
+a planned table (rows per shard, per-chip bytes — what the README's
+"table exceeds one chip" sizing math reads).
+"""
+from __future__ import annotations
+
+import re
+import time
+
+from ..ops.embedding_ops import (alltoall_bytes_per_lookup,
+                                 embedding_lookup_ref,
+                                 sharded_embedding_lookup as sharded_lookup)
+
+__all__ = [
+    "distributed_embedding",
+    "lookup",
+    "sharded_lookup",
+    "partition_rules",
+    "shard_info",
+    "alltoall_bytes_per_lookup",
+]
+
+
+def distributed_embedding(input, size, param_attr=None, padding_idx=None,
+                          dtype="float32", name=None):
+    """Static-graph sharded embedding: rows of the ``size[0] ×
+    size[1]`` table live distributed over the mesh's 'mp' axis (the
+    pass seeds P('mp', None) for is_sparse tables), and the gradient
+    is a dense scatter-add on the owning shard.  Outside a tensor-
+    parallel fleet program the table degrades to dense replicated —
+    loudly (``emb_sparse_fallback_dense``)."""
+    from ..layers import embedding as _layers_embedding
+
+    return _layers_embedding(
+        input, size, is_sparse=True, padding_idx=padding_idx,
+        param_attr=param_attr, dtype=dtype, name=name)
+
+
+def lookup(table, ids, padding_idx=None):
+    """Eager lookup over a concrete (host/global) table with the
+    engine's exact gradient semantics (custom_vjp dense scatter-add,
+    padding row pinned zero).  Telemetry: ``emb_lookup_seconds``
+    histogram + ``emb_oov_ids`` gauge (ids outside ``[0, vocab)``,
+    which the engine maps to zero rows)."""
+    import numpy as np
+
+    from ..monitor import stat_add, stat_time
+
+    t0 = time.perf_counter()
+    pad = -1 if padding_idx is None else int(padding_idx)
+    out = embedding_lookup_ref(table, ids, pad)
+    try:
+        idh = np.asarray(ids)
+        vocab = int(table.shape[0])
+        oov = int(((idh < 0) | (idh >= vocab)).sum())
+        if oov:
+            stat_add("emb_oov_ids", oov)
+    except Exception:  # noqa: BLE001 — telemetry only
+        pass
+    stat_time("emb_lookup_seconds", time.perf_counter() - t0)
+    return out
+
+
+def partition_rules(*table_names):
+    """Explicit row-sharding rules for named tables — merge into
+    ``DistributedStrategy.tensor_parallel_configs['partition_rules']``
+    when a table is built without ``is_sparse`` (the flag already
+    seeds the layout by itself)."""
+    return [(rf"^{re.escape(str(n))}$", "mp,None") for n in table_names]
+
+
+def shard_info(program, table_name, mesh=None):
+    """Physical layout of a planned table: where its rows live and
+    what one chip holds.  Requires the post-pass program (the plan is
+    ``program._tp_plan``); ``mesh`` defaults to the active parallel
+    env's."""
+    import numpy as np
+
+    from ..framework import dtypes as _dtypes
+    from .parallel_env import get_mesh
+
+    plan = getattr(program, "_tp_plan", None)
+    if plan is None:
+        raise ValueError(
+            "program has no sharding plan (_tp_plan); run it through a "
+            "tensor-parallel fleet executor first")
+    mesh = mesh if mesh is not None else get_mesh()
+    var = program.global_block._find_var_recursive(table_name)
+    if var is None:
+        raise KeyError(f"no var {table_name!r} in program")
+    spec = plan.spec_tuple(table_name)
+    divisor = plan.shard_divisor(table_name, mesh)
+    vocab = int(var.shape[0])
+    itemsize = np.dtype(_dtypes.to_str(var.dtype)).itemsize
+    global_bytes = int(np.prod([int(s) for s in var.shape])) * itemsize
+    row_sharded = bool(spec) and spec[0] == "mp"
+    return {
+        "table": table_name,
+        "spec": spec,
+        "row_sharded": row_sharded,
+        "shard_divisor": divisor,
+        "rows_per_shard": vocab // divisor if row_sharded else vocab,
+        "global_bytes": global_bytes,
+        "bytes_per_chip": global_bytes // divisor,
+    }

@@ -113,6 +113,14 @@ def __getattr__(name):
         mod = importlib.import_module(".elastic", __name__)
         globals()[name] = mod
         return mod
+    if name == "distributed_embedding":
+        # the sharded-embedding builder (replaces the reference's
+        # parameter-server fleet.distributed_embedding); lazy for the
+        # same reason as elastic
+        from ..embedding import distributed_embedding as _de
+
+        globals()[name] = _de
+        return _de
     raise AttributeError(
         f"module {__name__!r} has no attribute {name!r}")
 
@@ -136,7 +144,7 @@ minimize = _fleet_singleton.minimize
 
 __all__ = [
     "DistributedStrategy", "Fleet", "PaddleCloudRoleMaker",
-    "UserDefinedRoleMaker", "elastic", "init", "is_first_worker",
-    "worker_index", "worker_num", "is_worker", "barrier_worker",
-    "distributed_optimizer", "minimize",
+    "UserDefinedRoleMaker", "distributed_embedding", "elastic", "init",
+    "is_first_worker", "worker_index", "worker_num", "is_worker",
+    "barrier_worker", "distributed_optimizer", "minimize",
 ]

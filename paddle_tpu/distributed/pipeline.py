@@ -102,7 +102,8 @@ def _validate_mp_flow(block, stage_ops, tp_plan):
     mp-sharded value would compute a silently-wrong local result —
     refuse loudly instead.  Returns the final name -> mp-spec map (the
     fetch/boundary checks read it)."""
-    from ..framework.passes import TP_CONSTRAINT_ATTR, decode_anchor
+    from ..framework.passes import (EMB_SHARD_ATTR, TP_CONSTRAINT_ATTR,
+                                    decode_anchor)
 
     known: Dict[str, tuple] = {
         n: _mp_only(s) for n, s in tp_plan.specs.items()
@@ -169,6 +170,38 @@ def _validate_mp_flow(block, stage_ops, tp_plan):
                         known[n] = xsp
                     else:
                         known.pop(n, None)
+                continue
+            if op.type in ("lookup_table", "lookup_table_v2"):
+                # row-sharded table: the all-to-all engine
+                # (ops/embedding_ops.py) returns a value replicated on
+                # mp — but ONLY when the sharding pass stamped the op;
+                # an mp-sharded table reaching an unstamped lookup
+                # would gather from a local shard as if it were global
+                wname = op.inputs.get("W", [None])[0]
+                if wname and has_mp(wname):
+                    wspec = known.get(wname, ())
+                    if not int(op.attr(EMB_SHARD_ATTR, 0) or 0):
+                        raise NotImplementedError(
+                            f"pipeline×mp: {op.type!r} in stage {si} "
+                            f"reads mp-sharded table {wname!r} but the "
+                            f"sharding pass did not classify it for "
+                            f"the embedding engine (row-shard it: "
+                            f"P('mp', None), or drop its rule)")
+                    if wspec and (wspec[0] != "mp"
+                                  or any(x == "mp" for x in wspec[1:])):
+                        raise NotImplementedError(
+                            f"pipeline×mp: embedding table {wname!r} "
+                            f"in stage {si} must be ROW-sharded "
+                            f"(P('mp', None)); got {wspec}")
+                bad_ids = sorted(n for n in op.inputs.get("Ids", [])
+                                 if has_mp(n))
+                if bad_ids:
+                    raise NotImplementedError(
+                        f"pipeline×mp: embedding ids {bad_ids} in "
+                        f"stage {si} are mp-sharded; the engine needs "
+                        f"replicated ids")
+                for n in op.output_arg_names():
+                    known.pop(n, None)  # engine output: replicated
                 continue
             if op.type in _MP_PRESERVING:
                 xs = op.inputs.get("X", [])

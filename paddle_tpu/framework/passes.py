@@ -69,6 +69,7 @@ __all__ = [
     "TP_DEGREE_ATTR",
     "TP_SPEC_ATTR",
     "TP_CONSTRAINT_ATTR",
+    "EMB_SHARD_ATTR",
     "decode_anchor",
     "DP_LOSS_SCALE_ATTR",
     "LAYER_SCAN_ATTR",
@@ -110,6 +111,12 @@ TP_RULES_ATTR = "__tp_rules__"          # list of "regex\tspec" strings
 TP_DEGREE_ATTR = "__tp_degree__"        # required mp degree (0 = any)
 TP_SPEC_ATTR = "__tp_spec__"            # on grad collectives: grad's spec
 TP_CONSTRAINT_ATTR = "__tp_constraint__"  # list of "var\tspec" anchors
+# stamped on lookup_table(_v2) ops whose table the pass row-sharded
+# over 'mp' (value = the mp degree): the embedding lowering
+# (ops/embedding_ops.py) routes these through the sharded engine —
+# explicit all-to-all on the manual pipeline×mp path, custom_vjp dense
+# reference + layout anchor under GSPMD
+EMB_SHARD_ATTR = "__emb_row_sharded__"
 # stamped by GradAllReduce/ShardingMetaOptimizer on the 1/nranks
 # loss-grad scale op so the tensor-parallel meta-optimizer can remove it
 # (GSPMD computes global-batch-mean gradients directly; keeping the
@@ -469,6 +476,29 @@ class ShardingPropagationPass(Pass):
             specs[name] = spec
             n_sharded += 1
 
+        # -- 1b. sparse embedding tables default to row-sharding ------
+        # an is_sparse lookup is an explicit request for the
+        # distributed engine (fleet.distributed_embedding /
+        # nn.Embedding(sparse=True)): its table row-shards over 'mp'
+        # even without a matching partition rule; indivisible vocabs
+        # fall back to replicated like any rule match
+        for op in ops:
+            if op.type not in ("lookup_table", "lookup_table_v2") \
+                    or not bool(op.attr("is_sparse", False)):
+                continue
+            wname = op.inputs.get("W", [None])[0]
+            if not wname or wname in specs:
+                continue
+            var = block._find_var_recursive(wname)
+            if var is None or len(var.shape) < 2:
+                continue
+            spec = ("mp",) + (None,) * (len(var.shape) - 1)
+            if not self._divisible(var.shape, spec, mp_degree):
+                n_fallback += 1
+                continue
+            specs[wname] = spec
+            n_sharded += 1
+
         # -- 2. optimizer slots inherit their param's spec -------------
         self._inherit_slots(block, ops, specs, has_dp="dp" in axes)
 
@@ -603,6 +633,8 @@ class ShardingPropagationPass(Pass):
                         known[n] = spec
                     else:
                         known.pop(n, None)
+            elif op.type in ("lookup_table", "lookup_table_v2"):
+                self._prop_lookup(op, known, mp_degree)
             elif op.type == "c_allreduce_sum":
                 # transpiler grad collective: identity under GSPMD (the
                 # grad is already the global sum); stamp the grad's spec
@@ -623,6 +655,18 @@ class ShardingPropagationPass(Pass):
                     grad_reduce[g] = {"axes": ("dp",), "bytes": nbytes}
                 continue
             elif op.type.endswith("_grad"):
+                if op.type in ("lookup_table_grad", "lookup_table_v2_grad"):
+                    # mirror the forward's engine stamp: the generic-vjp
+                    # lowering re-emits the forward from the GRAD op's
+                    # own attrs (copied at backward-build time, before
+                    # this pass ran), so without the stamp the manual
+                    # pipeline×mp re-emission would gather from a local
+                    # shard as if it were the global table
+                    wname = op.inputs.get("W", [None])[0]
+                    wspec = known.get(wname) if wname else None
+                    if wspec and wspec[0] == "mp" \
+                            and not any(s == "mp" for s in wspec[1:]):
+                        op.attrs[EMB_SHARD_ATTR] = int(mp_degree)
                 # the gradient of a var shares its var's layout (the
                 # Megatron memo: dW of a column-parallel W is itself
                 # column-parallel); unknown bases reset to unknown
@@ -704,6 +748,41 @@ class ShardingPropagationPass(Pass):
             ents.append(f"{outs[0]}\t{encode_spec(spec)}"
                         + ("\tP" if contracted else ""))
             op.attrs[TP_CONSTRAINT_ATTR] = ents
+
+    @staticmethod
+    def _prop_lookup(op, known, mp_degree):
+        """Embedding lookup over a row-sharded table (W P('mp', None)):
+        the engine returns a value replicated on 'mp' whose leading
+        dims follow the ids' layout — stamp that as a layout anchor
+        (under GSPMD the constraint is where XLA places the lookup's
+        gather comm) and stamp ``EMB_SHARD_ATTR`` = the degree so the
+        lowering dispatches to the sharded engine.  A table sharded any
+        other way is outside engine scope: output unknown."""
+        ws = op.inputs.get("W", [])
+        outs = op.output_arg_names()
+        if len(ws) != 1 or len(outs) != 1:
+            return
+        wspec = known.get(ws[0])
+        var = op.block._find_var_recursive(outs[0])
+        if wspec is None or not any(s == "mp" for s in wspec) \
+                or var is None or not var.shape:
+            known.pop(outs[0], None)
+            return
+        if wspec[0] != "mp" or any(s == "mp" for s in wspec[1:]):
+            known.pop(outs[0], None)
+            return
+        rank = len(var.shape)
+        ids = op.inputs.get("Ids", [None])[0]
+        head = tuple(known.get(ids, ()))[:rank - 1]
+        head = head + (None,) * (rank - 1 - len(head))
+        # the engine needs ids replicated on mp; an mp entry in the ids
+        # spec degrades that dim to replicated (GSPMD regathers)
+        spec = tuple(None if s == "mp" else s for s in head) + (None,)
+        known[outs[0]] = spec
+        ents = list(op.attrs.get(TP_CONSTRAINT_ATTR, []) or [])
+        ents.append(f"{outs[0]}\t{encode_spec(spec)}")
+        op.attrs[TP_CONSTRAINT_ATTR] = ents
+        op.attrs[EMB_SHARD_ATTR] = int(mp_degree)
 
     @staticmethod
     def _prop_transpose(op, known):

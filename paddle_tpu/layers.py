@@ -390,7 +390,8 @@ def embedding(
         "lookup_table_v2",
         {"W": w, "Ids": input},
         {"Out": out},
-        {"padding_idx": -1 if padding_idx is None else padding_idx},
+        {"padding_idx": -1 if padding_idx is None else padding_idx,
+         "is_sparse": bool(is_sparse)},
     )
     return out
 

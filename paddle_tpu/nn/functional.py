@@ -280,8 +280,14 @@ def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=No
 
 
 def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    # sparse=True requests the distributed row-sharded engine: the
+    # is_sparse attr is what ShardingPropagationPass keys the table's
+    # P('mp', None) seeding on (historically the flag was silently
+    # dropped; without an active sharding plan the lowering now counts
+    # emb_sparse_fallback_dense and warns)
     return op_call("lookup_table_v2", {"Ids": x, "W": weight},
-                   {"padding_idx": -1 if padding_idx is None else int(padding_idx)},
+                   {"padding_idx": -1 if padding_idx is None else int(padding_idx),
+                    "is_sparse": bool(sparse)},
                    name=name)
 
 

@@ -63,11 +63,15 @@ class Dropout2D(Layer):
 
 class Embedding(Layer):
     def __init__(self, num_embeddings, embedding_dim, padding_idx=None,
-                 sparse=False, weight_attr=None, name=None):
+                 sparse=False, weight_attr=None, name=None,
+                 is_sparse=None):
         super().__init__()
         from ...initializer import NormalInitializer
 
         self.padding_idx = padding_idx
+        # 2.x spells it `sparse`, the 1.x dygraph layer `is_sparse`;
+        # accept both (explicit is_sparse wins)
+        self.sparse = bool(sparse if is_sparse is None else is_sparse)
         self.weight = self.create_parameter(
             [num_embeddings, embedding_dim], attr=weight_attr,
             default_initializer=NormalInitializer(0.0, 1.0))
@@ -78,7 +82,8 @@ class Embedding(Layer):
             self.weight._set_raw(w.at[padding_idx].set(0.0))
 
     def forward(self, x):
-        return F.embedding(x, self.weight, padding_idx=self.padding_idx)
+        return F.embedding(x, self.weight, padding_idx=self.padding_idx,
+                           sparse=self.sparse)
 
 
 class Flatten(Layer):

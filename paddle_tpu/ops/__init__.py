@@ -11,6 +11,7 @@ from . import (  # noqa: F401
     creation,
     deformable_ops,
     detection_ops,
+    embedding_ops,
     fused,
     grad_generic,
     interp_ops,

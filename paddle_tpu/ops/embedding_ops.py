@@ -1,0 +1,321 @@
+"""Embedding lookups: lookup_table(_v2) lowering + the sharded engine.
+
+Role parity: lookup_table_v2_op.cc plus the reference's whole sparse
+remote-lookup stack (SelectedRows gradients, the gRPC/bRPC parameter
+server, distributed/ps/*).  TPU-native replacement: a large table lives
+ROW-SHARDED over the mesh's 'mp' axis — rows ``[r*V/mp, (r+1)*V/mp)``
+on mp rank ``r`` — and a lookup is one all-to-all of ids to their
+owning shards, a local gather, and one all-to-all of the rows back.
+No parameter-server process exists; the "server" is the shard itself.
+
+Four lowering paths, dispatched per op at trace time:
+
+1. **manual pipeline×mp** (op stamped ``EMB_SHARD_ATTR`` and 'mp' in
+   ``ctx.axis_env``): the trace runs per-device inside shard_map and
+   the env holds the LOCAL row shard — :func:`sharded_embedding_lookup`
+   runs the explicit all-to-all engine.  Its backward is a
+   ``custom_vjp`` (the PR-15 f/g idiom): a dense scatter-add of the
+   routed cotangent rows onto the owning shard, so ``jax.vjp`` of the
+   staged forward (ops/grad_generic.py) yields exact shard gradients.
+2. **GSPMD** (stamped, mesh set, empty axis_env): the traced value is
+   the global table; :func:`embedding_lookup_ref` keeps the same
+   custom_vjp gather/scatter-add semantics on the global value and the
+   pass-stamped layout anchor (``TP_CONSTRAINT_ATTR``) pins the output
+   replicated-on-mp so XLA's SPMD partitioner places the gather comm.
+3. **sparse fallback** (``is_sparse`` requested but no sharding plan
+   stamped the op): counted ``emb_sparse_fallback_dense`` + warned
+   once — the flag silently degrading to dense was a bug.
+4. **plain dense** (everything else): ``jnp.take`` + padding mask,
+   byte-identical to the historical lowering (BERT word embeddings
+   etc. ride this path unchanged).
+
+``padding_idx`` contract on every path: the padding row's output is
+zero AND its gradient is exactly zero (pinned inside the custom_vjp
+backward, masked on the dense path).
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from ..framework.lowering import register_lower
+
+__all__ = [
+    "embedding_lookup_ref",
+    "sharded_embedding_lookup",
+    "alltoall_bytes_per_lookup",
+]
+
+
+# ---------------------------------------------------------------------------
+# dense reference: custom_vjp gather with an explicit scatter-add backward
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=None)
+def _dense_ref_fn(padding_idx: int):
+    """Dense lookup with the engine's gradient semantics made explicit:
+    forward ``take`` (+ padding mask), backward a dense scatter-add
+    ``zeros_like(W).at[ids].add(ct)`` with the padding row pinned zero
+    and out-of-range ids dropped.  Cached per static padding_idx so the
+    custom_vjp identity is stable across traces (lru idiom of
+    ops/collective_matmul.py)."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.custom_vjp
+    def lookup(w, ids):
+        return _fwd(w, ids)[0]
+
+    def _fwd(w, ids):
+        # engine contract (same as the all-to-all path): out-of-vocab
+        # ids yield zero rows, never wraparound/NaN-fill
+        keep = (ids >= 0) & (ids < w.shape[0])
+        if padding_idx >= 0:
+            keep = keep & (ids != padding_idx)
+        out = jnp.take(w, jnp.where(keep, ids, 0), axis=0)
+        out = out * keep[..., None].astype(out.dtype)
+        return out, (ids, w.shape)
+
+    def _bwd(res, ct):
+        ids, wshape = res
+        flat = ids.reshape(-1)
+        ctf = ct.reshape(-1, wshape[-1])
+        keep = (flat >= 0) & (flat < wshape[0])
+        if padding_idx >= 0:
+            keep = keep & (flat != padding_idx)
+        ctf = ctf * keep[:, None].astype(ct.dtype)
+        idx = jnp.where(keep, flat, wshape[0])  # OOB -> dropped
+        g = jnp.zeros(wshape, ct.dtype).at[idx].add(ctf, mode="drop")
+        if padding_idx >= 0:
+            g = g.at[padding_idx].set(0.0)
+        return g, np.zeros(ids.shape, jax.dtypes.float0)
+
+    lookup.defvjp(_fwd, _bwd)
+    return lookup
+
+
+def embedding_lookup_ref(w, ids, padding_idx=-1):
+    """Pure-jnp dense reference (the CPU/tier-1 default for the engine
+    paths): exact gather/scatter-add semantics as a ``custom_vjp``."""
+    return _dense_ref_fn(int(padding_idx))(w, ids)
+
+
+# ---------------------------------------------------------------------------
+# sharded engine: all-to-all id routing + local gather, per-shard trace
+# ---------------------------------------------------------------------------
+
+
+def _route(ids_slice, degree, rows_per_shard):
+    """Static routing plan for one rank's id slice: stable-sort by
+    owning shard, bucket offsets via searchsorted, and the (degree,
+    cap) send buffer of ids (-1 = empty slot).  Invalid ids (out of
+    [0, degree*rows_per_shard)) sort into a virtual bucket ``degree``
+    whose writes fall off the buffer (``mode='drop'``)."""
+    import jax.numpy as jnp
+
+    cap = ids_slice.shape[0]
+    vocab = degree * rows_per_shard
+    owner = ids_slice // rows_per_shard
+    valid = (ids_slice >= 0) & (ids_slice < vocab)
+    owner = jnp.where(valid, owner, degree)
+    order = jnp.argsort(owner, stable=True)
+    s_ids = ids_slice[order]
+    s_owner = owner[order]
+    start = jnp.searchsorted(s_owner, jnp.arange(degree + 1))
+    pos = jnp.arange(cap) - start[jnp.clip(s_owner, 0, degree)]
+    ok = s_owner < degree
+    send = jnp.full((degree, cap), -1, ids_slice.dtype)
+    send = send.at[s_owner, pos].set(s_ids, mode="drop")
+    return order, s_owner, pos, ok, send
+
+
+@functools.lru_cache(maxsize=None)
+def _sharded_inner_fn(axis_name: str, degree: int, rows_per_shard: int):
+    """The engine core as a ``custom_vjp`` over (local_rows, padded
+    ids).  The vjp boundary is the PER-RANK output slice — the final
+    all_gather (and the padding mask) stay OUTSIDE so jax transposes
+    them natively (all_gather^T = reduce-scatter), and the backward
+    receives each rank's exact cotangent slice with no dependence on
+    shard_map's replicated-output transpose convention.
+
+    forward: slice my cap ids -> all-to-all ids to owners -> local
+    gather on the row shard -> all-to-all rows back -> unsort.
+    backward: re-route (same plan), all-to-all the cotangent rows to
+    the owners, dense scatter-add onto the local shard; ids get a
+    float0 cotangent."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    @jax.custom_vjp
+    def inner(local_rows, ids_p):
+        return _fwd(local_rows, ids_p)[0]
+
+    def _my_slice(ids_p):
+        cap = ids_p.shape[0] // degree
+        r = lax.axis_index(axis_name)
+        return lax.dynamic_slice_in_dim(ids_p, r * cap, cap), cap, r
+
+    def _fwd(local_rows, ids_p):
+        my, cap, r = _my_slice(ids_p)
+        order, s_owner, pos, ok, send = _route(my, degree, rows_per_shard)
+        recv = lax.all_to_all(send, axis_name, split_axis=0, concat_axis=0)
+        lid = recv - r * rows_per_shard
+        rvalid = (recv >= 0) & (lid >= 0) & (lid < rows_per_shard)
+        rows = jnp.where(
+            rvalid[..., None],
+            jnp.take(local_rows, jnp.clip(lid, 0, rows_per_shard - 1),
+                     axis=0), 0.0)
+        back = lax.all_to_all(rows, axis_name, split_axis=0, concat_axis=0)
+        gathered = back[jnp.clip(s_owner, 0, degree - 1), pos]
+        gathered = jnp.where(ok[..., None], gathered, 0.0)
+        out = jnp.zeros((cap, local_rows.shape[1]),
+                        local_rows.dtype).at[order].set(gathered)
+        return out, (ids_p, local_rows.shape)
+
+    def _bwd(res, ct_slice):
+        ids_p, lshape = res
+        my, cap, r = _my_slice(ids_p)
+        order, s_owner, pos, ok, send = _route(my, degree, rows_per_shard)
+        ct_send = jnp.zeros((degree, cap, ct_slice.shape[1]),
+                            ct_slice.dtype).at[s_owner, pos].set(
+                                ct_slice[order], mode="drop")
+        ct_recv = lax.all_to_all(ct_send, axis_name,
+                                 split_axis=0, concat_axis=0)
+        id_recv = lax.all_to_all(send, axis_name,
+                                 split_axis=0, concat_axis=0)
+        lid = id_recv - r * rows_per_shard  # negative/OOB -> dropped
+        g = jnp.zeros(lshape, ct_slice.dtype).at[lid.reshape(-1)].add(
+            ct_recv.reshape(-1, ct_slice.shape[1]), mode="drop")
+        return g, np.zeros(ids_p.shape, jax.dtypes.float0)
+
+    inner.defvjp(_fwd, _bwd)
+    return inner
+
+
+def alltoall_bytes_per_lookup(n_ids, degree, emb_dim, ids_itemsize=8,
+                              row_itemsize=4):
+    """Static per-rank all-to-all payload of one sharded lookup (the
+    ``emb_alltoall_bytes`` accounting): the id routing buffer out plus
+    the gathered rows back."""
+    cap = -(-int(n_ids) // int(degree))
+    return int(degree) * cap * (int(ids_itemsize)
+                                + int(emb_dim) * int(row_itemsize))
+
+
+def sharded_embedding_lookup(local_rows, ids, axis_name="mp", degree=None,
+                             padding_idx=-1):
+    """All-to-all embedding lookup over a row-sharded table; call
+    inside shard_map (the manual pipeline×mp trace, or directly — see
+    distributed/embedding.py).  ``local_rows`` is THIS rank's
+    ``(vocab/degree, dim)`` shard; ``ids`` is replicated on
+    ``axis_name`` and may have any shape.  Returns the full
+    ``ids.shape + (dim,)`` lookup, replicated on ``axis_name``.
+    Out-of-vocab ids yield zero rows (and zero gradient)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    if degree is None:
+        raise ValueError("sharded_embedding_lookup requires the static "
+                         "shard degree (mesh axis size)")
+    degree = int(degree)
+    rows_per_shard = int(local_rows.shape[0])
+    flat = ids.reshape(-1)
+    n = int(flat.shape[0])
+    npad = -(-n // degree) * degree
+    if npad != n:
+        flat = jnp.concatenate(
+            [flat, jnp.full((npad - n,), -1, flat.dtype)])
+    inner = _sharded_inner_fn(axis_name, degree, rows_per_shard)
+    out_slice = inner(local_rows, flat)
+    full = lax.all_gather(out_slice, axis_name, tiled=True)[:n]
+    if padding_idx is not None and int(padding_idx) >= 0:
+        full = full * (flat[:n] != int(padding_idx))[:, None].astype(
+            full.dtype)
+    return full.reshape(tuple(ids.shape) + (local_rows.shape[1],))
+
+
+# ---------------------------------------------------------------------------
+# lowering
+# ---------------------------------------------------------------------------
+
+_warned_sparse_fallback = False
+
+
+def _warn_sparse_fallback(op):
+    """is_sparse=True with no active sharding plan: the historical code
+    silently ignored the flag; degrade loudly instead (once per
+    process; the counter covers every occurrence)."""
+    global _warned_sparse_fallback
+    from ..monitor import stat_add
+
+    stat_add("emb_sparse_fallback_dense")
+    if not _warned_sparse_fallback:
+        _warned_sparse_fallback = True
+        import warnings
+
+        site = op.callstack[-1] if getattr(op, "callstack", None) else "?"
+        warnings.warn(
+            "embedding(is_sparse=True) has no active sharding plan — "
+            "falling back to a dense replicated table (counted "
+            "emb_sparse_fallback_dense).  For the distributed engine, "
+            "train under fleet with a mesh that has an 'mp' axis "
+            f"(fleet.distributed_embedding; op built at {site})",
+            stacklevel=2)
+
+
+@register_lower("lookup_table", "lookup_table_v2")
+def _lookup_table(ctx, op):
+    import jax.numpy as jnp
+
+    from ..monitor import stat_add, stat_set
+    from ..observe import tracer as otrace
+
+    w = ctx.in1(op, "W")
+    ids = ctx.in1(op, "Ids")
+    padding_idx = int(op.attr("padding_idx", -1))
+    if op.type == "lookup_table" and ids.ndim >= 2 and ids.shape[-1] == 1:
+        ids = jnp.squeeze(ids, -1)
+
+    from ..framework.passes import EMB_SHARD_ATTR
+
+    degree = int(op.attr(EMB_SHARD_ATTR, 0) or 0)
+    if degree > 1 and "mp" in ctx.axis_env:
+        # manual pipeline×mp: w IS the local row shard; explicit engine
+        with otrace.span("embedding/lookup", path="alltoall",
+                         degree=degree, n_ids=int(np.prod(ids.shape))):
+            out = sharded_embedding_lookup(
+                w, ids, axis_name="mp", degree=degree,
+                padding_idx=padding_idx)
+        stat_set("emb_rows_per_shard", int(w.shape[0]))
+        stat_add("emb_alltoall_bytes", alltoall_bytes_per_lookup(
+            int(np.prod(ids.shape)), degree, int(w.shape[1]),
+            ids_itemsize=int(jnp.dtype(ids.dtype).itemsize)))
+        ctx.set_out(op, "Out", out)
+        return
+    if degree > 1:
+        # GSPMD: w is the global table (NamedSharding P('mp', None)
+        # from the plan); keep the engine's custom_vjp semantics on the
+        # global value — the stamped anchor pins the output layout and
+        # XLA places the gather/scatter comm at this op
+        with otrace.span("embedding/lookup", path="gspmd",
+                         degree=degree, n_ids=int(np.prod(ids.shape))):
+            out = embedding_lookup_ref(w, ids, padding_idx)
+        stat_set("emb_rows_per_shard", int(w.shape[0]) // degree)
+        stat_add("emb_alltoall_bytes", alltoall_bytes_per_lookup(
+            int(np.prod(ids.shape)), degree, int(w.shape[1]),
+            ids_itemsize=int(jnp.dtype(ids.dtype).itemsize)))
+        ctx.set_out(op, "Out", out)
+        return
+    if bool(op.attr("is_sparse", False)):
+        _warn_sparse_fallback(op)
+        ctx.set_out(op, "Out", embedding_lookup_ref(w, ids, padding_idx))
+        return
+    # plain dense path — unchanged historical semantics
+    out = jnp.take(w, ids, axis=0)
+    if padding_idx >= 0:
+        mask = (ids != padding_idx)[..., None].astype(out.dtype)
+        out = out * mask
+    ctx.set_out(op, "Out", out)

@@ -479,20 +479,5 @@ def _group_norm(ctx, op):
     ctx.set_out(op, "Variance", v.reshape((n, groups)))
 
 
-# ---------------------------------------------------------------------------
-# embedding
-# ---------------------------------------------------------------------------
-
-
-@register_lower("lookup_table", "lookup_table_v2")
-def _lookup_table(ctx, op):
-    w = ctx.in1(op, "W")
-    ids = ctx.in1(op, "Ids")
-    padding_idx = int(op.attr("padding_idx", -1))
-    if op.type == "lookup_table" and ids.ndim >= 2 and ids.shape[-1] == 1:
-        ids = jnp.squeeze(ids, -1)
-    out = jnp.take(w, ids, axis=0)
-    if padding_idx >= 0:
-        mask = (ids != padding_idx)[..., None].astype(out.dtype)
-        out = out * mask
-    ctx.set_out(op, "Out", out)
+# embedding (lookup_table/lookup_table_v2) moved to embedding_ops.py —
+# the sharded-engine dispatch lives with the all-to-all machinery there

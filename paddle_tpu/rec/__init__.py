@@ -1,0 +1,7 @@
+"""Recommender model zoo (reference PaddleRec's wide_deep / DLRM
+flagships) — the workload the sharded embedding engine
+(paddle_tpu.distributed.embedding) exists for: sparse categorical
+fields over a vocabulary far larger than one chip's HBM."""
+from .static_models import wide_deep_program  # noqa: F401
+
+__all__ = ["wide_deep_program"]

@@ -239,7 +239,8 @@ def render(bundle: str, tail: int = 15, stacks: bool = False,
                 "slo_budget_remaining", "goodput", "request_trace",
                 "quant_", "pass_weight_quant", "elastic_", "chaos_",
                 "overlap_", "pp_", "pipeline_scan",
-                "collective_matmul", "pass_overlap_stretched")
+                "collective_matmul", "pass_overlap_stretched",
+                "emb_", "dlrm_")
         for ln in rows:
             if metrics or any(k in ln for k in keys):
                 w(f"  {ln}\n")

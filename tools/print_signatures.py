@@ -37,8 +37,10 @@ MODULES = [
     "paddle_tpu.io",
     "paddle_tpu.distribution",
     "paddle_tpu.distributed",
+    "paddle_tpu.distributed.embedding",
     "paddle_tpu.distributed.fleet",
     "paddle_tpu.distributed.fleet.elastic",
+    "paddle_tpu.rec",
     "paddle_tpu.layers",
     "paddle_tpu.profiler",
     "paddle_tpu.text",
