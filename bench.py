@@ -1409,7 +1409,50 @@ def bench_decode(pt, jax):
              int(kv_delta["top1_agreement"] * 1e6))
     gc.collect()
 
+    # -- ragged prefill packing A/B (flash-attention PR serving leg) ------
+    # the SAME Poisson arrival schedule run with chunked prefill, padded
+    # per-slot dispatches vs ragged lane packing (several prompts' tails
+    # in one multi-row dispatch): outputs must be identical and the
+    # measured prefill_pad_waste (padded fraction of dispatched prefill
+    # rows, from serving/buckets.record_pad_waste) must DROP.
+    from paddle_tpu.monitor import stat_reset
+
+    def ragged_phase(lanes):
+        for name in ("prefill_pad_waste", "prefill_padded_tokens_total",
+                     "prefill_live_tokens_total"):
+            stat_reset(name)
+        e = DecodeEngine(model, weights, DecodeConfig(
+            slots=DECODE_SLOTS, max_seq_len=DECODE_MAX_SEQ,
+            page_size=DECODE_PAGE, max_queue=DECODE_REQS + 8,
+            prefill_chunk_pages=1, prefix_cache=False,
+            ragged_prefill_rows=lanes)).start()
+        try:
+            rr = []
+            for i, (prompt, n_new, gap) in enumerate(schedule):
+                time.sleep(gap)
+                rr.append(e.submit(prompt, max_new_tokens=n_new, seed=i))
+            outs = [r.result(timeout=600) for r in rr]
+        finally:
+            e.stop()
+        return outs, stat_get("prefill_pad_waste") / 1e6
+
+    padded_outs, padded_waste = ragged_phase(0)
+    ragged_outs, ragged_waste = ragged_phase(16)
+    if ragged_outs != padded_outs:
+        raise RuntimeError(
+            "ragged prefill packing changed decoded tokens — the "
+            "per-lane chunk-equivalence contract is broken")
+    if padded_waste > 0 and ragged_waste >= padded_waste:
+        raise RuntimeError(
+            f"ragged packing did not reduce prefill pad waste "
+            f"({padded_waste:.4f} -> {ragged_waste:.4f})")
+    gc.collect()
+
     return {
+        "prefill_pad_waste_padded": round(padded_waste, 4),
+        "prefill_pad_waste_ragged": round(ragged_waste, 4),
+        "prefill_pad_waste_reduction": round(
+            padded_waste / max(ragged_waste, 1e-9), 3),
         "decode_kv_quant_capacity": kv_cap_quant,
         "decode_kv_unquant_capacity": kv_cap_base,
         "decode_kv_quant_capacity_ratio": round(
@@ -1524,6 +1567,181 @@ def bench_quant(pt, jax):
         out["weight_quant_hbm_bytes"] = int(hbm_q)
         out["weight_quant_baseline_hbm_bytes"] = int(hbm_ref)
         out["weight_quant_hbm_ratio"] = round(hbm_q / hbm_ref, 3)
+    return out
+
+
+FLASH_SEQS = (512, 1024, 2048, 4096)  # hbm sweep (ISSUE 17: 512 -> 4k)
+FLASH_GATE_SEQ = 2048                 # acceptance: ratio < 0.6 here
+FLASH_PARITY_SEQ = 512                # loss-parity + step-time leg
+FLASH_PARITY_STEPS = 5
+
+
+def bench_flash_attention(pt, jax):
+    """Flash-attention training A/B (ISSUE 17): a 1-layer unfused-chain
+    BERT at growing seq lens, FLAGS_flash_attention never (the
+    matmul/softmax oracle) vs always (FlashAttentionPass rewrite; the
+    Pallas kernels engage in interpret mode off-TPU via the
+    ``fused._FORCE_INTERPRET`` hook so the tiled O(N) memory shape is
+    what XLA actually compiles).  Emits the ``flash_attn_hbm_ratio``
+    sweep (fused vs unfused ``hbm_required_bytes``), the
+    MFU-at-identical-config pair (program IR FLOPs are identical by
+    construction — hapi/model_stat prices the fused op as the two
+    contractions it replaced), and runs the PR 8 budget-gate assert:
+    with the capacity pinned to 0.6x the unfused footprint, the
+    unfused compile must be REFUSED (MemoryBudgetError before
+    dispatch) while the fused one passes — the acceptance bar as an
+    executable check."""
+    import numpy as np
+
+    from paddle_tpu.framework import flags as _fl
+    from paddle_tpu.framework.program import program_guard
+    from paddle_tpu.hapi.model_stat import program_flops
+    from paddle_tpu.monitor import stat_get
+    from paddle_tpu.observe import mfu_estimate
+    from paddle_tpu.observe.xla_stats import MemoryBudgetError
+    from paddle_tpu.ops import fused as _fused
+    from paddle_tpu.text import bert_base_pretrain_program
+
+    B, HID, HEADS, VOCAB, PREDS = 1, 128, 2, 512, 4
+
+    def build(seq):
+        main, startup, _, loss, opt = bert_base_pretrain_program(
+            batch_size=B, seq_len=seq, vocab_size=VOCAB, hidden=HID,
+            n_layers=1, n_heads=HEADS, ffn_size=2 * HID,
+            dropout_prob=0.0, max_preds_per_seq=PREDS,
+            use_fused_attention=False)
+        main.random_seed = startup.random_seed = 7
+        with program_guard(main, startup):
+            opt.minimize(loss)
+        return main, startup, loss
+
+    def feed(seq):
+        rng = np.random.RandomState(3)
+        ids = rng.randint(0, VOCAB, (B, seq)).astype("int64")
+        flat_pos = np.concatenate(
+            [b * seq + rng.choice(seq, PREDS, replace=False)
+             for b in range(B)]).astype("int64")
+        return {
+            "input_ids": ids,
+            "token_type_ids": np.zeros((B, seq), "int64"),
+            # max_pos embedding is 512-wide; wrap longer sweeps (the
+            # bench measures memory shape, not modelling quality)
+            "pos_ids": np.tile(np.arange(seq, dtype="int64") % 512,
+                               (B, 1)),
+            "input_mask": np.zeros((B, 1, 1, seq), "float32"),
+            "masked_flat_pos": flat_pos,
+            "masked_labels": ids.reshape(-1)[flat_pos]
+            .reshape(-1, 1).astype("int64"),
+            "masked_weights": np.ones((B * PREDS, 1), "float32"),
+            "nsp_labels": rng.randint(0, 2, (B, 1)).astype("int64"),
+        }
+
+    def phase(seq, mode, steps=1, capacity=0):
+        """One fresh program+Executor under FLAGS_flash_attention=mode
+        (the pass rewrites the program IN PLACE, so phases never share
+        a Program).  Returns (losses, hbm_required_bytes,
+        sec_per_step, program_flops_after_lowering)."""
+        old_mode = _fl.flag("flash_attention")
+        old_int = _fused._FORCE_INTERPRET
+        try:
+            pt.set_flags({
+                "FLAGS_flash_attention": mode,
+                "FLAGS_hbm_bytes_per_device": int(capacity),
+                "FLAGS_hbm_budget_fraction": 1.0 if capacity else 0.0,
+            })
+            _fused._FORCE_INTERPRET = (mode == "always")
+            main, startup, loss = build(seq)
+            exe = pt.Executor()
+            scope = pt.framework.Scope()
+            exe.run(startup, scope=scope)
+            fd = feed(seq)
+            losses, t0 = [], None
+            for i in range(steps):
+                out = exe.run(main, feed=fd, fetch_list=[loss],
+                              scope=scope)
+                losses.append(float(np.asarray(out[0]).reshape(-1)[0]))
+                if i == 0:
+                    t0 = time.perf_counter()
+            sec = ((time.perf_counter() - t0) / (steps - 1)
+                   if steps > 1 else 0.0)
+            return losses, stat_get("hbm_required_bytes"), sec, \
+                program_flops(main)
+        finally:
+            _fused._FORCE_INTERPRET = old_int
+            pt.set_flags({"FLAGS_flash_attention": old_mode,
+                          "FLAGS_hbm_bytes_per_device": 0,
+                          "FLAGS_hbm_budget_fraction": 0.0})
+
+    out = {"flash_attn_hbm_sweep": {}}
+
+    # --- parity + step-time leg (identical config, both modes) ---------
+    ref_losses, hbm_ref, t_ref, fl_ref = phase(
+        FLASH_PARITY_SEQ, "never", steps=FLASH_PARITY_STEPS)
+    fused_losses, hbm_fused, t_fused, fl_fused = phase(
+        FLASH_PARITY_SEQ, "always", steps=FLASH_PARITY_STEPS)
+    drift = max(abs(a - b) for a, b in zip(ref_losses, fused_losses))
+    if not (np.isfinite(drift) and drift <= 1e-4):
+        raise RuntimeError(
+            f"flash-attention loss parity broke: max |fused - unfused| "
+            f"over {FLASH_PARITY_STEPS} steps = {drift} (> 1e-4) at "
+            f"seq {FLASH_PARITY_SEQ}")
+    out["flash_attn_loss_drift"] = float(f"{drift:.3g}")
+    if fl_ref != fl_fused:
+        raise RuntimeError(
+            f"program FLOPs moved under the rewrite ({fl_ref} -> "
+            f"{fl_fused}): MFU is no longer comparable at identical "
+            f"config (hapi/model_stat pricing bug)")
+    # identical-config MFU pair: same numerator by construction, so on
+    # TPU this moves iff the step time moves; peak pinned to 1 TFLOP/s
+    # so the pair is comparable even where FLAGS_device_peak_tflops is
+    # unset for the host
+    if t_ref > 0:
+        out["flash_attn_bert_mfu_unfused"] = float(
+            f"{mfu_estimate(fl_ref, t_ref, 1.0):.4g}")
+    if t_fused > 0:
+        out["flash_attn_bert_mfu_fused"] = float(
+            f"{mfu_estimate(fl_fused, t_fused, 1.0):.4g}")
+    out["flash_attn_hbm_sweep"][FLASH_PARITY_SEQ] = {
+        "unfused_bytes": int(hbm_ref), "fused_bytes": int(hbm_fused)}
+
+    # --- hbm sweep 512 -> 4k -------------------------------------------
+    for seq in FLASH_SEQS:
+        if seq == FLASH_PARITY_SEQ:
+            continue
+        _, h_ref, _, _ = phase(seq, "never", steps=1)
+        _, h_fused, _, _ = phase(seq, "always", steps=1)
+        out["flash_attn_hbm_sweep"][seq] = {
+            "unfused_bytes": int(h_ref), "fused_bytes": int(h_fused)}
+    for seq, row in out["flash_attn_hbm_sweep"].items():
+        if row["unfused_bytes"] and row["fused_bytes"]:
+            row["ratio"] = round(
+                row["fused_bytes"] / row["unfused_bytes"], 4)
+
+    gate_row = out["flash_attn_hbm_sweep"].get(FLASH_GATE_SEQ, {})
+    if not (gate_row.get("unfused_bytes") and gate_row.get("fused_bytes")):
+        # no memory_analysis on this jax: the accounting keys are
+        # omitted rather than guessed (bench_quant convention) and the
+        # budget-gate assert cannot run
+        out["flash_attn_budget_gate"] = "skipped (no memory_analysis)"
+        return out
+    out["flash_attn_hbm_ratio"] = gate_row["ratio"]
+
+    # --- budget-gate assert: capacity = 0.6x the unfused footprint -----
+    capacity = int(0.6 * gate_row["unfused_bytes"])
+    try:
+        phase(FLASH_GATE_SEQ, "never", steps=1, capacity=capacity)
+        raise RuntimeError(
+            f"hbm budget gate did NOT refuse the unfused chain at seq "
+            f"{FLASH_GATE_SEQ} with capacity {capacity} (unfused "
+            f"footprint {gate_row['unfused_bytes']})")
+    except MemoryBudgetError:
+        pass
+    phase(FLASH_GATE_SEQ, "always", steps=1, capacity=capacity)  # passes
+    out["flash_attn_budget_gate"] = {
+        "capacity_bytes": capacity,
+        "unfused_rejected": True,
+        "fused_passed": True,
+    }
     return out
 
 
@@ -1907,6 +2125,12 @@ def main():
         result.update(bench_quant(pt, jax))
     except Exception as e:
         errors["quant"] = f"{type(e).__name__}: {e}"[:500]
+    try:
+        # flash-attention A/B (ISSUE 17): hbm_required_bytes sweep +
+        # loss parity + the 0.6x budget-gate refusal assert
+        result.update(bench_flash_attention(pt, jax))
+    except Exception as e:
+        errors["flash_attention"] = f"{type(e).__name__}: {e}"[:500]
     try:
         # elastic chaos leg: injected preflight init-timeout + rank
         # kill, recovered through the supervisor — must emit real

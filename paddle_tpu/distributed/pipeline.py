@@ -212,6 +212,42 @@ def _validate_mp_flow(block, stage_ops, tp_plan):
                     else:
                         known.pop(n, None)
                 continue
+            if op.type == "flash_attention":
+                # the fused op keeps the Megatron shape INTERNALLY: its
+                # softmax is per-head, so heads-dim (dim 1) sharded
+                # q/k/v is the one layout that flows through locally —
+                # no replication needed, unlike the unfused softmax op
+                qn = op.inputs.get("Q", [None])[0]
+                spec = known.get(qn) if qn else None
+                for other in (op.inputs.get("K", [None])[0],
+                              op.inputs.get("V", [None])[0]):
+                    if (known.get(other) if other else None) != spec:
+                        raise NotImplementedError(
+                            f"pipeline×mp: flash_attention in stage "
+                            f"{si} has q/k/v with mismatched mp "
+                            f"layouts; shard all three on the heads "
+                            f"dim or none")
+                mn = op.inputs.get("Mask", [None])[0]
+                if mn and has_mp(mn):
+                    raise NotImplementedError(
+                        f"pipeline×mp: flash_attention mask {mn!r} in "
+                        f"stage {si} is mp-sharded; the additive mask "
+                        f"must be replicated")
+                if spec is not None and not (
+                        len(spec) == 4 and spec[1] == "mp"
+                        and all(s != "mp" for j, s in enumerate(spec)
+                                if j != 1)):
+                    raise NotImplementedError(
+                        f"pipeline×mp: flash_attention in stage {si} "
+                        f"reads q/k/v sharded on a non-heads dim "
+                        f"({spec}); only heads-dim (Megatron) sharding "
+                        f"rides through the fused kernel")
+                for n in op.output_arg_names():
+                    if spec is not None:
+                        known[n] = spec
+                    else:
+                        known.pop(n, None)
+                continue
             bad = sorted(n for n in op.input_arg_names() if has_mp(n))
             if bad:
                 raise NotImplementedError(

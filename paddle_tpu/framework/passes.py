@@ -633,6 +633,26 @@ class ShardingPropagationPass(Pass):
                         known[n] = spec
                     else:
                         known.pop(n, None)
+            elif op.type == "flash_attention":
+                # fused attention is per-head batched math: a heads-dim
+                # (mp) sharded q/k/v rides through the kernel locally —
+                # the Megatron shape is kept internally (softmax is
+                # per-head), so out spec = Q's spec, anchored when mp
+                # is present so XLA keeps the layout through the kernel
+                qs = op.inputs.get("Q", [])
+                spec = known.get(qs[0]) if len(qs) == 1 else None
+                outs = op.output_arg_names()
+                if spec is not None and outs \
+                        and self._rank_ok(block, outs[0], spec):
+                    known[outs[0]] = spec
+                    if any(s == "mp" for s in spec):
+                        ents = list(op.attrs.get(TP_CONSTRAINT_ATTR,
+                                                 []) or [])
+                        ents.append(f"{outs[0]}\t{encode_spec(spec)}")
+                        op.attrs[TP_CONSTRAINT_ATTR] = ents
+                else:
+                    for n in outs:
+                        known.pop(n, None)
             elif op.type in ("lookup_table", "lookup_table_v2"):
                 self._prop_lookup(op, known, mp_degree)
             elif op.type == "c_allreduce_sum":
@@ -2127,6 +2147,274 @@ class RedundantCastEliminationPass(Pass):
         block.ops[:] = new_ops
         program._bump()
         stat_add("pass_casts_removed", n_removed)
+        return True
+
+
+@register_pass(before="sharding_propagation")
+class FlashAttentionPass(Pass):
+    """Rewrite the unfused attention chain — matmul(Q·Kᵀ, alpha) ->
+    [elementwise_add mask] -> softmax -> matmul(·V) — plus its generic
+    grad chain into the fused ``flash_attention`` /
+    ``flash_attention_grad`` ops (ops/flash_attention.py: Pallas
+    online-softmax forward keeping only per-row statistics, tiled
+    recompute backward, one custom_vjp — HBM ~O(N) instead of the
+    O(N²) materialized score tensor the plain chain costs).
+
+    Gated by FLAGS_flash_attention ('never' = no rewrite, so the
+    flag-off program stays bitwise-identical to the unfused chain;
+    'auto' rewrites only on a TPU backend so CPU/tier-1 numerics never
+    move; the flag is affects_lowering, so flips re-key the executor's
+    pass and compile caches).  Registered ahead of sharding
+    propagation: the fused op carries its own mp rule (heads-dim
+    sharding rides through — the Megatron shape is kept internally)
+    and LayerScanPass later sees the already-fused layer body, so the
+    rewrite composes with remat policies and the tp f/g anchors.
+
+    Conservative refusals — the chain is left alone when:
+    - any intermediate (scores / masked scores / probs, or their grad
+      twins) is fetched, persistable, or consumed outside the group
+      (e.g. a dropout on the attention probs: the standard flash
+      trade-off is no probs dropout);
+    - the mask wants gradients (the fused op treats it as a constant
+      additive bias);
+    - the grad chain is only partially present or its cotangent wiring
+      was renamed/summed (fan-out) — fusing half a backward would
+      recompute the other half wrong;
+    - shapes/attrs are off-pattern (non-rank-4 operands, transposed
+      layouts, non-unit alpha on the probs·V matmul, softmax on a
+      non-last axis).
+    """
+
+    name = "flash_attention_fuse"
+
+    @staticmethod
+    def _engaged():
+        from . import flags
+
+        mode = str(flags.flag("flash_attention") or "auto")
+        if mode == "never":
+            return False
+        if mode == "always":
+            return True
+        import jax
+
+        return jax.default_backend() == "tpu"
+
+    def should_apply(self, program, ctx):
+        return self._engaged() and any(
+            op.type == "softmax" for op in program.global_block.ops)
+
+    # -- chain matching ----------------------------------------------------
+    @staticmethod
+    def _slot1(op, group, slot):
+        ns = op.inputs.get(slot, []) if group == "in" \
+            else op.outputs.get(slot, [])
+        return ns[0] if len(ns) == 1 else None
+
+    def _match_group(self, block, ops, sm, producers, consumers,
+                     fetched, claimed):
+        """Match one fwd(+grad) group around a softmax op; returns None
+        on any refusal condition."""
+        s1 = self._slot1
+
+        def rank(n):
+            var = block._find_var_recursive(n)
+            return len(var.shape) if var is not None and var.shape else 0
+
+        def persistable(n):
+            var = block._find_var_recursive(n)
+            return bool(var is not None
+                        and getattr(var, "persistable", False))
+
+        masked = s1(sm, "in", "X")
+        probs = s1(sm, "out", "Out")
+        if not masked or not probs:
+            return None
+        if int(sm.attr("axis", -1)) not in (-1, rank(probs) - 1):
+            return None
+
+        prod = producers.get(masked)
+        add = mask = None
+        if prod is not None and prod.type == "elementwise_add":
+            if int(prod.attr("axis", -1)) != -1:
+                return None
+            add, mask = prod, s1(prod, "in", "Y")
+            scores = s1(prod, "in", "X")
+            qk = producers.get(scores) if scores else None
+        else:
+            scores, qk = masked, prod
+        if qk is None or qk.type != "matmul" or id(qk) in claimed:
+            return None
+        if bool(qk.attr("transpose_X", False)) \
+                or not bool(qk.attr("transpose_Y", False)):
+            return None
+        q, k = s1(qk, "in", "X"), s1(qk, "in", "Y")
+
+        pv = next((c for c in consumers.get(probs, [])
+                   if c.type == "matmul"
+                   and s1(c, "in", "X") == probs), None)
+        if pv is None or bool(pv.attr("transpose_X", False)) \
+                or bool(pv.attr("transpose_Y", False)) \
+                or float(pv.attr("alpha", 1.0)) != 1.0:
+            return None
+        v, ctxv = s1(pv, "in", "Y"), s1(pv, "out", "Out")
+
+        names = [q, k, v, scores, probs, ctxv] + ([mask] if add else [])
+        if not all(names):
+            return None
+        if any(rank(n) != 4 for n in (q, k, v)):
+            return None
+        if add and rank(mask) != 4:
+            return None
+
+        fwd = [qk] + ([add] if add else []) + [sm, pv]
+        if any(id(m) in claimed for m in fwd):
+            return None
+
+        # -- the matching generic grad chain (reverse order) --------------
+        def find_grad(t, outname):
+            cands = [o for o in ops if o.type == t
+                     and s1(o, "in", "Out") == outname]
+            return cands[0] if len(cands) == 1 else None
+
+        g_pv = find_grad("matmul_grad", ctxv)
+        g_sm = find_grad("softmax_grad", probs)
+        g_add = find_grad("elementwise_add_grad", masked) if add else None
+        g_qk = find_grad("matmul_grad", scores)
+        grads = [g for g in (g_pv, g_sm, g_add, g_qk) if g is not None]
+        if grads:
+            need = 4 if add else 3
+            if len(grads) != need:
+                return None  # partial grad chain: refuse, don't half-fuse
+            if any(g_add.outputs.get("Y" + GRAD_SUFFIX_TP, [])) \
+                    if g_add is not None else False:
+                return None  # learnable mask: fused op won't grad it
+            if s1(g_pv, "in", "X") != probs or s1(g_pv, "in", "Y") != v \
+                    or s1(g_qk, "in", "X") != q \
+                    or s1(g_qk, "in", "Y") != k:
+                return None
+            # cotangent wiring must be the straight-line chain
+            gp = (g_pv.outputs.get("X" + GRAD_SUFFIX_TP, [""]) + [""])[0]
+            gm = (g_sm.outputs.get("X" + GRAD_SUFFIX_TP, [""]) + [""])[0]
+            gs = (g_add.outputs.get("X" + GRAD_SUFFIX_TP, [""])
+                  + [""])[0] if g_add is not None else gm
+            if s1(g_sm, "in", "Out" + GRAD_SUFFIX_TP) != gp:
+                return None
+            if g_add is not None and \
+                    s1(g_add, "in", "Out" + GRAD_SUFFIX_TP) != gm:
+                return None
+            if s1(g_qk, "in", "Out" + GRAD_SUFFIX_TP) != gs:
+                return None
+            grad_inner = [n for n in (gp, gm,
+                                      gs if g_add is not None else None)
+                          if n]
+        else:
+            grad_inner = []
+
+        members = fwd + grads
+        inner = [scores, probs] + ([masked] if add else []) + grad_inner
+        for n in inner:
+            if n in fetched or persistable(n):
+                return None
+            if any(all(c is not m for m in members)
+                   for c in consumers.get(n, [])):
+                return None  # intermediate escapes the group
+        return {
+            "fwd": fwd, "grads": grads, "q": q, "k": k, "v": v,
+            "mask": mask if add else None, "ctxv": ctxv,
+            "alpha": float(qk.attr("alpha", 1.0)),
+            "g_pv": g_pv, "g_qk": g_qk,
+        }
+
+    def apply(self, program, ctx):
+        from ..monitor import stat_add
+        from .program import Operator
+
+        block = program.global_block
+        ops = list(block.ops)
+        pos = {id(op): i for i, op in enumerate(ops)}
+        producers, consumers = {}, {}
+        for op in ops:
+            for n in op.input_arg_names():
+                consumers.setdefault(n, []).append(op)
+            for n in op.output_arg_names():
+                producers[n] = op
+        fetched = set(ctx.fetch_names)
+
+        claimed: set = set()
+        groups = []
+        for sm in ops:
+            if sm.type != "softmax":
+                continue
+            g = self._match_group(block, ops, sm, producers, consumers,
+                                  fetched, claimed)
+            if g is None:
+                continue
+            if g["grads"]:
+                # moving dv's definition to the grad-group tail is only
+                # sound when nothing in between reads it
+                tail = pos[id(g["g_qk"])]
+                dv = (g["g_pv"].outputs.get(
+                    "Y" + GRAD_SUFFIX_TP, [""]) + [""])[0]
+                if dv and any(pos[id(c)] < tail
+                              for c in consumers.get(dv, [])):
+                    continue
+            for m in g["fwd"] + g["grads"]:
+                claimed.add(id(m))
+            groups.append(g)
+        if not groups:
+            return False
+
+        emit_at, skip = {}, set()
+        for g in groups:
+            attrs = {"scale": g["alpha"], "causal": False}
+            inputs = {"Q": [g["q"]], "K": [g["k"]], "V": [g["v"]]}
+            if g["mask"]:
+                inputs["Mask"] = [g["mask"]]
+            fop = Operator(block, "flash_attention", inputs,
+                           {"Out": [g["ctxv"]]}, dict(attrs))
+            emit_at[pos[id(g["fwd"][-1])]] = fop
+            for m in g["fwd"]:
+                skip.add(id(m))
+            if g["grads"]:
+                g_pv, g_qk = g["g_pv"], g["g_qk"]
+                gin = dict(inputs)
+                gin["Out"] = [g["ctxv"]]
+                gin["Out" + GRAD_SUFFIX_TP] = [
+                    self._slot1(g_pv, "in", "Out" + GRAD_SUFFIX_TP)]
+                gout = {}
+                dq = (g_qk.outputs.get("X" + GRAD_SUFFIX_TP, [""])
+                      + [""])[0]
+                dk = (g_qk.outputs.get("Y" + GRAD_SUFFIX_TP, [""])
+                      + [""])[0]
+                dv = (g_pv.outputs.get("Y" + GRAD_SUFFIX_TP, [""])
+                      + [""])[0]
+                if dq:
+                    gout["Q" + GRAD_SUFFIX_TP] = [dq]
+                if dk:
+                    gout["K" + GRAD_SUFFIX_TP] = [dk]
+                if dv:
+                    gout["V" + GRAD_SUFFIX_TP] = [dv]
+                gattrs = dict(attrs)
+                gattrs["__fwd_type__"] = "flash_attention"
+                gattrs["__fwd_out_slots__"] = ["Out"]
+                gop = Operator(block, "flash_attention_grad", gin, gout,
+                               gattrs)
+                emit_at[pos[id(g_qk)]] = gop
+                for m in g["grads"]:
+                    skip.add(id(m))
+
+        new_ops = []
+        for i, op in enumerate(ops):
+            if i in emit_at:
+                new_ops.append(emit_at[i])
+            elif id(op) not in skip:
+                new_ops.append(op)
+        block.ops[:] = new_ops
+        program._bump()
+        stat_add("pass_flash_attention_fused", len(groups))
+        stat_add("pass_flash_attention_grad_fused",
+                 sum(1 for g in groups if g["grads"]))
         return True
 
 

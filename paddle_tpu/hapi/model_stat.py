@@ -55,6 +55,22 @@ def _matmul_flops(block, op):
     return 2 * _prod(out) * int(k) if out else 0
 
 
+def _flash_attention_flops(block, op):
+    """Model FLOPs of the fused attention op: the two score/context
+    contractions it replaced (2*MACs each over B*H*Sq*Sk*D).  The
+    backward's tile recompute is an implementation cost, not model
+    work, so — like activation recompute under remat — it is NOT
+    priced; this keeps MFU comparable across FLAGS_flash_attention
+    settings at identical config."""
+    q = _shape_of(block, op.input("Q")[0])
+    k = _shape_of(block, op.input("K")[0])
+    if len(q) != 4 or len(k) != 4:
+        return 0
+    b, h, sq, d = q
+    sk = k[2]
+    return 4 * _prod([b, h, sq, sk, d])
+
+
 _ELEMENTWISE = {
     "elementwise_add", "elementwise_sub", "elementwise_mul",
     "elementwise_div", "elementwise_max", "elementwise_min", "relu",
@@ -106,6 +122,15 @@ def program_flops(program, detail=False):
             f = _conv_flops(block, op)
         elif op.type in ("matmul", "matmul_v2", "mul"):
             f = _matmul_flops(block, op)
+        elif op.type == "flash_attention":
+            f = _flash_attention_flops(block, op)
+        elif op.type == "flash_attention_grad":
+            # dQ/dK + dV/dP: four forward-sized contractions vs the
+            # forward's two — same 2x convention as matmul_grad
+            try:
+                f = 2 * _flash_attention_flops(block, _FwdSlotView(op))
+            except (IndexError, KeyError):
+                f = 0
         elif op.type in _GRAD_CONV or op.type in _GRAD_MATMUL:
             # backward = dX + dW, each a forward-sized contraction
             est = _conv_flops if op.type in _GRAD_CONV else _matmul_flops

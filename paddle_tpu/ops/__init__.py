@@ -12,6 +12,7 @@ from . import (  # noqa: F401
     deformable_ops,
     detection_ops,
     embedding_ops,
+    flash_attention,
     fused,
     grad_generic,
     interp_ops,

@@ -243,7 +243,13 @@ def prefill_bucket_grid(max_seq_len: int, page_size: int):
     """Prompt-length buckets for the decode engine's prefill compiles
     (serving/decode.py): page-multiple powers of two capped at
     max_seq_len, so the prefill executable universe stays
-    O(log(max_seq/page)) and every bucket scatters whole KV pages."""
+    O(log(max_seq/page)) and every bucket scatters whole KV pages.
+
+    The rounding buys a tiny executable universe at the price of dead
+    query rows — a 65-token prompt dispatches a 128-row executable.
+    Every admission must account that waste through
+    ``record_pad_waste`` so the cost is measurable (and so ragged
+    packing's A/B is visible on old padded rounds too)."""
     out = []
     b = int(page_size)
     while b < max_seq_len:
@@ -251,3 +257,22 @@ def prefill_bucket_grid(max_seq_len: int, page_size: int):
         b *= 2
     out.append(int(max_seq_len))
     return tuple(out)
+
+
+def record_pad_waste(live_tokens: int, dispatched_tokens: int) -> None:
+    """Account one prefill dispatch's padding: ``dispatched - live``
+    query rows computed attention for nobody.  Keeps the running
+    counters and re-derives the ``prefill_pad_waste`` gauge (cumulative
+    padded fraction of all dispatched prefill rows, in parts-per-million
+    — the stat registry is integer-only) — the number ragged packing
+    (FLAGS_decode_ragged_prefill) exists to drive down."""
+    from ..monitor import stat_add, stat_get, stat_set
+
+    live = max(0, int(live_tokens))
+    pad = max(0, int(dispatched_tokens) - live)
+    stat_add("prefill_padded_tokens_total", pad)
+    stat_add("prefill_live_tokens_total", live)
+    padded = stat_get("prefill_padded_tokens_total")
+    total = padded + stat_get("prefill_live_tokens_total")
+    if total:
+        stat_set("prefill_pad_waste", int(padded * 1_000_000 / total))

@@ -88,7 +88,7 @@ from ..observe.histogram import stat_time
 from .batcher import _UNSET, RequestBase
 from .buckets import (BucketSpec, DeadlineExceededError, QueueFullError,
                       RequestTooLargeError, ServerClosedError,
-                      prefill_bucket_grid)
+                      prefill_bucket_grid, record_pad_waste)
 from . import kv_cache
 from .kv_cache import (CacheConfig, PagedKVCache, K_PAGES_VAR,
                        V_PAGES_VAR, K_SCALES_VAR, V_SCALES_VAR)
@@ -374,6 +374,7 @@ class DecodeConfig:
                  cache_dtype="float32",
                  prefix_cache: Optional[bool] = None,
                  prefill_chunk_pages: Optional[int] = None,
+                 ragged_prefill_rows: Optional[int] = None,
                  spec_k: Optional[int] = None,
                  kv_quant: Optional[bool] = None):
         from ..framework import flags
@@ -400,6 +401,9 @@ class DecodeConfig:
         self.prefill_chunk_pages = int(
             prefill_chunk_pages if prefill_chunk_pages is not None
             else flags.flag("decode_prefill_chunk_pages"))
+        self.ragged_prefill_rows = int(
+            ragged_prefill_rows if ragged_prefill_rows is not None
+            else flags.flag("decode_ragged_prefill"))
         self.spec_k = int(spec_k if spec_k is not None
                           else flags.flag("decode_spec_k"))
         self.kv_quant = bool(kv_quant if kv_quant is not None
@@ -1199,7 +1203,12 @@ class DecodeEngine:
         if not pre:
             return
         chunk = self.config.prefill_chunk_pages
-        if chunk > 0:
+        if chunk > 0 and self.config.ragged_prefill_rows > 0:
+            # ragged packing: several prompts' tails share one
+            # fixed-width multi-lane dispatch instead of each padding
+            # its own chunk executable
+            self._run_prefill_ragged(pre)
+        elif chunk > 0:
             pick = min(pre, key=lambda i:
                        (i - self._prefill_rr) % self.config.slots)
             self._prefill_rr = (pick + 1) % self.config.slots
@@ -1254,6 +1263,7 @@ class DecodeEngine:
                       tokens=len(req.prompt),
                       dur_ms=round((time.monotonic() - t0) * 1e3, 3))
             stat_add("decode_prefills")
+            record_pad_waste(len(req.prompt), t_pad)
             st.prefill_pos = len(req.prompt)
             st.phase = "decode"
             self._cache.lengths[slot] = len(req.prompt)
@@ -1317,6 +1327,7 @@ class DecodeEngine:
                         args=args(self.draft_weights), scope=self._scope)
             stat_time("decode_prefill_seconds", time.monotonic() - t0)
             stat_add("prefill_chunks")
+            record_pad_waste(n_live, rows)
             self._prefill_chunk_count += 1
             st.chunks += 1
             self._tev(req, "prefill_chunk", slot=slot, start=start,
@@ -1334,6 +1345,140 @@ class DecodeEngine:
         except Exception as e:  # noqa: BLE001 — fault isolation per req
             stat_add("decode_prefill_errors")
             self._finish_slot(slot, e)
+
+    def _run_prefill_ragged(self, pre: List[int]):
+        """Pack several prompts' tails into ONE fixed-width multi-lane
+        dispatch: each of the ``ragged_prefill_rows`` lanes is one
+        (slot, position) query row with its own page-table row, start,
+        and (page, offset) write coords — the per-row coordinates of
+        the chunk executable already make lanes independent, so the
+        only thing padding bought (one shape per dispatch) is kept
+        while its cost (dead rows rounding each prompt up to its own
+        power-of-two bucket) is shared across requests.  Lanes of the
+        SAME request at consecutive positions are sound because every
+        layer writes all rows' K/V before its attention reads
+        (``_build_rows_fn``), and per-lane logits stay bitwise-equal
+        to the padded chunk path by the same chunk-equivalence
+        contract; dead lanes write to the trash page (page 0) and are
+        ignored.  One fixed lane count -> ONE extra executable."""
+        import jax.numpy as jnp
+
+        L = self.config.ragged_prefill_rows
+        cc = self._cache.config
+        per_slot_cap = self.config.prefill_chunk_pages * cc.page_size
+
+        # round-robin lane assignment in chunk-sized shares: every
+        # prefilling slot gets a fair share first, then further rounds
+        # deal the leftover lanes out (all of a prompt's pages are
+        # reserved at admission, so one slot absorbing several chunks
+        # in one dispatch is sound) — dead lanes only remain when the
+        # total outstanding prefill work is smaller than the dispatch
+        order = sorted(pre, key=lambda i:
+                       (i - self._prefill_rr) % self.config.slots)
+        assigned = {i: 0 for i in order}
+        lanes_left = L
+        progress = True
+        while lanes_left > 0 and progress:
+            progress = False
+            for i in order:
+                st = self._slots[i]
+                t = min(len(st.req.prompt) - st.prefill_pos
+                        - assigned[i], per_slot_cap, lanes_left)
+                if t <= 0:
+                    continue
+                assigned[i] += t
+                lanes_left -= t
+                progress = True
+        picks = [(i, self._slots[i].prefill_pos, assigned[i])
+                 for i in order if assigned[i] > 0]
+        if not picks:
+            return
+        self._prefill_rr = (picks[-1][0] + 1) % self.config.slots
+        live = L - lanes_left
+
+        tokens = np.zeros((L, 1), np.int32)
+        start = np.zeros((L,), np.int32)
+        page_table = np.zeros((L,) + self._cache.page_table[0].shape,
+                              np.int32)
+        write_page = np.zeros((L, 1), np.int32)
+        write_off = np.zeros((L, 1), np.int32)
+        key0 = np.asarray(self._slots[picks[0][0]].base_key)
+        base_keys = np.zeros((L,) + key0.shape, key0.dtype)
+        temp = np.zeros((L,), np.float32)
+        top_k = np.zeros((L,), np.int32)
+        top_p = np.ones((L,), np.float32)
+        lane = 0
+        spec_any = False
+        for i, s, t in picks:
+            st = self._slots[i]
+            req = st.req
+            spec_any = spec_any or st.spec
+            for j in range(t):
+                pos = s + j
+                tokens[lane, 0] = req.prompt[pos]
+                start[lane] = pos
+                page_table[lane] = self._cache.page_table[i]
+                write_page[lane, 0] = self._cache.page_table[i][
+                    pos // cc.page_size]
+                write_off[lane, 0] = pos % cc.page_size
+                base_keys[lane] = np.asarray(st.base_key)
+                temp[lane] = req.temperature
+                top_k[lane] = req.top_k
+                top_p[lane] = req.top_p
+                lane += 1
+        try:
+            t0 = time.monotonic()
+            args = lambda w: (w, jnp.asarray(tokens),  # noqa: E731
+                              jnp.asarray(start),
+                              np.zeros((L,), np.int32),
+                              jnp.asarray(page_table),
+                              jnp.asarray(write_page),
+                              jnp.asarray(write_off),
+                              jnp.asarray(base_keys),
+                              np.zeros((L,), np.int32),
+                              jnp.asarray(temp), jnp.asarray(top_k),
+                              jnp.asarray(top_p))
+            with otrace.span("serving/decode_prefill_ragged", lanes=L,
+                             live=live, slots=len(picks)):
+                tok, _greedy, logits = self._exe.run_persistent(
+                    self._rows_fn(1, L), self._state_vars,
+                    args=args(self.weights), scope=self._scope)
+                if spec_any:
+                    self._exe.run_persistent(
+                        self._rows_fn(1, L, "draft"),
+                        self._draft_state_vars,
+                        args=args(self.draft_weights), scope=self._scope)
+            stat_time("decode_prefill_seconds", time.monotonic() - t0)
+            stat_add("prefill_chunks")
+            stat_add("decode_ragged_dispatches")
+            record_pad_waste(live, L)
+            self._prefill_chunk_count += 1
+            dur = round((time.monotonic() - t0) * 1e3, 3)
+            lane = 0
+            for i, s, t in picks:
+                st = self._slots[i]
+                req = st.req
+                lane += t
+                n = len(req.prompt)
+                final = s + t >= n
+                st.chunks += 1
+                self._tev(req, "prefill_chunk", slot=i, start=s, rows=t,
+                          live=t, final=final, ragged=True, dur_ms=dur)
+                st.prefill_pos += t
+                if final:
+                    stat_add("decode_prefills")
+                    st.phase = "decode"
+                    self._cache.lengths[i] = n
+                    if req.record_logits:
+                        req.logits_trace.append(
+                            np.asarray(logits)[lane - 1, 0].copy())
+                    self._deliver(i, int(np.asarray(tok)[lane - 1]))
+        except Exception as e:  # noqa: BLE001 — the packed dispatch is
+            # shared: fail every packed request, not just one
+            stat_add("decode_prefill_errors")
+            for i, _s, _t in picks:
+                if self._slots[i] is not None:
+                    self._finish_slot(i, e)
 
     # -- device work: decode ----------------------------------------------
     def _deliver(self, slot: int, token: int):
@@ -1716,6 +1861,9 @@ class DecodeEngine:
             "shared_pages": self._cache.shared_pages,
             "cow_copies": self._cow_copies,
             "prefill_chunks": self._prefill_chunk_count,
+            "ragged_prefill_rows": self.config.ragged_prefill_rows,
+            "ragged_dispatches": stat_get("decode_ragged_dispatches"),
+            "prefill_pad_waste": stat_get("prefill_pad_waste") / 1e6,
             "spec_enabled": self.spec_enabled,
             "spec_proposed": sp,
             "spec_accepted": sa,

@@ -122,6 +122,8 @@ def jax_capability(name: str) -> bool:
     - ``memory_analysis`` / ``cost_analysis``: AOT-compiled executables
       expose per-module memory/cost introspection
       (observe/xla_stats.py capability-skips without them).
+    - ``pallas_interpret``: ``pl.pallas_call(..., interpret=True)`` runs
+      on the CPU backend (the Pallas kernel equivalence tests need it).
     """
     if name not in _CAPABILITY_CACHE:
         from paddle_tpu.framework import jax_compat
@@ -138,6 +140,19 @@ def jax_capability(name: str) -> bool:
             c = _probe_compiled()
             ok = c is not None and \
                 jax_compat.compiled_cost_analysis(c) is not None
+        elif name == "pallas_interpret":
+            try:
+                import jax.experimental.pallas as pl
+
+                out = pl.pallas_call(
+                    lambda x_ref, o_ref: o_ref.__setitem__(
+                        ..., x_ref[...] + 1.0),
+                    out_shape=jax.ShapeDtypeStruct((8, 128), np.float32),
+                    interpret=True,
+                )(np.zeros((8, 128), np.float32))
+                ok = float(np.asarray(out)[0, 0]) == 1.0
+            except Exception:  # noqa: BLE001 - no usable Pallas here
+                ok = False
         else:
             raise KeyError(f"unknown jax capability probe {name!r}")
         _CAPABILITY_CACHE[name] = ok

@@ -240,7 +240,8 @@ def render(bundle: str, tail: int = 15, stacks: bool = False,
                 "quant_", "pass_weight_quant", "elastic_", "chaos_",
                 "overlap_", "pp_", "pipeline_scan",
                 "collective_matmul", "pass_overlap_stretched",
-                "emb_", "dlrm_")
+                "emb_", "dlrm_", "flash_attn_", "prefill_pad",
+                "pass_flash_attention")
         for ln in rows:
             if metrics or any(k in ln for k in keys):
                 w(f"  {ln}\n")
