@@ -121,6 +121,11 @@ class _Compiled:
     # mesh-less path pins execution to (None when a mesh owns placement)
     jit_fn: object = None
     jit_device: object = None
+    # step-phase attribution (observe/phases.py): the compile-time cost
+    # model — predicted compute seconds + per-collective exposed/hidden
+    # ledger — consulted at each window drain; None when the plane is
+    # off or the model could not price this program
+    phase_plan: object = None
 
 
 class _InflightStep:
@@ -128,10 +133,11 @@ class _InflightStep:
 
     __slots__ = ("sync_refs", "nan_flags", "nan_ops", "t_dispatch",
                  "steps", "examples", "compiled", "flops_per_step",
-                 "allreduce_bytes", "drained")
+                 "allreduce_bytes", "host_s", "phase_plan", "drained")
 
     def __init__(self, sync_refs, nan_flags, nan_ops, t_dispatch, steps,
-                 examples, compiled, flops_per_step, allreduce_bytes):
+                 examples, compiled, flops_per_step, allreduce_bytes,
+                 host_s=0.0, phase_plan=None):
         self.sync_refs = sync_refs          # fetch device arrays (never
         self.nan_flags = nan_flags          # donated, safe to hold)
         self.nan_ops = nan_ops
@@ -141,6 +147,11 @@ class _InflightStep:
         self.compiled = compiled
         self.flops_per_step = flops_per_step
         self.allreduce_bytes = allreduce_bytes
+        # phase attribution (observe/phases.py): dispatch-side host
+        # seconds (pass pipeline + analysis + feed prep, backpressure
+        # excluded) and the entry's compile-time cost model
+        self.host_s = host_s
+        self.phase_plan = phase_plan
         self.drained = False
 
 
@@ -263,6 +274,18 @@ class _InflightWindow:
             max(now - start, 0.0), steps=e.steps, examples=e.examples,
             compiled=e.compiled, flops_per_step=e.flops_per_step,
             allreduce_bytes_per_step=e.allreduce_bytes)
+        # step-phase attribution + anomaly trigger (observe/phases.py,
+        # observe/profiler_capture.py): the drain is THE truth point —
+        # wall = inter-drain loop period, sync = this drain's block,
+        # host = the dispatch-side host seconds carried on the entry
+        from ..observe import phases as _phases
+        from ..observe import profiler_capture as _prof
+
+        _phases.on_step_drained(
+            wall_s=max(now - start, 0.0), sync_s=now - t0, host_s=e.host_s,
+            steps=e.steps, plan=e.phase_plan, compiled=e.compiled)
+        _prof.on_step_drained(max(now - start, 0.0) / max(e.steps, 1),
+                              compiled=e.compiled)
         if e.nan_flags is not None:
             try:
                 _raise_on_nan(np.asarray(e.nan_flags), e.nan_ops)
@@ -693,6 +716,10 @@ class Executor:
                        place=type(self.place).__name__,
                        device_id=self.place.device_id)
         _health.maybe_start_watchdog()
+        # continuous low-duty-cycle profiling (FLAGS_prof_continuous_s)
+        from ..observe import profiler_capture as _prof
+
+        _prof.maybe_start_continuous()
 
     def _active_mesh(self):
         if self._mesh is not None:
@@ -1012,6 +1039,12 @@ class Executor:
         from ..observe import step_stats as _step_stats
         from ..observe import tracer as otrace
 
+        # phase attribution: dispatch-side host seconds = entry-to-launch
+        # wall MINUS the backpressure drain block (that block is an older
+        # step's sync time, charged to that step at ITS drain)
+        t_enter = _time.perf_counter()
+        t_backpressure = 0.0
+
         # graph-pass pipeline (framework/passes.py): fused gradient
         # allreduce + cast/dead-op cleanup, applied to a cached clone so
         # the caller's program is never mutated
@@ -1141,7 +1174,9 @@ class Executor:
         pipelined = max_inflight > 0
 
         if pipelined:
+            _t_bp0 = _time.perf_counter()
             self._window.backpressure(max_inflight)
+            t_backpressure = _time.perf_counter() - _t_bp0
 
         # examples/steps for the StepTimer; FLOPs/allreduce bytes are
         # the compile-time static accounting on the entry
@@ -1216,7 +1251,9 @@ class Executor:
                 nan_ops=entry.nan_ops, t_dispatch=t_exec0, steps=n_steps,
                 examples=int(batch) * n_steps, compiled=first_call,
                 flops_per_step=entry.flops_per_step,
-                allreduce_bytes=entry.allreduce_bytes)
+                allreduce_bytes=entry.allreduce_bytes,
+                host_s=max(t_exec0 - t_enter - t_backpressure, 0.0),
+                phase_plan=entry.phase_plan)
             self._window.push(inflight)
             stat_add("executor_steps_dispatched", n_steps)
             _flight.record("executor/dispatch", steps=n_steps,
@@ -1315,8 +1352,11 @@ class Executor:
         if rec.get("xla_flops_per_step"):
             # MFU honesty: the hand-rolled IR count misprices fused ops
             # (mfu_flops_mismatch counted in on_compile) — XLA's own
-            # per-chip number feeds the StepTimer from here on
+            # per-chip number feeds the StepTimer from here on, and the
+            # phase cost model re-prices its compute side to match
             entry.flops_per_step = float(rec["xla_flops_per_step"])
+            if entry.phase_plan is not None:
+                entry.phase_plan.update_flops(entry.flops_per_step)
 
         orig_fn = entry.fn
 
@@ -1557,6 +1597,17 @@ class Executor:
                 for r in tp_plan.grad_reduce.values())
         else:
             allreduce_bytes = _program_allreduce_bytes(block, op_list)
+        # step-phase attribution (observe/phases.py): price this
+        # program's compute + collectives once at compile; consulted at
+        # every window drain.  Never fails a compile (None on error).
+        from . import flags as _pflags
+        from ..observe import phases as _phases
+
+        phase_plan = _phases.build_phase_plan(
+            block, op_list, mesh=mesh, tp_plan=tp_plan,
+            flops_per_step=flops_per_step,
+            cm_chunks=int(_pflags.flag("collective_matmul_chunks") or 0)
+            if tp_plan is not None else 0)
         out_set = set(state_out)
         state_mut = tuple(n for n in state_in if n in out_set)
         state_const = tuple(n for n in state_in if n not in out_set)
@@ -1689,6 +1740,7 @@ class Executor:
                 flops_per_step=flops_per_step,
                 allreduce_bytes=allreduce_bytes,
                 jit_fn=pipe_jfn,
+                phase_plan=phase_plan,
             )
 
         globalize = None
@@ -1764,6 +1816,7 @@ class Executor:
             allreduce_bytes=allreduce_bytes,
             jit_fn=jfn,
             jit_device=jit_device,
+            phase_plan=phase_plan,
         )
         return compiled
 

@@ -417,3 +417,54 @@ define_flag("decode_spec_k", 0,
             "bitwise-identical to non-speculative decode (rejected "
             "proposals fall back to the target's own token); 0 = off, "
             "ignored unless a draft model is configured")
+define_flag("phase_attribution", True,
+            "step-phase attribution (paddle_tpu.observe.phases): "
+            "decompose each drained step's wall time into compute / "
+            "exposed-collective / host-blocked / input-wait buckets "
+            "(phase_*_seconds_micro gauges + the per-collective "
+            "exposed-vs-hidden ledger on /stats and /metrics).  Pure "
+            "observer: never affects lowering or numerics — the "
+            "measured split comes from timestamps the drain path "
+            "already takes, the predicted split from the compile-time "
+            "cost model (deterministic on CPU/tier-1)")
+define_flag("phase_interconnect_gbps", 100.0,
+            "assumed per-chip interconnect bandwidth (GB/s) for the "
+            "phase-attribution cost model's predicted collective "
+            "times (observe/phases.py) — TPU v4/v5e ICI-class default; "
+            "set to your fabric's number for honest predicted "
+            "comm fractions.  Prediction only: measured phases and "
+            "step numerics never read it")
+define_flag("prof_trigger_ratio", 0.0,
+            "anomaly-triggered profiling (observe/profiler_capture): "
+            "when a drained step's wall time exceeds this ratio x the "
+            "rolling step-time baseline (or an slo_burn_rate_* gauge "
+            "trips past its budget), capture ONE bounded jax.profiler "
+            "trace window + phase snapshot into a postmortem bundle "
+            "(phases.json section), then latch until the step time "
+            "drops back under the threshold; 0 = disabled")
+define_flag("prof_cooldown_s", 60.0,
+            "minimum seconds between two anomaly-triggered captures "
+            "(observe/profiler_capture): after one bundle is written "
+            "the trigger stays quiet for this long even if the episode "
+            "re-trips — a sustained regression produces one bundle per "
+            "cooldown window, not one per step; the capture itself "
+            "perturbs step times, so this also keeps the observer from "
+            "triggering on its own overhead")
+define_flag("prof_capture_s", 2.0,
+            "bound (seconds) of one anomaly/continuous profiler "
+            "capture window — the trace is stopped after this long no "
+            "matter what, so a capture can never become the overhead "
+            "it is meant to explain")
+define_flag("prof_continuous_s", 0.0,
+            "continuous low-duty-cycle profiling: every this many "
+            "seconds, capture one FLAGS_prof_capture_s trace window "
+            "(duty cycle = capture_s / continuous_s) — the always-on "
+            "fleet profiling mode; 0 = disabled.  Captures are "
+            "capability-skipped (prof_trace_unavailable counted) on "
+            "backends without jax.profiler trace support")
+define_flag("flight_recorder_max_mb", 0.0,
+            "size-based rotation for the FLAGS_flight_recorder_file "
+            "JSONL sink: when the active segment exceeds this many MB "
+            "it is rotated to <path>.1 (one previous segment kept, so "
+            "the post-crash tail always spans >= this much history); "
+            "0 = unbounded (the pre-rotation behavior)")

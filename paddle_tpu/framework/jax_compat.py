@@ -237,6 +237,51 @@ def wrap_checkpoint(fn, policy_name: str = ""):
         return ckpt(fn)
 
 
+# ---------------------------------------------------------------------------
+# jax.profiler capture (observe/profiler_capture.py).  Same capability
+# pattern as the AOT accessors: probe the installed jax, treat a missing
+# or failing profiler as "this jax can't say" (False) — never an error,
+# never a version-string compare.  The CPU tier-1 backend typically has
+# start_trace but produces host-only traces; a build without
+# jax.profiler at all (or one whose start raises) degrades to False and
+# the capture layer counts ``prof_trace_unavailable``.
+# ---------------------------------------------------------------------------
+
+
+def profiler_start(log_dir: str) -> bool:
+    """Begin a ``jax.profiler`` trace into ``log_dir``; returns whether
+    a trace actually started (False = this jax/backend can't)."""
+    try:
+        from jax import profiler as _prof
+    except ImportError:
+        return False
+    start = getattr(_prof, "start_trace", None)
+    if start is None:
+        return False
+    try:
+        start(log_dir)
+    except Exception:  # noqa: BLE001 - a second live trace, a dead
+        return False   # backend, an unwritable dir: all mean "no trace"
+    return True
+
+
+def profiler_stop() -> bool:
+    """Stop the live ``jax.profiler`` trace; returns whether the stop
+    succeeded.  Safe to call when no trace is live (returns False)."""
+    try:
+        from jax import profiler as _prof
+    except ImportError:
+        return False
+    stop = getattr(_prof, "stop_trace", None)
+    if stop is None:
+        return False
+    try:
+        stop()
+    except Exception:  # noqa: BLE001 - no trace in flight etc.
+        return False
+    return True
+
+
 _scan_unroll_supported: Optional[bool] = None
 
 

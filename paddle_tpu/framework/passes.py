@@ -143,6 +143,19 @@ LAYER_STACK_ATTR = "__layer_stack__"          # num stacked layers
 # are what checkpoints save, keeping resume elastic across the flag)
 LAYER_STACK_PREFIX = "@LAYER_STACK@"
 
+# collective-identity stamps for the phase-attribution ledger
+# (observe/phases.py).  FuseAllReducePass stamps both on each fused
+# c_allreduce_sum it emits: COMM_ID_ATTR is the stable bucket identity
+# ("bucket:<dtype>@r<ring>@<idx>" — deterministic across re-transpiles,
+# like the fused var name), COMM_OVERLAP_ATTR marks a bucket the
+# overlap stretch (FLAGS_overlap_grad_allreduce) closed at its scan
+# boundary, i.e. one whose bulk payload dispatches UNDER the remaining
+# backward compute and is therefore modeled as hidden comm.  Op attrs —
+# not side channels — so the identity survives clone/proto round-trips
+# and joins the program fingerprint.
+COMM_ID_ATTR = "__comm_id__"
+COMM_OVERLAP_ATTR = "__comm_overlap__"
+
 
 def encode_spec(spec) -> str:
     """Partition spec tuple -> attr string: ``(None,'mp')`` -> "None,mp".
@@ -2012,7 +2025,10 @@ class FuseAllReducePass(Pass):
                 # to the tail.  Stacked-with-stacked fusion across
                 # compute keeps the old greedy semantics (their byte
                 # ratio makes the delay symmetric).
-                open_buckets.pop(key)
+                closed = open_buckets.pop(key)
+                # the closed bucket's comm runs under the remaining
+                # backward compute: the phase ledger models it hidden
+                closed["overlap_hidden"] = True
                 b = None
                 stat_add("pass_overlap_stretched_buckets")
             if b is None or b["bytes"] + e["bytes"] > e["cap"]:
@@ -2049,7 +2065,12 @@ class FuseAllReducePass(Pass):
             seq.append(Operator(block, "cast", {"X": [fused]},
                                 {"Out": [fused]},
                                 {"out_dtype": dtypes.to_enum("bfloat16")}))
-        fused_attrs = {"ring_id": ring_id, "use_calc_stream": True}
+        fused_attrs = {"ring_id": ring_id, "use_calc_stream": True,
+                       # ledger identity (observe/phases.py): stable
+                       # across re-transpiles like the fused var name
+                       COMM_ID_ATTR: f"bucket:{dtype}@r{ring_id}@{bucket_idx}"}
+        if bucket.get("overlap_hidden"):
+            fused_attrs[COMM_OVERLAP_ATTR] = True
         if tp_spec:
             # a homogeneous tp bucket keeps its members' spec visible to
             # the collective span/byte telemetry (the fused 1-D buffer's

@@ -39,6 +39,22 @@ This package is the middle:
   rates): ``slo_burn_rate_*`` / ``slo_budget_remaining_*`` gauges and
   the ``decode_goodput_rps`` metric (completions meeting ALL
   objectives per second).
+- ``phases``     — step-phase attribution: decomposes each drained
+  step's wall time into compute / exposed-collective / host-blocked /
+  input-wait buckets, backed by an HLO cost model (deterministic
+  *predicted* fractions on backends without device tracing) and a
+  per-collective ledger keyed by FuseAllReducePass bucket /
+  collective-matmul chunk identity (``comm_exposed_seconds`` vs
+  ``comm_hidden_seconds`` per collective).
+- ``profiler_capture`` — anomaly-triggered + continuous
+  ``jax.profiler`` capture: step-time spikes past
+  ``FLAGS_prof_trigger_ratio`` x rolling baseline (or an SLO burn-rate
+  trip) fire one bounded trace window + phase snapshot into a
+  postmortem bundle; ``FLAGS_prof_continuous_s`` runs a low-duty-cycle
+  always-on mode with 2-deep directory rotation.
+- ``metrics_catalog`` — the authoritative name → (type, unit,
+  subsystem) catalog behind ``METRICS.md``; a tier-1 drift gate keeps
+  every ``/metrics`` series documented.
 - ``xla_stats``  — XLA introspection: per-compile wall time
   (``compile_seconds``), executable size, per-chip HBM footprint from
   ``compiled.memory_analysis()`` joined with the tensor-parallel
@@ -47,8 +63,14 @@ This package is the middle:
   memory budget gate (``FLAGS_hbm_budget_fraction`` →
   :class:`~.xla_stats.MemoryBudgetError` before dispatch).
 """
-from . import flight, health, request_trace, slo, xla_stats
+from . import (flight, health, metrics_catalog, phases, profiler_capture,
+               request_trace, slo, xla_stats)
 from .flight import FlightRecorder, get_flight_recorder
+from .phases import (PhaseEngine, PhasePlan, build_phase_plan,
+                     collective_inventory, phase_engine, phases_report,
+                     reset_phases)
+from .profiler_capture import (CaptureEngine, capture_engine,
+                               parse_trace_dir, reset_capture)
 from .request_trace import (RequestTrace, TraceStore,
                             export_request_chrome_trace, get_trace_store)
 from .slo import Objective, SLOEngine, get_slo_engine
@@ -89,6 +111,12 @@ __all__ = [
     "xla_stats", "MemoryBudgetError", "memory_breakdown",
     "var_attribution", "check_hbm_budget", "device_memory_stats",
     "memory_report",
+    # phase attribution + profiler capture + metrics catalog
+    "phases", "PhasePlan", "PhaseEngine", "build_phase_plan",
+    "collective_inventory", "phase_engine", "phases_report",
+    "reset_phases", "profiler_capture", "CaptureEngine",
+    "capture_engine", "parse_trace_dir", "reset_capture",
+    "metrics_catalog",
     # per-request tracing + SLO plane
     "request_trace", "RequestTrace", "TraceStore", "get_trace_store",
     "export_request_chrome_trace", "slo", "Objective", "SLOEngine",

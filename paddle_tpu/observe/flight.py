@@ -72,6 +72,7 @@ class FlightRecorder:
         self._sink = None
         self._sink_path: Optional[str] = None
         self._sink_failed_path: Optional[str] = None
+        self._rotations = 0
 
     # -- recording -------------------------------------------------------
     def record(self, event: str, **fields) -> Optional[dict]:
@@ -121,10 +122,41 @@ class FlightRecorder:
                 self._sink_failed_path = None
             self._sink.write(json.dumps(rec) + "\n")
             self._sink.flush()
+            self._maybe_rotate(path)
         except OSError:  # sink trouble must never fail the caller
             self._sink = None
             self._sink_path = None
             self._sink_failed_path = path
+
+    def _maybe_rotate(self, path: str) -> None:
+        """Size-based sink rotation (``FLAGS_flight_recorder_max_mb``,
+        called under the lock right after a flushed write): when the
+        active segment passes the cap it becomes ``<path>.1`` (the one
+        previous segment kept — two segments bound disk at 2x the cap
+        on an unbounded run) and a fresh segment opens.  The rotated
+        file is complete JSONL, so a post-SIGKILL reader concatenating
+        ``<path>.1`` + ``<path>`` always has at least one full cap of
+        tail history."""
+        try:
+            max_mb = float(_flags.flag("flight_recorder_max_mb") or 0.0)
+        except KeyError:  # pragma: no cover - partial installs
+            return
+        if max_mb <= 0.0 or self._sink is None:
+            return
+        if self._sink.tell() < max_mb * 1024.0 * 1024.0:
+            return
+        self._sink.close()
+        self._sink = None
+        os.replace(path, path + ".1")  # atomic; drops any older .1
+        self._sink = open(path, "a")
+        self._sink_path = path
+        self._rotations += 1
+        try:
+            from ..monitor import stat_add
+
+            stat_add("flight_sink_rotations")
+        except ImportError:  # pragma: no cover
+            pass
 
     # -- reading ---------------------------------------------------------
     def snapshot(self) -> List[dict]:

@@ -56,7 +56,8 @@ __all__ = ["StallWatchdog", "HealthReporter", "executor_progress",
 HEALTH_KEY_PREFIX = "health/rank/"
 
 _BUNDLE_FILES = ("meta.json", "stacks.txt", "trace.json", "metrics.prom",
-                 "flight.jsonl", "flags.json", "memory.json")
+                 "flight.jsonl", "flags.json", "memory.json",
+                 "phases.json")
 
 
 # ---------------------------------------------------------------------------
@@ -177,6 +178,10 @@ def dump_postmortem(reason: str, directory: Optional[str] = None,
       in-flight table, and the SLO verdict snapshot (burn rates,
       budget remaining, goodput) — observe/request_trace.py +
       observe/slo.py; pretty-print with ``python -m tools.reqtrace``
+    - ``phases.json`` step-phase attribution snapshot
+      (observe/phases.py): measured compute / exposed-comm / host /
+      input-wait split, the predicted cost-model fractions, and the
+      per-collective exposed-vs-hidden ledger
     """
     directory = directory or _flags.flag("postmortem_dir") or "postmortem"
     safe = re.sub(r"[^A-Za-z0-9_.-]", "_", str(reason))[:48] or "unknown"
@@ -247,6 +252,12 @@ def dump_postmortem(reason: str, directory: Optional[str] = None,
         with open(p, "w") as f:
             json.dump(doc, f, indent=2, default=repr)
 
+    def _phases_json(p):
+        from . import phases as _phases
+
+        with open(p, "w") as f:
+            json.dump(_phases.phases_report(), f, indent=2, default=repr)
+
     section("stacks.txt", _stacks)
     section("trace.json", _trace)
     section("metrics.prom", _metrics)
@@ -254,6 +265,7 @@ def dump_postmortem(reason: str, directory: Optional[str] = None,
     section("flags.json", _flags_json)
     section("memory.json", _memory_json)
     section("requests.json", _requests_json)
+    section("phases.json", _phases_json)
 
     meta = {
         "reason": str(reason),
@@ -559,6 +571,17 @@ def _default_rank_stats() -> Dict:
         out["step_time_p50_s"] = round(h.percentile(50), 6)
         out["steps_timed"] = h.count
     try:
+        # per-rank comm-exposure share (observe/phases.py): reads the
+        # engine's own ledger under its own lock — no drains forced —
+        # and gives the cluster straggler gauge a CAUSE column
+        from . import phases as _phases
+
+        eng = _phases.phase_engine()
+        if eng.steps:
+            out["comm_exposed_share"] = round(eng.comm_exposed_share(), 6)
+    except Exception:  # noqa: BLE001 - heartbeat must never die here
+        pass
+    try:
         # live per-chip HBM sample (observe/xla_stats.py): sets the
         # hbm_free/used/limit gauges on /metrics and rides the heartbeat
         # onto /metrics/cluster; {} where the backend has no memory
@@ -781,7 +804,21 @@ def cluster_health(kv: Dict, world_size: Optional[int] = None,
     if len(p50s) >= 2:
         lo, hi = min(p50s.values()), max(p50s.values())
         out["step_time_skew"] = round((hi - lo) / lo, 4)
-        out["straggler_rank"] = max(p50s, key=p50s.get)
+        straggler = max(p50s, key=p50s.get)
+        out["straggler_rank"] = straggler
+        # the CAUSE column (observe/phases.py heartbeat field): how
+        # much of the straggler's priced comm is exposed — "rank 3:
+        # 41% exposed-allreduce" instead of a bare rank number
+        share = ranks[straggler].get("comm_exposed_share")
+        if share is not None:
+            from ..monitor import stat_set as _stat_set
+
+            out["straggler_comm_exposed_share"] = float(share)
+            out["straggler_cause"] = (
+                f"rank {straggler}: {float(share) * 100:.0f}% "
+                f"exposed-collective")
+            _stat_set("cluster_straggler_comm_exposed_ppm",
+                      int(float(share) * 1e6))
     else:
         out["step_time_skew"] = 0.0
     # HBM headroom across the fleet (heartbeat fields fed by

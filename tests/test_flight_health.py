@@ -37,6 +37,7 @@ def _clean_plane():
     health.uninstall_crash_handler()
     pt.set_flags({"FLAGS_flight_recorder": True,
                   "FLAGS_flight_recorder_file": "",
+                  "FLAGS_flight_recorder_max_mb": 0.0,
                   "FLAGS_stall_timeout_s": 0.0,
                   "FLAGS_device_peak_tflops": 275.0})
     flight.clear_events()
@@ -107,6 +108,34 @@ class TestFlightRecorder:
         pt.set_flags({"FLAGS_flight_recorder_file": ""})
         flight.record("test/sink", n=3)
         assert len(open(p).read().splitlines()) == 2  # sink detached
+
+    def test_file_sink_rotates_at_size_cap_and_tail_survives(
+            self, tmp_path):
+        """FLAGS_flight_recorder_max_mb: the active segment rotates to
+        <path>.1 at the cap and a reader concatenating .1 + active —
+        the post-SIGKILL recovery path, no shutdown hook involved —
+        sees an unbroken, parseable event history spanning the
+        rotation."""
+        p = str(tmp_path / "fr" / "events.jsonl")
+        before = stat_get("flight_sink_rotations")
+        pt.set_flags({"FLAGS_flight_recorder_file": p,
+                      "FLAGS_flight_recorder_max_mb": 0.002})  # ~2 KB
+        pad = "x" * 64
+        for i in range(200):  # ~130 bytes/line >> 2 KB: many rotations
+            flight.record("test/rot", i=i, pad=pad)
+        # no close/flush call: every line was already flushed at write
+        assert os.path.isfile(p) and os.path.isfile(p + ".1")
+        assert os.path.getsize(p + ".1") >= 2 * 1024
+        assert stat_get("flight_sink_rotations") > before
+        events = []
+        for seg in (p + ".1", p):  # rotated first, then active
+            for line in open(seg).read().splitlines():
+                events.append(json.loads(line))  # every line parses
+        idx = [e["i"] for e in events if e["event"] == "test/rot"]
+        # contiguous tail ending at the last event: rotation dropped
+        # only history OLDER than the kept two segments
+        assert idx == list(range(idx[0], 200))
+        assert len(idx) >= 20  # spans at least one rotation boundary
 
     def test_run_metadata_once_and_content(self):
         ev = flight.record_run_metadata()

@@ -1,0 +1,117 @@
+"""Metrics catalog drift gate (observe/metrics_catalog.py + METRICS.md).
+
+Two invariants: (1) the checked-in METRICS.md is exactly what the
+catalog rules generate — editing one without the other fails tier-1;
+(2) every series a real process exports on ``/metrics`` matches a
+catalog rule.  The coverage scrape runs in a SUBPROCESS with a
+representative slice of the framework exercised — the in-process test
+registry is polluted by every synthetic stat name other tests mint
+(``aa_a``, ``t_counter``...), which would make the assertion about the
+test suite, not the product.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import paddle_tpu as pt  # noqa: F401 - conftest backend setup
+from paddle_tpu.observe import metrics_catalog as mc
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_checked_in_catalog_matches_rules():
+    path = os.path.join(ROOT, "METRICS.md")
+    assert os.path.isfile(path), "METRICS.md missing — run " \
+        "python -m paddle_tpu.observe.metrics_catalog --write"
+    assert mc.check_file(path), \
+        "METRICS.md drifted from observe/metrics_catalog.py RULES — " \
+        "regenerate with python -m paddle_tpu.observe.metrics_catalog " \
+        "--write"
+
+
+def test_rules_cover_statically_registered_names():
+    """Every literal stat name in the source tree has a catalog row
+    (cheap static half of the coverage gate; the subprocess scrape
+    below covers the dynamic names)."""
+    import re
+
+    pat = re.compile(r'stat_(?:add|set|max|time)\("([a-z0-9_]+)"')
+    missing = set()
+    for dirpath, _dirs, files in os.walk(
+            os.path.join(ROOT, "paddle_tpu")):
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            with open(os.path.join(dirpath, fn)) as f:
+                for name in pat.findall(f.read()):
+                    if mc.lookup(name) is None:
+                        missing.add(name)
+    assert not missing, f"stats without a catalog rule: {sorted(missing)}"
+
+
+def test_lookup_first_match_and_units():
+    assert mc.lookup("step_time_seconds").type == "histogram"
+    assert mc.lookup("executor_steps_drained").subsystem == "executor"
+    assert mc.lookup("zz_not_a_metric") is None
+    assert mc.unit_of("phase_compute_seconds_micro") == \
+        "microseconds (int)"
+    assert mc.unit_of("comm_exposed_share_ppm") == "parts-per-million"
+    assert mc.unit_of("executor_steps_drained") == "count"
+
+
+_SCRAPE_SCRIPT = r"""
+import json
+import numpy as np
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.framework.program import Program, program_guard
+from paddle_tpu.optimizer import MomentumOptimizer
+from paddle_tpu.observe import (histogram, phases, prometheus_text,
+                                profiler_capture, slo, stat_time)
+
+# exercise a representative slice: train steps (executor/pass/phase
+# stats), SLO gauges, request-path histograms
+main, startup = Program(), Program()
+main.random_seed = 1
+with program_guard(main, startup):
+    x = layers.data("x", [16])
+    label = layers.data("label", [1], dtype="int64")
+    loss = layers.mean(layers.softmax_with_cross_entropy(
+        layers.fc(x, 10), label))
+    MomentumOptimizer(0.05, 0.9).minimize(loss)
+sc = pt.framework.Scope()
+exe = pt.Executor(pt.CPUPlace())
+exe.run(startup, scope=sc)
+rs = np.random.RandomState(0)
+for _ in range(3):
+    exe.run(main, feed={"x": rs.randn(4, 16).astype("f4"),
+                        "label": rs.randint(0, 10, (4, 1)).astype("int64")},
+            fetch_list=[loss], scope=sc)
+exe.close()
+stat_time("ttft_seconds", 0.01)
+slo.observe_request({"ttft_s": 0.01, "tpot_s": 0.001, "ok": True})
+slo.refresh_gauges()
+series = set()
+for line in prometheus_text().splitlines():
+    if line.startswith("# TYPE "):
+        series.add(line.split()[2])
+print(json.dumps(sorted(series)))
+"""
+
+
+def test_every_exported_series_has_a_catalog_row():
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=ROOT)
+    out = subprocess.run(
+        [sys.executable, "-c", _SCRAPE_SCRIPT], env=env, cwd=ROOT,
+        capture_output=True, text=True, timeout=240)
+    assert out.returncode == 0, out.stderr[-2000:]
+    series = json.loads(out.stdout.strip().splitlines()[-1])
+    assert len(series) > 20, "scrape produced implausibly few series"
+    missing = []
+    for m in series:
+        assert m.startswith("paddle_tpu_"), m
+        if mc.lookup(m[len("paddle_tpu_"):]) is None:
+            missing.append(m)
+    assert not missing, \
+        f"/metrics series without a METRICS.md row: {missing}"

@@ -1,0 +1,474 @@
+"""Step-phase attribution + anomaly-triggered profiler capture.
+
+observe/phases.py: the four-bucket wall-time decomposition (compute /
+comm_exposed / host / input_wait, summing exactly to the inter-drain
+wall), the deterministic compile-time cost model (hide-under-compute
+overlap walk), and the per-collective exposed-vs-hidden ledger keyed by
+FuseAllReducePass bucket identity.  observe/profiler_capture.py: the
+rolling-baseline spike trigger, the one-bundle-per-episode latch +
+cooldown, and the continuous low-duty-cycle mode.  All on the CPU
+backend: the measured split comes from real drain timestamps, the
+predicted split from static inputs only, so every assertion here is
+deterministic.
+"""
+import io
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.framework.program import Program, program_guard
+from paddle_tpu.monitor import stat_get
+from paddle_tpu.observe import phases, profiler_capture
+from paddle_tpu.optimizer import MomentumOptimizer
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _quench_slo_burn():
+    """Zero any slo_burn_rate_*_ppm gauges an earlier test file left in
+    the process-wide registry — the capture engine's SLO-burn trigger
+    reads them, so stale induced violations would fire captures here."""
+    from paddle_tpu.monitor import StatRegistry, stat_set
+
+    for name, _v in StatRegistry.instance().export():
+        if name.startswith("slo_burn_rate_") and name.endswith("_ppm"):
+            stat_set(name, 0)
+
+
+@pytest.fixture(autouse=True)
+def _clean_phase_plane():
+    """Fresh engines + default flags around every test."""
+    _quench_slo_burn()
+    phases.reset_phases()
+    profiler_capture.reset_capture()
+    yield
+    profiler_capture.reset_capture()
+    phases.reset_phases()
+    pt.set_flags({"FLAGS_phase_attribution": True,
+                  "FLAGS_phase_interconnect_gbps": 100.0,
+                  "FLAGS_prof_trigger_ratio": 0.0,
+                  "FLAGS_prof_capture_s": 2.0,
+                  "FLAGS_prof_cooldown_s": 60.0,
+                  "FLAGS_prof_continuous_s": 0.0,
+                  "FLAGS_device_peak_tflops": 275.0,
+                  "FLAGS_overlap_grad_allreduce": True,
+                  "FLAGS_layer_scan": False})
+
+
+def _mlp_program(depth=2, width=32, fleet_dp=False):
+    from paddle_tpu.distributed import fleet
+
+    main, startup = Program(), Program()
+    main.random_seed = 1
+    with program_guard(main, startup):
+        x = layers.data("x", [width])
+        label = layers.data("label", [1], dtype="int64")
+        h = x
+        for _ in range(depth):
+            h = layers.fc(h, width, act="relu")
+        logits = layers.fc(h, 10)
+        loss = layers.mean(
+            layers.softmax_with_cross_entropy(logits, label))
+        opt = MomentumOptimizer(0.05, 0.9)
+        if fleet_dp:
+            fleet.init(is_collective=True)
+            fleet.distributed_optimizer(opt)
+            fleet.minimize(loss)
+        else:
+            opt.minimize(loss)
+    return main, startup, loss
+
+
+def _train(main, startup, loss, steps=4, width=32, batch=8):
+    rs = np.random.RandomState(0)
+    scope = pt.framework.Scope()
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup, scope=scope)
+    for _ in range(steps):
+        exe.run(main, feed={
+            "x": rs.randn(batch, width).astype("f4"),
+            "label": rs.randint(0, 10, (batch, 1)).astype("int64")},
+            fetch_list=[loss], scope=scope)
+    exe.close()
+
+
+# ---------------------------------------------------------------------------
+# PhasePlan: the deterministic cost model
+# ---------------------------------------------------------------------------
+
+
+class TestPhasePlan:
+    def test_hide_under_compute_walk(self):
+        # peak 1 TFLOP/s, 1 GB/s: 1e9 FLOPs = 1s compute budget
+        pt.set_flags({"FLAGS_device_peak_tflops": 1e-3,
+                      "FLAGS_phase_interconnect_gbps": 1.0})
+        plan = phases.PhasePlan(1e9, [
+            {"id": "a", "op": "ar", "dtype": "f4",
+             "bytes": 400_000_000, "overlap": True},   # 0.4s, hides
+            {"id": "b", "op": "ar", "dtype": "f4",
+             "bytes": 800_000_000, "overlap": True},   # 0.8s, 0.6 budget
+            {"id": "c", "op": "ar", "dtype": "f4",
+             "bytes": 100_000_000, "overlap": False},  # never hides
+        ])
+        assert plan.compute_s == pytest.approx(1.0)
+        assert plan.comm_hidden_s == pytest.approx(0.4 + 0.6)
+        assert plan.comm_exposed_s == pytest.approx(0.2 + 0.1)
+        by_id = {r["id"]: r for r in plan.ledger}
+        assert by_id["a"]["hidden_s"] == pytest.approx(0.4)
+        assert by_id["b"]["exposed_s"] == pytest.approx(0.2)
+        assert by_id["c"]["exposed_s"] == pytest.approx(0.1)
+        fr = plan.predicted_fractions()
+        assert fr["compute"] + fr["comm_exposed"] == pytest.approx(1.0)
+
+    def test_update_flops_recosts_hidden_budget(self):
+        pt.set_flags({"FLAGS_device_peak_tflops": 1e-3,
+                      "FLAGS_phase_interconnect_gbps": 1.0})
+        coll = [{"id": "a", "op": "ar", "dtype": "f4",
+                 "bytes": 500_000_000, "overlap": True}]  # 0.5s
+        plan = phases.PhasePlan(1e8, coll)  # 0.1s budget: mostly exposed
+        assert plan.comm_hidden_s == pytest.approx(0.1)
+        plan.update_flops(1e9)  # 1s budget: fully hidden
+        assert plan.comm_hidden_s == pytest.approx(0.5)
+        assert plan.comm_exposed_s == pytest.approx(0.0)
+
+    def test_deterministic_across_builds(self):
+        coll = [{"id": "a", "op": "ar", "dtype": "f4",
+                 "bytes": 12345, "overlap": True}]
+        a = phases.PhasePlan(3e6, coll).to_dict()
+        b = phases.PhasePlan(3e6, coll).to_dict()
+        assert a == b
+
+
+# ---------------------------------------------------------------------------
+# PhaseEngine: the drain-side decomposition
+# ---------------------------------------------------------------------------
+
+
+class TestPhaseEngine:
+    def test_buckets_sum_exactly_to_wall(self):
+        eng = phases.PhaseEngine()
+        split = eng.on_step_drained(wall_s=0.10, sync_s=0.03,
+                                    host_s=0.02)
+        assert split is not None
+        assert sum(split.values()) == pytest.approx(0.10, abs=0)
+        assert split["host"] == pytest.approx(0.02)
+        assert split["compute"] == pytest.approx(0.03)  # no plan: all
+        assert split["input_wait"] == pytest.approx(0.05)
+        rep = eng.report()
+        assert sum(rep["measured_fractions"].values()) == \
+            pytest.approx(1.0, abs=5e-6)  # 4 fractions rounded to 6dp
+
+    def test_sync_splits_by_plan_comm_fraction(self):
+        pt.set_flags({"FLAGS_device_peak_tflops": 1e-3,
+                      "FLAGS_phase_interconnect_gbps": 1.0})
+        # compute 1s, exposed comm 1s -> predicted comm fraction 0.5
+        plan = phases.PhasePlan(1e9, [
+            {"id": "a", "op": "ar", "dtype": "f4",
+             "bytes": 1_000_000_000, "overlap": False}])
+        eng = phases.PhaseEngine()
+        split = eng.on_step_drained(wall_s=0.08, sync_s=0.04,
+                                    host_s=0.0, plan=plan)
+        assert split["comm_exposed"] == pytest.approx(0.02)
+        assert split["compute"] == pytest.approx(0.02)
+
+    def test_host_and_sync_clamped_to_wall(self):
+        eng = phases.PhaseEngine()
+        split = eng.on_step_drained(wall_s=0.01, sync_s=0.5, host_s=0.5)
+        assert sum(split.values()) == pytest.approx(0.01)
+        assert all(v >= 0 for v in split.values())
+
+    def test_compiled_steps_and_flag_off_are_skipped(self):
+        eng = phases.PhaseEngine()
+        assert eng.on_step_drained(0.1, 0.1, 0.0, compiled=True) is None
+        pt.set_flags({"FLAGS_phase_attribution": False})
+        assert eng.on_step_drained(0.1, 0.1, 0.0) is None
+        pt.set_flags({"FLAGS_phase_attribution": True})
+        assert eng.steps == 0
+
+    def test_reset_zeroes_report_and_gauges(self):
+        eng = phases.phase_engine()
+        eng.on_step_drained(0.1, 0.05, 0.01)
+        assert stat_get("phase_steps_attributed") >= 1
+        phases.reset_phases()
+        rep = phases.phases_report()
+        assert rep["steps"] == 0 and rep["wall_s"] == 0.0
+        assert stat_get("phase_compute_seconds_micro") == 0
+
+
+# ---------------------------------------------------------------------------
+# composition matrix: the split must hold on real compiled programs
+# ---------------------------------------------------------------------------
+
+
+class TestProgramComposition:
+    def _report_for(self, fleet_dp=False, **flag_over):
+        if flag_over:
+            pt.set_flags({f"FLAGS_{k}": v for k, v in flag_over.items()})
+        main, startup, loss = _mlp_program(
+            depth=6 if flag_over.get("layer_scan") else 2,
+            fleet_dp=fleet_dp)
+        _train(main, startup, loss)
+        return phases.phases_report()
+
+    def _assert_sane(self, rep):
+        assert rep["steps"] >= 3  # first (compile) drain skipped
+        # each of the 4 fractions is rounded to 6dp in the report, so
+        # the sum can be off by up to 2e-6
+        assert sum(rep["measured_fractions"].values()) == \
+            pytest.approx(1.0, abs=5e-6)
+        assert rep["wall_s"] > 0
+        assert all(v >= 0 for v in rep["measured_s"].values())
+
+    def test_plain_program(self):
+        rep = self._report_for()
+        self._assert_sane(rep)
+        # single device, no collectives: predicted split is all compute
+        assert rep["predicted"]["predicted_fractions"]["compute"] == 1.0
+        assert rep["ledger"] == []
+
+    def test_dp_fused_program_has_bucket_ledger(self, mesh8):
+        # slow modeled fabric so the tiny test grads price above the
+        # report's µs rounding
+        rep = self._report_for(fleet_dp=True,
+                               phase_interconnect_gbps=1e-3)
+        self._assert_sane(rep)
+        assert rep["ledger"], "dp grad allreduces must be priced"
+        assert any(r["id"].startswith("bucket:") for r in rep["ledger"])
+        assert rep["comm_exposed_s"] + rep["comm_hidden_s"] > 0
+        assert stat_get("comm_exposed_seconds_micro") >= 0
+
+    def test_scanned_program_overlap_hides_carrier(self, mesh8):
+        # big compute budget (tiny peak) so the stretched carrier
+        # bucket hides fully under the edge-layer backward
+        rep = self._report_for(fleet_dp=True, layer_scan=True,
+                               overlap_grad_allreduce=True,
+                               device_peak_tflops=1e-6,
+                               phase_interconnect_gbps=1e-3)
+        self._assert_sane(rep)
+        assert stat_get("pass_overlap_stretched_buckets") >= 1
+        hidden = [r for r in rep["ledger"] if r["hidden_s"] > 0]
+        assert hidden, "stretched bucket must be modeled hidden"
+        assert rep["comm_hidden_s"] > 0
+
+    def test_flash_attention_program(self):
+        pt.set_flags({"FLAGS_flash_attention": "always"})
+        try:
+            import math
+
+            from paddle_tpu.initializer import NormalInitializer
+            from paddle_tpu.param_attr import ParamAttr
+
+            S, HEADS, D = 8, 2, 8
+            HID = HEADS * D
+            main, startup = Program(), Program()
+            main.random_seed = 3
+            with program_guard(main, startup):
+                x = layers.data("x", [S, HID])
+                y = layers.data("y", [S, HID])
+
+                def proj(name):
+                    t = layers.fc(x, HID, num_flatten_dims=2, name=name,
+                                  param_attr=ParamAttr(
+                                      initializer=NormalInitializer(
+                                          0.0, 0.05)))
+                    t = layers.reshape(t, [0, S, HEADS, D])
+                    return layers.transpose(t, [0, 2, 1, 3])
+
+                q, k, v = proj("aq"), proj("ak"), proj("av")
+                scores = layers.matmul(q, k, transpose_y=True,
+                                       alpha=1.0 / math.sqrt(D))
+                probs = layers.softmax(scores)
+                ctx = layers.matmul(probs, v)
+                ctx = layers.reshape(
+                    layers.transpose(ctx, [0, 2, 1, 3]), [0, S, HID])
+                out = layers.fc(ctx, HID, num_flatten_dims=2)
+                loss = layers.mean(layers.square_error_cost(out, y))
+                MomentumOptimizer(0.05, 0.9).minimize(loss)
+            rs = np.random.RandomState(0)
+            scope = pt.framework.Scope()
+            exe = pt.Executor(pt.CPUPlace())
+            exe.run(startup, scope=scope)
+            for _ in range(4):
+                exe.run(main, feed={
+                    "x": rs.randn(2, S, HID).astype("f4"),
+                    "y": rs.randn(2, S, HID).astype("f4")},
+                    fetch_list=[loss], scope=scope)
+            exe.close()
+        finally:
+            pt.set_flags({"FLAGS_flash_attention": "auto"})
+        assert stat_get("pass_flash_attention_fused") >= 1
+        self._assert_sane(phases.phases_report())
+
+
+class TestOverlapAB:
+    def test_exposed_share_strictly_drops_with_stretching(self, mesh8):
+        """The acceptance A/B: on the scanned dp program, the ledger's
+        exposed share with FLAGS_overlap_grad_allreduce=1 is strictly
+        below the =0 baseline (deterministic: both sides are the
+        static cost model)."""
+        shares = {}
+        for overlap in (0, 1):
+            phases.reset_phases()
+            pt.set_flags({"FLAGS_overlap_grad_allreduce": bool(overlap),
+                          "FLAGS_layer_scan": True,
+                          "FLAGS_device_peak_tflops": 1e-6,
+                          "FLAGS_phase_interconnect_gbps": 1e-3})
+            main, startup, loss = _mlp_program(depth=6, fleet_dp=True)
+            _train(main, startup, loss, steps=3)
+            rep = phases.phases_report()
+            assert rep["comm_exposed_s"] + rep["comm_hidden_s"] > 0
+            shares[overlap] = rep["comm_exposed_share"]
+        assert shares[1] < shares[0], shares
+        assert shares[0] == pytest.approx(1.0)  # baseline hides nothing
+
+
+# ---------------------------------------------------------------------------
+# anomaly-triggered capture
+# ---------------------------------------------------------------------------
+
+
+class TestAnomalyCapture:
+    def _engine(self, tmp_path, ratio=2.0, cooldown=60.0):
+        pt.set_flags({"FLAGS_prof_trigger_ratio": ratio,
+                      "FLAGS_prof_capture_s": 0.02,
+                      "FLAGS_prof_cooldown_s": cooldown,
+                      "FLAGS_postmortem_dir": str(tmp_path / "pm")})
+        return profiler_capture.CaptureEngine(window=16, warmup=4)
+
+    def test_spike_fires_exactly_one_bounded_capture(self, tmp_path):
+        eng = self._engine(tmp_path)
+        for _ in range(8):
+            eng.on_step(0.010)
+        for _ in range(5):        # sustained episode: latch holds
+            eng.on_step(0.100)
+        assert eng.wait(30)
+        assert eng.captures == 1
+        assert len(eng.bundles) == 1
+        bundle = eng.bundles[0]
+        assert os.path.basename(bundle).endswith("step_time_anomaly")
+        ph = json.load(open(os.path.join(bundle, "phases.json")))
+        assert set(ph) >= {"steps", "measured_fractions", "ledger"}
+        meta = json.load(open(os.path.join(bundle, "meta.json")))
+        assert "baseline" in meta["extra"]["trigger"]
+        assert meta["extra"]["prof_capture_s"] == pytest.approx(0.02)
+        assert stat_get("prof_captures_triggered") >= 1
+
+    def test_latch_rearms_but_cooldown_blocks_refire(self, tmp_path):
+        eng = self._engine(tmp_path, cooldown=3600.0)
+        for _ in range(8):
+            eng.on_step(0.010)
+        eng.on_step(0.100)        # fire #1
+        assert eng.wait(30)
+        for _ in range(4):
+            eng.on_step(0.010)    # episode over: re-arms
+        eng.on_step(0.100)        # would fire, but inside cooldown
+        assert eng.wait(30)
+        assert eng.captures == 1
+
+    def test_compiled_steps_never_feed_or_fire(self, tmp_path):
+        eng = self._engine(tmp_path)
+        for _ in range(8):
+            eng.on_step(0.010)
+        eng.on_step(10.0, compiled=True)  # a recompile is not a spike
+        assert eng.wait(5)
+        assert eng.captures == 0
+
+    def test_zero_ratio_disables(self, tmp_path):
+        eng = self._engine(tmp_path, ratio=0.0)
+        for _ in range(20):
+            eng.on_step(0.010)
+        eng.on_step(9.9)
+        assert eng.captures == 0
+
+    def test_executor_spike_to_rendered_bundle(self, tmp_path):
+        """End to end: an induced inter-drain stall on a real training
+        loop produces exactly one bundle whose phases.json renders
+        through the pure-stdlib CLI reader."""
+        pt.set_flags({"FLAGS_prof_trigger_ratio": 4.0,
+                      "FLAGS_prof_capture_s": 0.05,
+                      "FLAGS_postmortem_dir": str(tmp_path / "pm")})
+        main, startup, loss = _mlp_program()
+        rs = np.random.RandomState(0)
+        scope = pt.framework.Scope()
+        exe = pt.Executor(pt.CPUPlace())
+        exe.run(startup, scope=scope)
+
+        def step():
+            exe.run(main, feed={
+                "x": rs.randn(8, 32).astype("f4"),
+                "label": rs.randint(0, 10, (8, 1)).astype("int64")},
+                fetch_list=[loss], scope=scope)
+
+        for _ in range(12):
+            step()
+        time.sleep(0.25)  # the anomaly: one slow inter-drain gap
+        step()
+        for _ in range(3):
+            step()
+        exe.close()
+        eng = profiler_capture.capture_engine()
+        assert eng.wait(30)
+        assert eng.captures == 1, "latch+cooldown: one bundle only"
+        from tools import postmortem as pm
+
+        out = io.StringIO()
+        pm.render(eng.bundles[0], out=out)
+        text = out.getvalue()
+        assert "phase attribution" in text
+        assert "step_time_anomaly" in text
+
+    def test_continuous_mode_smoke_and_rotation(self, tmp_path):
+        pt.set_flags({"FLAGS_prof_continuous_s": 0.05,
+                      "FLAGS_prof_capture_s": 0.01,
+                      "FLAGS_postmortem_dir": str(tmp_path / "pm")})
+        eng = profiler_capture.capture_engine()
+        assert eng.start_continuous()
+        assert eng.start_continuous()  # idempotent
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and \
+                stat_get("prof_continuous_captures") + \
+                stat_get("prof_trace_unavailable") < 2:
+            time.sleep(0.05)
+        eng.stop_continuous()
+        n = stat_get("prof_continuous_captures")
+        if n == 0:
+            pytest.skip("backend cannot trace (prof_trace_unavailable)")
+        root = str(tmp_path / "pm" / "prof_continuous")
+        slots = os.listdir(root)
+        assert set(slots) <= {"window_0", "window_1"}  # 2-deep bound
+
+    def test_continuous_off_by_default(self):
+        assert not profiler_capture.maybe_start_continuous()
+
+
+class TestPureObserver:
+    def test_attribution_off_is_bitwise_identical(self):
+        """FLAGS_phase_attribution must not touch numerics: the same
+        seeded program yields bitwise-equal losses with the plane on
+        and off."""
+        losses = {}
+        for on in (True, False):
+            pt.set_flags({"FLAGS_phase_attribution": on})
+            phases.reset_phases()
+            main, startup, loss = _mlp_program()
+            rs = np.random.RandomState(7)
+            scope = pt.framework.Scope()
+            exe = pt.Executor(pt.CPUPlace())
+            exe.run(startup, scope=scope)
+            vals = []
+            for _ in range(3):
+                out = exe.run(main, feed={
+                    "x": rs.randn(8, 32).astype("f4"),
+                    "label": rs.randint(0, 10, (8, 1)).astype("int64")},
+                    fetch_list=[loss], scope=scope)
+                vals.append(np.asarray(out[0]).copy())
+            exe.close()
+            losses[on] = np.stack(vals)
+        assert np.array_equal(losses[True], losses[False])
+        rep = phases.phases_report()
+        assert rep["steps"] == 0  # the off run attributed nothing

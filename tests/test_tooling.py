@@ -38,3 +38,68 @@ def test_op_spec_counts_grads():
     assert len(spec) >= 350
     kinds = {ln.split()[1] for ln in spec}
     assert kinds <= {"explicit_grad", "grad_maker", "generic_vjp"}
+
+
+# ---------------------------------------------------------------------------
+# tools/bench_diff.py against the checked-in bench rounds
+# ---------------------------------------------------------------------------
+
+
+def _bench_diff(*args):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.run(
+        [sys.executable, "-m", "tools.bench_diff", *args],
+        capture_output=True, text=True, env=env, cwd=ROOT)
+
+
+def test_bench_diff_clean_rounds_improvement():
+    """r02 -> r03 is the PR-3 throughput jump: both rounds clean, no
+    regression, exit 0, and the improvement is flagged."""
+    p = _bench_diff("BENCH_r02.json", "BENCH_r03.json")
+    assert p.returncode == 0, p.stderr
+    assert "no regressions past threshold" in p.stdout
+    assert "improved" in p.stdout
+    assert "caveat" not in p.stdout
+
+
+def test_bench_diff_broken_round_is_advisory_not_a_failure():
+    """r05 is the dead-device round (preflight timeout, every metric
+    zeroed): the -100% 'regression' must be downgraded to advisory —
+    exit 0 — with the caveat printed."""
+    p = _bench_diff("BENCH_r03.json", "BENCH_r05.json")
+    assert p.returncode == 0, p.stderr
+    assert "caveat [B]" in p.stdout
+    assert "ADVISORY" in p.stdout
+
+
+def test_bench_diff_real_regression_fails(tmp_path):
+    import json
+
+    a = tmp_path / "a.json"
+    b = tmp_path / "b.json"
+    a.write_text(json.dumps({"resnet50_images_per_sec": 1000.0,
+                             "vs_baseline": 1.0, "status": "ok"}))
+    b.write_text(json.dumps({"resnet50_images_per_sec": 800.0,
+                             "vs_baseline": 0.8, "status": "ok"}))
+    p = _bench_diff(str(a), str(b))
+    assert p.returncode == 1, p.stdout
+    assert "REGRESSION" in p.stdout
+    # json mode carries the same verdict for machines
+    pj = _bench_diff(str(a), str(b), "--json")
+    doc = json.loads(pj.stdout)
+    assert doc["advisory"] is False
+    assert "resnet50_images_per_sec" in doc["regressions"]
+
+
+def test_bench_diff_threshold_is_respected(tmp_path):
+    import json
+
+    a = tmp_path / "a.json"
+    b = tmp_path / "b.json"
+    a.write_text(json.dumps({"bert_base_tokens_per_sec": 100.0}))
+    b.write_text(json.dumps({"bert_base_tokens_per_sec": 93.0}))
+    assert _bench_diff(str(a), str(b), "--threshold",
+                       "0.10").returncode == 0
+    assert _bench_diff(str(a), str(b), "--threshold",
+                       "0.05").returncode == 1

@@ -566,11 +566,18 @@ class TestConcurrentScrapeWithXlaGauges:
         """4 scrapers x 25 GETs over real HTTP while a thread feeds
         compile records (compile_seconds histogram + hbm/executable
         gauges): every exposition must stay well-formed and carry the
-        new series."""
+        new series — including the phase-attribution and profiler-
+        capture gauges."""
         from paddle_tpu.distributed.fleet.utils.http_server import KVServer
+        from paddle_tpu.monitor import stat_set
+        from paddle_tpu.observe import phases as phases_mod
 
         # seed one record so the first scrape already sees the series
         xla_stats.on_compile(_FakeCompiled(), seconds=0.01)
+        phases_mod.reset_phases()
+        phases_mod.phase_engine().on_step_drained(
+            wall_s=0.01, sync_s=0.005, host_s=0.001)
+        stat_set("prof_capture_latched", 0)
         srv = KVServer(0)
         srv.start()
         stop = threading.Event()
@@ -599,6 +606,11 @@ class TestConcurrentScrapeWithXlaGauges:
                     assert "paddle_tpu_compile_seconds_bucket" in body
                     assert "paddle_tpu_hbm_required_bytes" in body
                     assert "paddle_tpu_executable_size_bytes" in body
+                    assert "paddle_tpu_phase_compute_seconds_micro" \
+                        in body
+                    assert "paddle_tpu_phase_compute_fraction_ppm" \
+                        in body
+                    assert "paddle_tpu_prof_capture_latched" in body
                 except Exception as e:  # noqa: BLE001
                     errors.append(e)
 

@@ -28,7 +28,7 @@ from typing import List, Optional
 
 BUNDLE_FILES = ("meta.json", "stacks.txt", "trace.json", "metrics.prom",
                 "flight.jsonl", "flags.json", "memory.json",
-                "requests.json")
+                "requests.json", "phases.json")
 
 
 def _mb(nbytes) -> float:
@@ -205,6 +205,40 @@ def render(bundle: str, tail: int = 15, stacks: bool = False,
               f"used {_mb(g.get('hbm_used_bytes'))} MB, "
               f"limit {_mb(g.get('hbm_limit_bytes'))} MB\n")
 
+    # -- step-phase attribution (observe/phases.py) ------------------------
+    ph = _read_json(os.path.join(bundle, "phases.json"))
+    if ph is not None and ph.get("steps"):
+        w(f"\nphase attribution ({ph['steps']} steps, "
+          f"{ph.get('wall_s', 0)}s wall):\n")
+        fr = ph.get("measured_fractions") or {}
+        secs = ph.get("measured_s") or {}
+        for b in ("compute", "comm_exposed", "host", "input_wait"):
+            if b in fr:
+                w(f"  {b:<12} {fr[b] * 100:>6.1f}%  "
+                  f"({secs.get(b, 0)}s)\n")
+        pred = (ph.get("predicted") or {}).get("predicted_fractions")
+        if pred:
+            w(f"  predicted:   compute {pred.get('compute', 0) * 100:.1f}% "
+              f"/ exposed-comm {pred.get('comm_exposed', 0) * 100:.1f}%\n")
+        total = ph.get("comm_exposed_s", 0) + ph.get("comm_hidden_s", 0)
+        if total:
+            w(f"  comm: {ph.get('comm_exposed_s')}s exposed / "
+              f"{ph.get('comm_hidden_s')}s hidden "
+              f"(share {ph.get('comm_exposed_share', 0) * 100:.1f}% "
+              f"exposed)\n")
+        rows = (ph.get("ledger") or [])[:8]
+        if rows:
+            width = max(len(str(r.get("id", "?"))) for r in rows)
+            w(f"  top collectives ({len(ph.get('ledger') or [])}):\n")
+            w(f"    {'id':<{width}}  {'MB/step':>8}  {'exposed s':>10}  "
+              f"{'hidden s':>9}  overlap\n")
+            for r in rows:
+                w(f"    {str(r.get('id', '?')):<{width}}  "
+                  f"{_mb(r.get('bytes_per_step')):>8}  "
+                  f"{round(r.get('exposed_s', 0), 6):>10}  "
+                  f"{round(r.get('hidden_s', 0), 6):>9}  "
+                  f"{'yes' if r.get('overlap') else 'no'}\n")
+
     # -- per-request traces + SLO verdict (observe/request_trace + slo) ----
     rq = _read_json(os.path.join(bundle, "requests.json"))
     if rq is not None:
@@ -241,7 +275,8 @@ def render(bundle: str, tail: int = 15, stacks: bool = False,
                 "overlap_", "pp_", "pipeline_scan",
                 "collective_matmul", "pass_overlap_stretched",
                 "emb_", "dlrm_", "flash_attn_", "prefill_pad",
-                "pass_flash_attention")
+                "pass_flash_attention", "phase_", "prof_",
+                "comm_exposed", "comm_hidden")
         for ln in rows:
             if metrics or any(k in ln for k in keys):
                 w(f"  {ln}\n")
