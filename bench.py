@@ -1494,6 +1494,263 @@ def bench_decode(pt, jax):
     }
 
 
+DISAGG_REQS = 24
+
+
+def bench_disagg(pt, jax):
+    """Disaggregated prefill/decode serving (serving/disagg.py), four
+    legs, each asserted in-bench:
+
+    1. **Migration oracle**: the same seeded request served
+       disaggregated (prefill replica -> KV-page migration -> decode
+       replica) must produce BITWISE the tokens of a local
+       prefill+decode — plain and kv_quant pools both.
+    2. **Goodput A/B at a FIXED fleet of 2**: a mixed
+       long-prompt-adversary / short-chat Poisson stream through a
+       1 prefill + 1 decode DisaggServer vs a 2-replica unified
+       DecodeServer running chunked prefill (the best co-located
+       mitigation).  Goodput counts requests whose per-request TPOT
+       stays within 2x the idle-engine decode floor — the quantity a
+       co-located long prefill steals and disaggregation protects.
+       Disagg must win goodput, and its ttft p99 must HOLD (<= 1.5x
+       unified) — the decode-side win cannot come from starving
+       prefill.
+    3. **Chaos**: a prefill replica hard-killed mid-stream
+       (``kill_prefill_replica``) must drop ZERO requests — the router
+       re-dispatches the orphaned legs to the survivor.
+    4. **Autoscaler**: real induced ttft burn (an impossible SLO
+       objective over real traffic) must re-role a decode replica to
+       the prefill set via the REAL burn signal, and the cooldown must
+       suppress the immediate retrigger (no flapping).
+    """
+    import gc
+
+    from paddle_tpu.distributed.fleet.elastic import chaos
+    from paddle_tpu.monitor import stat_get
+    from paddle_tpu.observe import slo as slo_mod
+    from paddle_tpu.serving.decode import (DecodeConfig, DecodeEngine,
+                                           TransformerLM)
+    from paddle_tpu.serving.disagg import (Autoscaler, DisaggConfig,
+                                           DisaggServer)
+    from paddle_tpu.serving.server import DecodeServer
+
+    model = TransformerLM(vocab_size=DECODE_VOCAB, d_model=64,
+                          num_layers=2, num_heads=2, max_seq_len=256)
+    weights = model.init_weights(jax.random.PRNGKey(1))
+
+    # -- leg 1: migrated-vs-local bitwise oracle -------------------------
+    def bitwise_leg(kv_quant):
+        cfg = DecodeConfig(slots=2, max_seq_len=32, page_size=8,
+                           prefix_cache=False, kv_quant=kv_quant)
+        prompts = [[5, 4, 3, 2, 1, 6, 7, 8], list(range(1, 14))]
+        srv = DisaggServer(model, weights, config=cfg,
+                           disagg=DisaggConfig(prefill_replicas=1,
+                                               decode_replicas=1))
+        with srv:
+            rr = [srv.submit(p, max_new_tokens=4, temperature=1.0,
+                             seed=40 + i)
+                  for i, p in enumerate(prompts)]
+            douts = [r.result(timeout=300) for r in rr]
+        eng = DecodeEngine(model, weights, cfg).start()
+        try:
+            louts = [eng.submit(p, max_new_tokens=4, temperature=1.0,
+                                seed=40 + i).result(timeout=300)
+                     for i, p in enumerate(prompts)]
+        finally:
+            eng.stop()
+        if douts != louts:
+            raise RuntimeError(
+                f"migrated decode diverged from local prefill "
+                f"(kv_quant={kv_quant}): {douts} vs {louts}")
+
+    bitwise_leg(False)
+    bitwise_leg(True)
+    gc.collect()
+
+    # -- leg 2: goodput A/B at a fixed fleet of 2 ------------------------
+    rs = np.random.RandomState(23)
+    # every other request is a 48-token adversary (6 pages of prefill);
+    # the rest are short chats whose decode stream is what the
+    # co-located prefills interrupt
+    schedule = []
+    for i in range(DISAGG_REQS):
+        if i % 2 == 0:
+            prompt, n_new = list(rs.randint(1, DECODE_VOCAB, 48)), 4
+        else:
+            prompt = list(rs.randint(1, DECODE_VOCAB,
+                                     rs.randint(2, 7)))
+            n_new = 16
+        schedule.append((prompt, n_new, float(rs.exponential(0.002))))
+
+    def _cfg(chunked):
+        # unified replicas chunk their prefills (protecting co-located
+        # decoders is the point of chunking); the dedicated prefill
+        # replica has no decoders to protect and runs whole-prompt
+        # prefill — each system gets its best configuration
+        return DecodeConfig(slots=8, max_seq_len=64, page_size=8,
+                            max_queue=DISAGG_REQS + 8,
+                            prefix_cache=False,
+                            prefill_chunk_pages=1 if chunked else 0)
+
+    # the goodput budget: 2x the pure-decode TPOT floor of an idle warm
+    # engine — requests a co-located prefill pushed past that lost the
+    # latency the disaggregation is buying
+    eng = DecodeEngine(model, weights, _cfg(False)).start()
+    try:
+        eng.generate([1, 2], max_new_tokens=33)  # pay the compiles
+        r = eng.submit([1, 2], max_new_tokens=33)
+        r.result(timeout=300)
+        t_base = (r.t_last_token - r.t_first_token) / 32
+    finally:
+        eng.stop()
+    tpot_budget = 2.0 * t_base
+
+    def phase_metrics(reqs, wall):
+        ttfts = sorted(r.t_first_token - r.t_enqueue for r in reqs)
+        p99 = ttfts[min(len(ttfts) - 1,
+                        int(math.ceil(0.99 * len(ttfts))))]
+        good = 0
+        for r in reqs:
+            dr = getattr(r, "decode_request", r)
+            n = len(dr.generated)
+            if n >= 2 and dr.t_last_token is not None \
+                    and dr.t_first_token is not None:
+                tpot = (dr.t_last_token - dr.t_first_token) / (n - 1)
+            else:
+                tpot = 0.0
+            good += tpot <= tpot_budget
+        return {"goodput_rps": good / wall, "ttft_ms_p99": 1e3 * p99}
+
+    def run_stream(submit):
+        reqs = []
+        t0 = time.perf_counter()
+        for i, (prompt, n_new, gap) in enumerate(schedule):
+            time.sleep(gap)  # open loop: arrivals don't wait
+            reqs.append(submit(prompt, max_new_tokens=n_new, seed=i))
+        for r in reqs:
+            r.result(timeout=600)
+        return reqs, time.perf_counter() - t0
+
+    usrv = DecodeServer(model, weights, _cfg(True), replicas=2)
+    usrv.start()
+    try:
+        for e in usrv._engines:  # warm both replicas' executables
+            e.generate(schedule[0][0], max_new_tokens=2)
+            e.generate([1, 2, 3], max_new_tokens=2)
+        ureqs, uwall = run_stream(usrv.submit)
+    finally:
+        usrv.stop()
+    uni = phase_metrics(ureqs, uwall)
+    gc.collect()
+
+    dsrv = DisaggServer(model, weights, config=_cfg(False),
+                        disagg=DisaggConfig(prefill_replicas=1,
+                                            decode_replicas=1))
+    with dsrv:
+        dsrv.generate(schedule[0][0], max_new_tokens=2)  # warm both
+        dsrv.generate([1, 2, 3], max_new_tokens=2)       # roles' paths
+        dreqs, dwall = run_stream(dsrv.submit)
+        dstats = dsrv.stats()
+    dis = phase_metrics(dreqs, dwall)
+    gc.collect()
+
+    if dis["goodput_rps"] <= uni["goodput_rps"]:
+        raise RuntimeError(
+            f"disaggregation did not beat the unified fleet on decode "
+            f"goodput at a fixed replica count "
+            f"({dis['goodput_rps']:.3f} <= {uni['goodput_rps']:.3f} "
+            f"rps, tpot budget {tpot_budget * 1e3:.2f}ms)")
+    if dis["ttft_ms_p99"] > 1.5 * uni["ttft_ms_p99"]:
+        raise RuntimeError(
+            f"disagg ttft p99 did not hold under the long-prompt "
+            f"adversary ({dis['ttft_ms_p99']:.1f}ms vs unified "
+            f"{uni['ttft_ms_p99']:.1f}ms with chunked prefill alone)")
+
+    # -- leg 3: chaos — prefill replica death, zero drops ----------------
+    deaths0 = stat_get("disagg_replica_deaths")
+    redisp0 = stat_get("disagg_redispatches_total")
+    chaos.clear()
+    chaos.inject("kill_prefill_replica", count=1, replica=0)
+    try:
+        csrv = DisaggServer(model, weights, config=_cfg(False),
+                            disagg=DisaggConfig(prefill_replicas=2,
+                                                decode_replicas=1))
+        with csrv:
+            rr = [csrv.submit([3 + i, 5, 7, 9, 2], max_new_tokens=4,
+                              seed=50 + i) for i in range(6)]
+            outs = [r.result(timeout=600) for r in rr]
+    finally:
+        chaos.clear()
+    chaos_dropped = sum(1 for o in outs if len(o) != 4)
+    if chaos_dropped:
+        raise RuntimeError(
+            f"prefill replica death dropped {chaos_dropped}/6 requests "
+            f"— the re-dispatch path is broken")
+    chaos_deaths = stat_get("disagg_replica_deaths") - deaths0
+    chaos_redispatches = stat_get("disagg_redispatches_total") - redisp0
+    gc.collect()
+
+    # -- leg 4: autoscaler re-role under REAL induced burn ---------------
+    # an impossible ttft objective makes every completed request a
+    # violation, so the DEFAULT burn signal (observe/slo.py snapshot)
+    # fires — nothing about the trigger is simulated except the SLO bar
+    slo_mod.configure([
+        slo_mod.Objective("ttft_p99", "ttft", 1e-6, 0.01)])
+    try:
+        asrv = DisaggServer(
+            model, weights,
+            config=DecodeConfig(slots=2, max_seq_len=32, page_size=8,
+                                prefix_cache=False),
+            disagg=DisaggConfig(prefill_replicas=1, decode_replicas=3,
+                                autoscale_cooldown_s=3600.0))
+        with asrv:
+            rr = [asrv.submit([9, 8, 7], max_new_tokens=4, seed=70 + i)
+                  for i in range(4)]
+            for r in rr:
+                r.result(timeout=600)
+            auto = Autoscaler(asrv, queue_fn=lambda: 0.0,
+                              preflight=lambda: True)
+            reroles0 = stat_get("autoscale_reroles_total")
+            skips0 = stat_get("autoscale_cooldown_skips_total")
+            first = auto.tick()
+            second = auto.tick()
+    finally:
+        slo_mod.configure(None)
+    if first != "decode->prefill":
+        raise RuntimeError(
+            f"induced ttft burn did not re-role a decode replica "
+            f"(tick -> {first!r})")
+    if second is not None \
+            or stat_get("autoscale_cooldown_skips_total") != skips0 + 1:
+        raise RuntimeError(
+            "the cooldown did not suppress the immediate re-trigger — "
+            "the autoscaler flapped")
+    autoscale_reroles = stat_get("autoscale_reroles_total") - reroles0
+    gc.collect()
+
+    return {
+        "disagg_migrated_bitwise_ok": 1,
+        "disagg_goodput_rps": round(dis["goodput_rps"], 3),
+        "unified_goodput_rps": round(uni["goodput_rps"], 3),
+        "disagg_goodput_improvement": round(
+            dis["goodput_rps"] / max(uni["goodput_rps"], 0.001), 3),
+        "disagg_ttft_ms_p99": round(dis["ttft_ms_p99"], 3),
+        "unified_ttft_ms_p99": round(uni["ttft_ms_p99"], 3),
+        "disagg_ttft_p99_improvement": round(
+            uni["ttft_ms_p99"] / max(dis["ttft_ms_p99"], 1e-9), 3),
+        "disagg_tpot_budget_ms": round(tpot_budget * 1e3, 3),
+        "disagg_handoffs": int(dstats["handoffs_total"]),
+        "disagg_migrate_pages": int(dstats["migrate_pages_total"]),
+        "disagg_migrate_bytes": int(dstats["migrate_bytes_total"]),
+        "disagg_chaos_dropped": int(chaos_dropped),
+        "disagg_chaos_replica_deaths": int(chaos_deaths),
+        "disagg_chaos_redispatches": int(chaos_redispatches),
+        "autoscale_reroles": int(autoscale_reroles),
+        "autoscale_cooldown_skips": int(
+            stat_get("autoscale_cooldown_skips_total") - skips0),
+    }
+
+
 def bench_quant(pt, jax):
     """Weight-only quantized inference (slim PostTrainingWeightQuantPass
     + ops/quant_ops.dequant_matmul): a matmul-heavy inference program
@@ -2341,6 +2598,13 @@ def main():
         result.update(bench_decode(pt, jax))
     except Exception as e:
         errors["decode"] = f"{type(e).__name__}: {e}"[:500]
+    try:
+        # disaggregated serving (ISSUE 19): migrated-page bitwise
+        # oracle, fixed-fleet goodput/ttft A/B vs unified chunked
+        # prefill, chaos zero-drop leg, autoscaler burn re-role
+        result.update(bench_disagg(pt, jax))
+    except Exception as e:
+        errors["disagg"] = f"{type(e).__name__}: {e}"[:500]
     try:
         # weight-only quantized inference: hbm_required_bytes ratio +
         # the measured quality tax (quant_quality_delta)

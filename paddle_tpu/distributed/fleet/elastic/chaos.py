@@ -24,6 +24,11 @@ continuously by tests and the ``bench.py`` chaos leg:
 - ``preflight_init_timeout`` (no params): one preflight probe reports
   ``init_timeout`` without spawning the subprocess — the r04/r05
   "device init did not complete" failure on demand.
+- ``kill_prefill_replica`` (params ``replica``): the disaggregated
+  serving router (``serving/disagg.py``) hard-stops the named prefill
+  replica at its handoff hook — the in-flight prefill dies with
+  ``ServerClosedError`` and the router's re-dispatch path must finish
+  the request on a survivor with zero drops.
 
 Arming is explicit (:func:`inject`) and consumption is counted: a
 fault fires ``count`` times then disarms (``count=-1`` = until
@@ -43,7 +48,8 @@ __all__ = ["FAULTS", "RankKilled", "TornCheckpoint", "inject", "clear",
            "armed", "take", "step_hook", "checkpoint_fault_hook"]
 
 FAULTS = ("kill_rank_mid_step", "hang_device_call", "torn_checkpoint",
-          "heartbeat_blackhole", "preflight_init_timeout")
+          "heartbeat_blackhole", "preflight_init_timeout",
+          "kill_prefill_replica")
 
 
 class RankKilled(RuntimeError):

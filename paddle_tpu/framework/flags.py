@@ -468,3 +468,63 @@ define_flag("flight_recorder_max_mb", 0.0,
             "it is rotated to <path>.1 (one previous segment kept, so "
             "the post-crash tail always spans >= this much history); "
             "0 = unbounded (the pre-rotation behavior)")
+define_flag("disagg_prefill_replicas", 1,
+            "disaggregated serving (paddle_tpu.serving.disagg): "
+            "replicas in the PREFILL set of a DisaggServer — they run "
+            "only (chunked) prefill + first-token sampling, then hand "
+            "the request's KV pages off to a decode replica; the "
+            "DistServe/Mooncake split that stops long prefills from "
+            "stealing decode step time")
+define_flag("disagg_decode_replicas", 1,
+            "disaggregated serving: replicas in the DECODE set — they "
+            "admit requests by INSTALLING migrated KV pages (no "
+            "prefill compute) and emit from the first decode step; "
+            "tokens stay bitwise-equal to a local prefill because the "
+            "migrated admission reuses the full-prefix-hit contract "
+            "(lengths start at prompt-1, same fold_in(key, 0) "
+            "sampling)")
+define_flag("disagg_migrate_host_bounce", False,
+            "disaggregated serving: force KV-page migration through "
+            "host memory (np.asarray out / device_put in) even when "
+            "prefill and decode replicas share a process/backend — "
+            "the cross-host transport path, also the A/B knob for "
+            "measuring migration overhead; off = device-to-device "
+            "pool-slice copy when possible")
+define_flag("disagg_handoff_timeout_s", 120.0,
+            "disaggregated serving: how long the router waits for a "
+            "prefill replica to finish one request's prefill leg "
+            "before treating the replica as failed and re-dispatching "
+            "the request (counted disagg_redispatches_total)")
+define_flag("disagg_redispatch_retries", 2,
+            "disaggregated serving: how many times the router "
+            "re-dispatches one request after a prefill-replica "
+            "failure (death, timeout, lost payload) before failing "
+            "the request to the client; each retry picks a surviving "
+            "replica, so a killed replica drops zero requests while "
+            "any prefill capacity remains")
+define_flag("disagg_autoscale_interval_s", 1.0,
+            "disagg autoscaler: seconds between policy ticks of the "
+            "background Autoscaler thread (Autoscaler.serve_forever); "
+            "each tick reads SLO burn + queue depths and may re-role "
+            "at most one replica")
+define_flag("disagg_autoscale_cooldown_s", 30.0,
+            "disagg autoscaler: minimum seconds between two re-roles "
+            "— the anti-flap floor; a trigger firing inside the "
+            "window is counted (autoscale_cooldown_skips_total) and "
+            "dropped, never queued")
+define_flag("disagg_autoscale_burn_high", 1.0,
+            "disagg autoscaler: ttft-objective SLO burn rate at/above "
+            "which a decode replica is re-roled into the prefill set "
+            "(prefill capacity is what ttft burn starves); paired "
+            "with disagg_autoscale_burn_low as hysteresis so the two "
+            "thresholds can never chase each other")
+define_flag("disagg_autoscale_burn_low", 0.25,
+            "disagg autoscaler: ttft burn rate at/below which the "
+            "prefill side is considered healthy enough to GIVE UP a "
+            "replica to the decode set (only then does decode queue "
+            "pressure trigger a prefill->decode re-role) — the lower "
+            "half of the hysteresis band")
+define_flag("disagg_autoscale_queue_high", 4,
+            "disagg autoscaler: mean decode-replica queue depth "
+            "at/above which (with prefill burn under burn_low) a "
+            "prefill replica is re-roled into the decode set")

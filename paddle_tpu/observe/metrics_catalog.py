@@ -76,6 +76,9 @@ RULES = (
          "Time per output token (SLO input)", exact=True),
     Rule("emb_lookup_seconds", "histogram", "embedding",
          "Sharded-embedding lookup (gather+alltoall)", exact=True),
+    Rule("migrate_seconds", "histogram", "disagg",
+         "One KV-page migration install (gather->scatter)",
+         exact=True),
     # -- executor / compile plane ---------------------------------------
     Rule("executor_", "gauge", "executor",
          "Dispatch/drain/cache counters of the Executor hot path"),
@@ -147,6 +150,16 @@ RULES = (
          "Chunked-prefill padding/live token accounting"),
     Rule("spec_", "gauge", "serving",
          "Speculative-decoding acceptance rates"),
+    # -- disaggregated serving (serving/disagg.py) ------------------------
+    Rule("migrate_", "gauge", "disagg",
+         "KV-page migration traffic (pages/bytes, device vs "
+         "host-bounce transport)"),
+    Rule("disagg_", "gauge", "disagg",
+         "Disagg router lifecycle: handoffs, re-dispatches, replica "
+         "deaths, role-set sizes"),
+    Rule("autoscale_", "gauge", "disagg",
+         "SLO-driven re-roling: re-roles, cooldown skips, preflight "
+         "failures, observed burn/queue signals"),
 )
 
 _UNIT_SUFFIXES = (

@@ -41,22 +41,35 @@ from .decode import (  # noqa: F401
     DecodeRequest,
     TransformerLM,
 )
+from .disagg import (  # noqa: F401
+    Autoscaler,
+    DisaggConfig,
+    DisaggRequest,
+    DisaggServer,
+)
 from .kv_cache import (  # noqa: F401
     CacheConfig,
     CacheExhaustedError,
+    KVPageExport,
     PagedKVCache,
     PageAllocator,
     PrefixIndex,
 )
-from .server import DecodeServer, Server, ServingConfig  # noqa: F401
+from .server import (  # noqa: F401
+    DecodeServer,
+    Server,
+    ServingConfig,
+    least_loaded_order,
+)
 
 __all__ = [
-    "Batcher", "BucketSpec", "CacheConfig", "CacheExhaustedError",
-    "DeadlineExceededError", "DecodeConfig", "DecodeEngine",
-    "DecodeRequest", "DecodeServer", "InferenceRequest", "PageAllocator",
-    "PagedKVCache", "PrefixIndex", "QueueFullError",
-    "RequestAbandonedError", "RequestBase",
+    "Autoscaler", "Batcher", "BucketSpec", "CacheConfig",
+    "CacheExhaustedError", "DeadlineExceededError", "DecodeConfig",
+    "DecodeEngine", "DecodeRequest", "DecodeServer", "DisaggConfig",
+    "DisaggRequest", "DisaggServer", "InferenceRequest",
+    "KVPageExport", "PageAllocator", "PagedKVCache", "PrefixIndex",
+    "QueueFullError", "RequestAbandonedError", "RequestBase",
     "RequestTooLargeError", "Server", "ServerClosedError",
     "ServingConfig", "ServingError", "TransformerLM",
-    "prefill_bucket_grid",
+    "least_loaded_order", "prefill_bucket_grid",
 ]

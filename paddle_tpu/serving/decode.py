@@ -229,14 +229,15 @@ class DecodeRequest(RequestBase):
     __slots__ = ("prompt", "max_new_tokens", "temperature", "top_k",
                  "top_p", "seed", "on_token", "generated", "_stream",
                  "t_first_token", "t_last_token", "record_logits",
-                 "logits_trace", "speculative", "finish_reason")
+                 "logits_trace", "speculative", "finish_reason",
+                 "extract_kv", "kv_import", "kv_export")
 
     _deadline_stat = "decode_deadline_exceeded"
     _outcome_prefix = "decode"
 
     def __init__(self, prompt, max_new_tokens, deadline, temperature,
                  top_k, top_p, seed, on_token, record_logits=False,
-                 speculative=None):
+                 speculative=None, extract_kv=False, kv_import=None):
         super().__init__(deadline)
         self.prompt = list(prompt)
         self.max_new_tokens = int(max_new_tokens)
@@ -253,6 +254,18 @@ class DecodeRequest(RequestBase):
         self.logits_trace: List[np.ndarray] = []
         self.speculative = speculative  # None=auto, False=opt out
         self.finish_reason: Optional[str] = None
+        # disaggregated serving (serving/disagg.py): an extract_kv
+        # request is the INTERNAL prefill leg — on success its slot's
+        # prompt pages are gathered into ``kv_export`` (a
+        # kv_cache.KVPageExport) before release, and it is exempt from
+        # the client-facing SLO plane (ttft histogram + goodput/burn
+        # accounting) because the logical request's first token is the
+        # decode replica's.  ``kv_import`` carries such a payload INTO
+        # an engine: admission installs the pages and starts at the
+        # first decode step instead of prefilling.
+        self.extract_kv = bool(extract_kv)
+        self.kv_import = kv_import
+        self.kv_export = None
 
     # terminal accounting (RequestBase._on_terminal hooks) ---------------
     def _finish_stats(self, outcome, latency):
@@ -283,6 +296,12 @@ class DecodeRequest(RequestBase):
         }
 
     def _slo_check(self, summary):
+        if self.extract_kv:
+            # internal disagg prefill leg: the logical request is
+            # observed once, by its decode-side request — feeding this
+            # half too would double-count every disagg request in
+            # goodput/burn
+            return ()
         from ..observe import slo as _slo
 
         return _slo.observe_request(summary)
@@ -292,7 +311,9 @@ class DecodeRequest(RequestBase):
         now = time.monotonic()
         if self.t_first_token is None:
             self.t_first_token = now
-            stat_time("ttft_seconds", self.t_first_token - self.t_enqueue)
+            if not self.extract_kv:
+                stat_time("ttft_seconds",
+                          self.t_first_token - self.t_enqueue)
         self.t_last_token = now
         self.generated.append(int(token))
         self._stream.put(int(token))
@@ -821,7 +842,9 @@ class DecodeEngine:
                seed: Optional[int] = None,
                on_token: Optional[Callable[[int], None]] = None,
                record_logits: bool = False,
-               speculative: Optional[bool] = None) -> DecodeRequest:
+               speculative: Optional[bool] = None,
+               extract_kv: bool = False,
+               kv_import=None) -> DecodeRequest:
         from ..observe.request_trace import get_trace_store
 
         c = self.config
@@ -833,7 +856,8 @@ class DecodeEngine:
         try:
             return self._submit_traced(
                 trace, prompt, max_new_tokens, deadline_ms, temperature,
-                top_k, top_p, seed, on_token, record_logits, speculative)
+                top_k, top_p, seed, on_token, record_logits, speculative,
+                extract_kv, kv_import)
         except Exception as e:
             # submit-time rejection IS a terminal outcome: count it,
             # record its (instant) terminal latency so error-rate
@@ -868,10 +892,44 @@ class DecodeEngine:
 
     def _submit_traced(self, trace, prompt, max_new_tokens, deadline_ms,
                        temperature, top_k, top_p, seed, on_token,
-                       record_logits, speculative) -> DecodeRequest:
+                       record_logits, speculative, extract_kv=False,
+                       kv_import=None) -> DecodeRequest:
         c = self.config
         if not prompt:
             raise ValueError("prompt must hold at least one token id")
+        if kv_import is not None:
+            # migrated admission (serving/disagg.py): validate the
+            # payload against THIS engine's pool geometry at submit
+            # time — a mismatch must reject loudly, never corrupt pools
+            cc = self._cache.config
+            if extract_kv:
+                raise ValueError(
+                    "kv_import and extract_kv are mutually exclusive "
+                    "(a request is either the prefill leg or the "
+                    "decode leg of a disagg handoff, not both)")
+            if speculative:
+                raise ValueError(
+                    "kv_import cannot be speculative: the migration "
+                    "payload carries the target pools only — the "
+                    "draft pools never saw the prompt K/V")
+            if bool(kv_import.quantized) != bool(cc.quantized):
+                raise ValueError(
+                    f"kv_import quantized={kv_import.quantized} but "
+                    f"this engine's cache quantized={cc.quantized} — "
+                    f"prefill and decode replicas must agree on "
+                    f"FLAGS_decode_kv_quant")
+            if int(kv_import.page_size) != cc.page_size:
+                raise ValueError(
+                    f"kv_import page_size {kv_import.page_size} != "
+                    f"engine page_size {cc.page_size}")
+            if int(kv_import.n_tokens) != len(prompt):
+                raise ValueError(
+                    f"kv_import covers {kv_import.n_tokens} tokens but "
+                    f"the prompt has {len(prompt)}")
+            if int(kv_import.n_pages) != cc.pages_for(len(prompt)):
+                raise ValueError(
+                    f"kv_import carries {kv_import.n_pages} pages but "
+                    f"the prompt needs {cc.pages_for(len(prompt))}")
         if speculative:
             # loud submit-time rejection: a request that ASKS for
             # speculative decoding must get it or fail, never silently
@@ -924,7 +982,9 @@ class DecodeEngine:
             req = DecodeRequest(prompt, max_new_tokens, deadline,
                                 temperature, top_k, top_p, seed,
                                 on_token, record_logits=record_logits,
-                                speculative=speculative)
+                                speculative=speculative,
+                                extract_kv=extract_kv,
+                                kv_import=kv_import)
             req.trace = trace
             self._queue.append(req)
             # resolved defaults ride the event, not trace.attrs: the
@@ -1054,7 +1114,14 @@ class DecodeEngine:
             need = len(req.prompt) + req.max_new_tokens
             self._admitting = req
             try:
-                info = self._cache.claim(slot, need, prompt=req.prompt)
+                # a migrated admission claims ALL-FRESH pages (no
+                # prefix lookup): the installed pages must be solely
+                # owned — cross-engine sharing of migrated bytes is
+                # exactly what the disagg refcount contract forbids
+                info = self._cache.claim(
+                    slot, need,
+                    prompt=None if req.kv_import is not None
+                    else req.prompt)
             finally:
                 self._admitting = None
             if info is None:
@@ -1066,12 +1133,45 @@ class DecodeEngine:
             self._queue.popleft()
             st = _SlotState(req, jax.random.PRNGKey(req.seed))
             st.spec = (self.spec_enabled and req.temperature <= 0.0
-                       and req.speculative is not False)
-            self._account_claim(slot, st, info)
+                       and req.speculative is not False
+                       and req.kv_import is None)
+            if req.kv_import is not None:
+                self._account_migrated(slot, st, req)
+            else:
+                self._account_claim(slot, st, info)
             self._slots[slot] = st
             admitted.append((slot, req))
         stat_set("decode_queue_depth", len(self._queue))
         return admitted
+
+    def _account_migrated(self, slot: int, st: _SlotState, req) -> None:
+        """Admit a request whose prompt K/V arrives as a migration
+        payload (disaggregated serving): install the pages into the
+        slot's fresh claim, then start the slot exactly like a
+        full-prefix-cache hit — the pages hold prompt positions
+        ``0..n-1``, so the first decode step re-derives the last prompt
+        position's logits (its own K/V write aims at trash) and samples
+        the first token with ``fold_in(base_key, 0)``.  That is the
+        SAME sampling path as a local prefill's first token, which is
+        what makes migrated decode bitwise-equal to local."""
+        n = len(req.prompt)
+        self._cache.install_pages(slot, req.kv_import)
+        st.phase = "decode"
+        st.write_trash_once = True
+        st.last_token = req.prompt[-1]
+        st.prefill_pos = n
+        self._cache.lengths[slot] = n - 1
+        stat_add("decode_migrated_admissions")
+        self._tev(req, "admit", slot=slot,
+                  queue_wait_ms=round(
+                      (st.t_admit - req.t_enqueue) * 1e3, 3),
+                  migrated_pages=req.kv_import.n_pages,
+                  migrated_bytes=req.kv_import.nbytes,
+                  prefill_skipped=True)
+        # drop the payload reference: the arrays live in the pools now,
+        # and holding them would pin the transport buffers for the
+        # request's whole lifetime
+        req.kv_import = None
 
     def _account_claim(self, slot: int, st: _SlotState, info) -> None:
         """Fold one admission's prefix-cache outcome into the slot's
@@ -1145,8 +1245,43 @@ class DecodeEngine:
         stat_set("decode_free_pages", self._cache.allocator.num_free)
         stat_set("decode_shared_pages", self._cache.shared_pages)
 
+    def _export_slot_kv(self, slot: int) -> None:
+        """Gather the slot's prompt-covering pages into a migration
+        payload on ``req.kv_export`` — the disagg prefill->decode
+        handoff.  Runs on the engine thread right before the slot
+        releases, so the pages still hold positions ``0..n-1`` and the
+        gather cannot race a donated step."""
+        st = self._slots[slot]
+        req = st.req
+        cc = self._cache.config
+        n = len(req.prompt)
+        if int(self._cache.lengths[slot]) < n - 1:
+            return  # prefill never covered the prompt; router re-runs
+        n_pages = cc.pages_for(n)
+        pages = self._cache.slot_pages(slot)[:n_pages]
+        with otrace.span("serving/migrate_export", slot=slot,
+                         pages=n_pages):
+            arrays = self._cache.export_pages(pages)
+        req.kv_export = kv_cache.KVPageExport(
+            n_tokens=n, n_pages=n_pages, src_pages=pages,
+            arrays=arrays, quantized=cc.quantized,
+            page_size=cc.page_size)
+        stat_add("decode_kv_exports")
+        self._tev(req, "kv_export", pages=n_pages,
+                  bytes=req.kv_export.nbytes)
+
     def _finish_slot(self, slot: int, error=None):
         st = self._slots[slot]
+        if error is None and st.req.extract_kv \
+                and st.phase == "decode":
+            # export BEFORE _finish: the handoff thread wakes on the
+            # request's completion and must find the payload attached
+            try:
+                self._export_slot_kv(slot)
+            except Exception as e:  # noqa: BLE001 — a failed export
+                # must fail the REQUEST (the router re-dispatches), not
+                # the engine loop
+                error = e
         if error is None:
             if st.req._finish():
                 stat_add("decode_completed")

@@ -72,11 +72,13 @@ from __future__ import annotations
 
 import math
 import threading
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from ..monitor import stat_add
+from ..observe.histogram import stat_time
 from ..ops.quant_ops import SCALE_EPS
 
 K_PAGES_VAR = "__decode_k_pages__"
@@ -89,6 +91,37 @@ KV_QMAX = 127.0  # symmetric int8 grid for quantized pages
 
 class CacheExhaustedError(RuntimeError):
     """The page pool cannot cover a request's worst-case reservation."""
+
+
+class KVPageExport:
+    """A self-describing export of one slot's leading KV pages — the
+    disaggregated-serving migration payload (serving/disagg.py).
+
+    ``arrays`` maps every pool var name from ``state_var_names()``
+    (data pages AND, when quantized, the scale planes) to a
+    ``[layers, n_pages, ...]`` slice gathered out of the source pool.
+    The slices are fresh buffers (a jax gather never aliases the
+    donated pool), so a payload stays valid after the source engine's
+    next step; ``np.asarray`` each array for the host-bounce transport
+    when source and destination do not share a backend.  ``quantized``
+    and ``page_size`` let the destination reject a geometry-mismatched
+    install before touching its pools."""
+
+    __slots__ = ("n_tokens", "n_pages", "src_pages", "arrays",
+                 "quantized", "page_size", "nbytes")
+
+    def __init__(self, n_tokens: int, n_pages: int,
+                 src_pages: Sequence[int], arrays: Dict[str, object],
+                 quantized: bool, page_size: int):
+        self.n_tokens = int(n_tokens)
+        self.n_pages = int(n_pages)
+        self.src_pages = list(src_pages)
+        self.arrays = dict(arrays)
+        self.quantized = bool(quantized)
+        self.page_size = int(page_size)
+        self.nbytes = sum(
+            int(np.prod(a.shape)) * a.dtype.itemsize
+            for a in self.arrays.values())
 
 
 class CacheConfig:
@@ -417,6 +450,11 @@ class PagedKVCache:
         # audits cover every pool.
         self.scale_vars: List[str] = []
         self._pending_scale_resets: List[int] = []
+        # pages installed by a disagg migration, while owned by their
+        # admitting slot: page id -> slot.  An installed page is a
+        # FRESH page (refcount exactly 1, never index-registered) until
+        # its slot releases — debug_check audits exactly that.
+        self._migrated_in: Dict[int, int] = {}
         if c.quantized:
             sshape = (c.num_layers, c.num_pages, c.page_size,
                       c.num_heads)
@@ -456,6 +494,7 @@ class PagedKVCache:
                 f"held")
         if r == 0:
             self.allocator.free([pid])
+            self._migrated_in.pop(pid, None)
             if self.config.quantized:
                 # hygiene + auditability: a freed page's scale plane is
                 # reset to SCALE_EPS (flushed in one batched device op
@@ -584,6 +623,11 @@ class PagedKVCache:
         hold), those pages are first registered in the prefix index —
         the index takes its own reference, so registered pages survive
         the release for future prompts to share."""
+        # a migrated-in page's owned-fresh invariant ends with its
+        # slot: from here it is an ordinary page (registrable in the
+        # index, sharable, freeable)
+        for pid in self._slot_pages[slot]:
+            self._migrated_in.pop(pid, None)
         if register_tokens and self.prefix is not None:
             n_pages = self.config.pages_for(len(register_tokens))
             new = self.prefix.register(
@@ -603,6 +647,65 @@ class PagedKVCache:
 
     def slot_pages(self, slot: int) -> List[int]:
         return list(self._slot_pages[slot])
+
+    # -- disaggregated-serving page migration -----------------------------
+    def export_pages(self, pages: Sequence[int]) -> Dict[str, object]:
+        """Gather the given page ids out of EVERY pool this cache
+        threads through the persistent step (data pages + scale planes
+        when quantized) into fresh device arrays, keyed by pool var
+        name.  Must run on the engine thread between step dispatches —
+        the gather's operand ordering against the donated pools is then
+        guaranteed by jax dispatch order, and its result never aliases
+        a pool buffer, so the payload survives the source's next
+        step."""
+        idx = np.asarray([int(p) for p in pages], np.int32)
+        return {name: self.scope.get_var(name)[:, idx]
+                for name in self.state_var_names()}
+
+    def install_pages(self, slot: int, export: "KVPageExport") -> None:
+        """Scatter a migrated payload into the slot's leading
+        ``export.n_pages`` table pages (claimed fresh — a migrated
+        admission never prefix-shares, so every destination page is
+        solely owned).  Covers every pool the payload carries; records
+        ``migrate_pages_total`` / ``migrate_bytes_total`` /
+        ``migrate_seconds``.  Engine-thread-only, like every pool
+        mutation."""
+        import jax.numpy as jnp
+
+        t0 = time.monotonic()
+        names = self.state_var_names()
+        if set(export.arrays) != set(names):
+            raise ValueError(
+                f"migration payload pools {sorted(export.arrays)} do "
+                f"not match destination pools {sorted(names)} — "
+                f"source/destination kv_quant configs disagree")
+        if export.page_size != self.config.page_size:
+            raise ValueError(
+                f"migration payload page_size {export.page_size} != "
+                f"destination page_size {self.config.page_size}")
+        dst = self._slot_pages[slot][:export.n_pages]
+        if len(dst) < export.n_pages:
+            raise ValueError(
+                f"slot {slot} holds {len(dst)} pages but the payload "
+                f"carries {export.n_pages}")
+        idx = np.asarray(dst, np.int32)
+        for name in names:
+            pool = self.scope.get_var(name)
+            arr = export.arrays[name]
+            want = (pool.shape[0], export.n_pages) + tuple(pool.shape[2:])
+            if tuple(arr.shape) != want:
+                raise ValueError(
+                    f"migration payload {name} shape "
+                    f"{tuple(arr.shape)} != expected {want}")
+            self.scope.set_var(
+                name, pool.at[:, idx].set(jnp.asarray(arr, pool.dtype)))
+        for pid in dst:
+            self._migrated_in[pid] = slot
+        stat_add("migrate_pages_total", export.n_pages)
+        stat_add("migrate_bytes_total", export.nbytes)
+        stat_time("migrate_seconds", time.monotonic() - t0)
+        self._fire(slot, "migrate_install", pages=list(dst),
+                   bytes=export.nbytes)
 
     # -- copy-on-write ----------------------------------------------------
     def writable(self, slot: int, position: int) -> bool:
@@ -692,6 +795,30 @@ class PagedKVCache:
             assert in_free == (self._refs[pid] == 0), (
                 f"page {pid}: refcount {self._refs[pid]} but "
                 f"{'on' if in_free else 'not on'} the free list")
+        # migrated-in pages (disagg): while owned by their admitting
+        # slot an installed page is FRESH — exactly one reference (the
+        # slot's), never pinned by the prefix index, and (quantized)
+        # carrying the live scale plane the source wrote
+        for pid, slot in self._migrated_in.items():
+            assert self._refs[pid] == 1, (
+                f"migrated-in page {pid} (slot {slot}): refcount "
+                f"{self._refs[pid]} != 1 — a migrated page leaked into "
+                f"sharing before its slot released")
+            assert self.prefix is None or \
+                not self.prefix.is_registered(pid), (
+                    f"migrated-in page {pid} (slot {slot}) is "
+                    f"registered in the prefix index while still "
+                    f"slot-owned")
+            assert pid in self._slot_pages[slot], (
+                f"migrated-in page {pid} not in slot {slot}'s table")
+        if self.config.quantized and self._migrated_in:
+            mig_idx = np.asarray(sorted(self._migrated_in), np.int32)
+            for name in self.scale_vars:
+                plane = np.asarray(self.scope.get_var(name))[:, mig_idx]
+                assert np.isfinite(plane).all() and (plane > 0).all(), (
+                    f"scale pool {name}: migrated-in pages "
+                    f"{mig_idx.tolist()} hold non-finite/non-positive "
+                    f"scales — the migration dropped a scale plane")
         if not self.config.quantized:
             return
         free_idx = np.asarray(sorted(free), np.int32)

@@ -225,6 +225,21 @@ class Server:
         }
 
 
+def least_loaded_order(engines):
+    """Deterministic least-loaded dispatch order over decode engines:
+    most free slots first, then shortest queue, then LOWEST index.
+    The index tie-break matters: Python's sort is stable, but the
+    iteration order of a replica list is an accident of construction —
+    pinning ties to the lowest index makes router A/Bs and the disagg
+    bench reproducible run-to-run (tests/test_disagg.py pins it).
+    Shared by :class:`DecodeServer` and the disagg router."""
+    engines = list(engines)
+    order = sorted(range(len(engines)),
+                   key=lambda i: (-engines[i].free_slots,
+                                  engines[i].queue_depth, i))
+    return [engines[i] for i in order]
+
+
 class DecodeServer:
     """N replicated decode engines (serving/decode.py) behind ONE
     admission point with least-loaded dispatch — the generative
@@ -268,11 +283,9 @@ class DecodeServer:
 
     # -- request path ----------------------------------------------------
     def _pick(self):
-        """Least-loaded dispatch order: most free slots first, then
-        shortest queue (a replica with a free slot starts the request
-        at the NEXT step boundary; one with a queue adds wait)."""
-        return sorted(self._engines,
-                      key=lambda e: (-e.free_slots, e.queue_depth))
+        """Least-loaded dispatch order (see
+        :func:`least_loaded_order`)."""
+        return least_loaded_order(self._engines)
 
     def submit(self, prompt, **kw):
         from .buckets import QueueFullError

@@ -38,10 +38,11 @@ __all__ = ["load_round", "classify", "diff_rounds", "main"]
 # key-name → direction rules; first match wins, unknown keys neutral
 _HIGHER = re.compile(
     r"(per_sec|_rps$|vs_baseline|speedup|goodput|accept|hit_rate|"
-    r"fraction_of_synthetic|ratio$|_mfu|tokens_total)")
+    r"fraction_of_synthetic|ratio$|_mfu|tokens_total|improvement|"
+    r"bitwise_ok|reroles)")
 _LOWER = re.compile(
     r"(_seconds|_ms$|_s$|_p50|_p90|_p95|_p99|_bytes|bubble|pad_waste|"
-    r"exposed|latency|restarts|_errors)")
+    r"exposed|latency|restarts|_errors|dropped|redispatch)")
 
 _BAD_STATUS = ("partial", "failed", "recovered")
 

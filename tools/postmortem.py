@@ -276,7 +276,8 @@ def render(bundle: str, tail: int = 15, stacks: bool = False,
                 "collective_matmul", "pass_overlap_stretched",
                 "emb_", "dlrm_", "flash_attn_", "prefill_pad",
                 "pass_flash_attention", "phase_", "prof_",
-                "comm_exposed", "comm_hidden")
+                "comm_exposed", "comm_hidden", "migrate_", "disagg_",
+                "autoscale_")
         for ln in rows:
             if metrics or any(k in ln for k in keys):
                 w(f"  {ln}\n")
