@@ -565,6 +565,241 @@ def _fallback_reduced_run(result):
     return result
 
 
+# mixture-of-experts flagship (ISSUE 20): sized so the [E, capacity, D]
+# dispatch buffer's capacity (ceil(B*K*cf/E) = 40) divides the chunk
+# count — the overlap A/B must ENGAGE chunking, not fall back
+MOE_BATCH = 64
+MOE_DM = 32
+MOE_FFN_DIM = 64
+MOE_EXPERTS = 4
+MOE_TOPK = 2
+MOE_CF = 1.25
+MOE_STEPS = 6
+MOE_CHUNKS = 4
+
+
+def bench_moe(pt, jax):
+    """Mixture-of-experts flagship over a dp×ep mesh (ISSUE 20).
+
+    Four measurements: (1) loss parity of the expert-parallel run vs
+    the replicated single-device oracle (the dense execution of the
+    same routed FFN — matched activated FLOPs by construction);
+    (2) throughput vs a dense-equivalent MLP whose hidden width is
+    top_k * ffn_dim (what the same activated FLOPs buy without
+    routing), data-parallel over the same chips; (3) the overlap A/B:
+    FLAGS_moe_alltoall_chunks off vs on must keep losses BITWISE equal
+    (capacity-axis chunking + one final combine) while the PR 18
+    ledger shows >= 1 hidden all-to-all and a strictly lower exposed
+    share; (4) the quantized-expert serving leg's quality tax through
+    quant_quality_delta.  Emits moe_tokens_per_sec,
+    moe_expert_balance_ppm, moe_dropped_fraction_ppm,
+    moe_overlap_step_time_ratio and friends."""
+    import time as _time
+
+    from paddle_tpu import layers
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.parallel_env import reset_mesh, set_mesh
+    from paddle_tpu.framework import passes as passes_mod
+    from paddle_tpu.framework import unique_name
+    from paddle_tpu.framework.program import Program, program_guard
+    from paddle_tpu.observe.phases import collective_inventory
+    from paddle_tpu.ops.moe_ops import moe_balance_gauges
+    from paddle_tpu.optimizer import MomentumOptimizer
+
+    devs = jax.devices()
+    n = len(devs)
+    if n < 2:
+        raise RuntimeError(f"bench_moe needs >= 2 devices, have {n}")
+    ep = 4 if n % 4 == 0 else 2
+    dp = max(n // ep, 1)
+    ep_mesh = jax.sharding.Mesh(
+        np.array(devs[:dp * ep]).reshape(dp, ep), ("dp", "ep"))
+    dp_mesh = jax.sharding.Mesh(np.array(devs[:dp * ep]), ("dp",))
+
+    def build(kind):
+        main_p, startup = Program(), Program()
+        main_p.random_seed = 1
+        with unique_name.guard(), program_guard(main_p, startup):
+            x = layers.data("x", [MOE_DM])
+            y = layers.data("y", [1])
+            load = None
+            if kind == "dense":
+                # dense-equivalent at matched ACTIVATED FLOPs: every
+                # token runs top_k experts of width ffn_dim, so the
+                # dense twin gets one MLP of width top_k * ffn_dim
+                h = layers.fc(x, MOE_TOPK * MOE_FFN_DIM, act="gelu",
+                              name="dense_up")
+                h = layers.fc(h, MOE_DM, name="dense_down")
+                pred = layers.fc(h, 1, name="head")
+                loss = layers.mean(layers.square_error_cost(pred, y))
+            else:
+                h, aux, load = layers.moe_ffn(
+                    x, num_experts=MOE_EXPERTS, ffn_dim=MOE_FFN_DIM,
+                    top_k=MOE_TOPK, capacity_factor=MOE_CF, name="moe0")
+                pred = layers.fc(h, 1, name="head")
+                loss0 = layers.mean(layers.square_error_cost(pred, y))
+                loss = layers.elementwise_add(
+                    loss0, layers.scale(aux, 0.01))
+            opt = MomentumOptimizer(0.05, 0.9)
+            if kind == "moe_ep":
+                strat = fleet.DistributedStrategy()
+                strat.expert_parallel = True
+                fleet.init(is_collective=True, strategy=strat)
+                fleet.distributed_optimizer(opt)
+                fleet.minimize(loss)
+            elif kind == "dense":
+                fleet.init(is_collective=True)
+                fleet.distributed_optimizer(opt)
+                fleet.minimize(loss)
+            else:  # replicated oracle
+                opt.minimize(loss)
+        return main_p, startup, loss, load
+
+    rs = np.random.RandomState(0)
+    X = rs.randn(MOE_BATCH, MOE_DM).astype(np.float32)
+    Y = (X.sum(axis=1, keepdims=True) * 0.3).astype(np.float32)
+
+    def train(kind, mesh, steps=MOE_STEPS):
+        main_p, startup, loss, load = build(kind)
+        scope = pt.framework.Scope()
+        exe = pt.Executor(pt.CPUPlace(), mesh=mesh)
+        exe.run(startup, scope=scope)
+        fetches = [loss] + ([load] if load is not None else [])
+        out = exe.run(main_p, feed={"x": X, "y": Y}, fetch_list=fetches,
+                      scope=scope)  # compile + warm
+        assert np.isfinite(np.asarray(out[0])).all()
+        t0 = _time.perf_counter()
+        losses, last_load = [], None
+        for _ in range(steps):
+            out = exe.run(main_p, feed={"x": X, "y": Y},
+                          fetch_list=fetches, scope=scope)
+            losses.append(float(np.asarray(out[0]).ravel()[0]))
+            if load is not None:
+                last_load = np.asarray(out[1])
+        exe.drain()
+        wall = _time.perf_counter() - t0
+        return losses, last_load, wall, main_p
+
+    # replicated oracle (dense execution of the same routed FFN)
+    reset_mesh()
+    base, _, _, _ = train("moe_local", None)
+
+    pt.set_flags({"FLAGS_moe_alltoall_chunks": 0})
+    set_mesh(ep_mesh)
+    try:
+        seq_losses, load, seq_wall, prog = train("moe_ep", ep_mesh)
+        rel = max(abs(a - b) / max(abs(a), 1e-8)
+                  for a, b in zip(base, seq_losses))
+        assert rel <= 1e-4, (
+            f"ep loss parity {rel} vs replicated oracle", base, seq_losses)
+        gauges = moe_balance_gauges(load, MOE_BATCH, MOE_TOPK)
+
+        # overlap A/B: same program, chunked all-to-all schedule
+        pt.set_flags({"FLAGS_moe_alltoall_chunks": MOE_CHUNKS})
+        chunk_losses, _, chunk_wall, _ = train("moe_ep", ep_mesh)
+        assert chunk_losses == seq_losses, (
+            "chunked schedule is not bitwise-equal to sequential",
+            seq_losses, chunk_losses)
+
+        # ledger: chunking must hide >= 1 all-to-all and strictly
+        # lower the exposed share of the a2a bytes
+        plan_prog = passes_mod.apply_passes(
+            prog, fetch_names=(), feed_names=("x", "y"), mesh=ep_mesh)
+        blk = plan_prog.global_block
+
+        def a2a_exposed_share(chunks):
+            inv = [e for e in collective_inventory(
+                blk, list(blk.ops), mesh=ep_mesh,
+                tp_plan=plan_prog._tp_plan, moe_chunks=chunks)
+                if e["op"] == "ep_alltoall"]
+            total = sum(e["bytes"] for e in inv)
+            exposed = sum(e["bytes"] for e in inv if not e["overlap"])
+            hidden_n = sum(1 for e in inv if e["overlap"])
+            return exposed / max(total, 1), hidden_n
+
+        share_seq, hidden_seq = a2a_exposed_share(0)
+        share_chunk, hidden_chunk = a2a_exposed_share(MOE_CHUNKS)
+        assert hidden_chunk >= 1, "chunked schedule hid no all-to-all"
+        assert share_chunk < share_seq, (share_chunk, share_seq)
+    finally:
+        pt.set_flags({"FLAGS_moe_alltoall_chunks": 0})
+        reset_mesh()
+
+    # dense-equivalent throughput over the same chips (dp only)
+    set_mesh(dp_mesh)
+    try:
+        _, _, dense_wall, _ = train("dense", dp_mesh)
+    finally:
+        reset_mesh()
+
+    toks = MOE_BATCH * MOE_STEPS
+    out = {
+        "ep_degree": ep,
+        "moe_mesh": [dp, ep],
+        "moe_tokens_per_sec": round(toks / seq_wall, 1),
+        "moe_dense_equiv_tokens_per_sec": round(toks / dense_wall, 1),
+        "moe_loss_parity_vs_oracle": rel,
+        "moe_expert_balance_ppm": gauges["moe_expert_balance_ppm"],
+        "moe_dropped_fraction_ppm": gauges["moe_dropped_fraction_ppm"],
+        # sequential/chunked step time: > 1.0 means the overlapped
+        # schedule is faster (higher-is-better, bench_diff "ratio$")
+        "moe_overlap_step_time_ratio": round(seq_wall / chunk_wall, 3),
+        "moe_alltoall_hidden": hidden_chunk,
+        "moe_alltoall_exposed_share_seq": round(share_seq, 3),
+        "moe_alltoall_exposed_share_chunked": round(share_chunk, 3),
+    }
+    out.update(_bench_moe_serving_quant(pt, jax))
+    return out
+
+
+def _bench_moe_serving_quant(pt, jax):
+    """Quantized-expert serving leg: int8 stacked expert carriers vs
+    the full-precision oracle on the SAME decode engine surface, the
+    quality tax reported through quant_quality_delta (satellite of
+    ISSUE 20 riding the bench_quant convention)."""
+    from paddle_tpu.ops.quant_ops import quant_quality_delta
+    from paddle_tpu.serving.decode import (DecodeConfig, DecodeEngine,
+                                           TransformerLM,
+                                           quantize_moe_weights)
+
+    model = TransformerLM(vocab_size=64, d_model=32, num_layers=2,
+                          num_heads=2, moe_experts=MOE_EXPERTS,
+                          moe_top_k=MOE_TOPK)
+    weights = model.init_weights(jax.random.PRNGKey(0))
+    prompts = [[1, 2, 3], [7, 5, 11, 2]]
+
+    # quantized run first; the full-precision oracle is TEACHER-FORCED
+    # on the quantized run's own tokens (bench_quant's kv-leg
+    # convention) so logits stay position-comparable after the
+    # trajectories would otherwise diverge
+    eq = DecodeEngine(model, quantize_moe_weights(weights, "int8"),
+                      DecodeConfig(slots=2, max_seq_len=64,
+                                   page_size=8)).start()
+    try:
+        reqs = [eq.submit(p, max_new_tokens=8, record_logits=True)
+                for p in prompts]
+        outs = [r.result(timeout=300) for r in reqs]
+        quant = np.concatenate(
+            [np.stack([np.asarray(x) for x in r.logits_trace])
+             for r in reqs])
+    finally:
+        eq.stop()
+    ef = DecodeEngine(model, weights, DecodeConfig(
+        slots=2, max_seq_len=64, page_size=8)).start()
+    try:
+        ref = np.concatenate(
+            [np.stack([ef.recompute_logits(list(p) + o[:t])
+                       for t in range(len(o))])
+             for p, o in zip(prompts, outs)])
+    finally:
+        ef.stop()
+    delta = quant_quality_delta(quant, ref)
+    return {"moe_quant_quality_delta": {
+        "max_abs_logit_delta": round(delta["max_abs_logit_delta"], 6),
+        "top1_agreement": round(delta["top1_agreement"], 4),
+    }}
+
+
 # transformer-depth flagship (scan-over-layers acceptance): dims are
 # deliberately tiny — the quantity under test is trace+compile scaling
 # with DEPTH, not step throughput, and the deep unrolled compile is the
@@ -2654,6 +2889,15 @@ def main():
             result.update(bench_dlrm(pt, jax))
         except Exception as e:
             errors["dlrm"] = f"{type(e).__name__}: {e}"[:500]
+        try:
+            # mixture-of-experts flagship (ISSUE 20): dp×ep loss
+            # parity vs the replicated oracle, dense-equivalent
+            # activated-FLOPs throughput twin, bitwise overlap A/B
+            # with the ledger's hidden all-to-alls, and the
+            # quantized-expert serving quality tax
+            result.update(bench_moe(pt, jax))
+        except Exception as e:
+            errors["moe"] = f"{type(e).__name__}: {e}"[:500]
 
     ratios = []
     if ips is not None:

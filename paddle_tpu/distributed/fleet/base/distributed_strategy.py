@@ -173,6 +173,31 @@ class DistributedStrategy:
             self._tp_extra = {}
         self._tp_extra.update(extra)
 
+    # expert parallelism (mixture-of-experts): the reference proto
+    # predates MoE, so both knobs are pure python-side state — they do
+    # NOT survive serialize_to_string (the contract DOES survive program
+    # clone/proto round-trips once ExpertParallelMetaOptimizer stamps
+    # EP_DEGREE_ATTR onto the optimizer ops).  Config keys:
+    # "expert_parallel_degree" — required 'ep' axis size (0/absent =
+    # whatever the mesh has).
+    @property
+    def expert_parallel(self):
+        return bool(getattr(self, "_ep_enabled", False))
+
+    @expert_parallel.setter
+    def expert_parallel(self, v):
+        self._ep_enabled = bool(v)
+
+    @property
+    def expert_parallel_configs(self):
+        return dict(getattr(self, "_ep_configs", {}))
+
+    @expert_parallel_configs.setter
+    def expert_parallel_configs(self, configs):
+        if not hasattr(self, "_ep_configs"):
+            self._ep_configs = {}
+        self._ep_configs.update(configs or {})
+
     @property
     def nccl_comm_num(self):
         return self._proto.nccl_comm_num

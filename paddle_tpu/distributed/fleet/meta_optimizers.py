@@ -885,6 +885,83 @@ class TensorParallelMetaOptimizer(MetaOptimizerBase):
         return ops, params_grads
 
 
+class ExpertParallelMetaOptimizer(MetaOptimizerBase):
+    """Expert parallelism (mixture-of-experts) over a named mesh with an
+    'ep' axis — the reference's incubate MoE distributed layer, GSPMD-
+    native form.
+
+    Outermost wrapper like TensorParallelMetaOptimizer: it composes
+    with whichever graph-level chain applied by stamping
+    ``EP_DEGREE_ATTR`` onto the program's optimizer ops; the executor-
+    side ``ShardingPropagationPass`` then seeds ``P('ep', ...)`` on
+    every moe_ffn op's stacked expert weights, stamps the all-to-all
+    anchors, and refuses ep-sharded consumers outside the routed-FFN
+    family.  The dp loss-grad scale op is removed here for the same
+    reason as the tp meta-optimizer: under GSPMD the traced loss is the
+    global-batch mean already."""
+
+    def _can_apply(self):
+        return self.user_strategy.expert_parallel
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        from ...framework.passes import DP_LOSS_SCALE_ATTR, EP_DEGREE_ATTR
+        from ..parallel_env import get_mesh
+
+        strat = self.user_strategy
+        if strat.localsgd:
+            raise NotImplementedError(
+                "strategy.expert_parallel does not compose with "
+                "strategy.localsgd yet: localsgd's host-side parameter "
+                "averaging has no ep-sharded form here; unset one")
+        mesh = get_mesh()
+        if mesh is not None and "ep" not in mesh.axis_names:
+            raise ValueError(
+                "strategy.expert_parallel needs a mesh with an 'ep' "
+                "axis; build it with init_parallel_env(mesh_shape="
+                "(dp, ep), axis_names=('dp', 'ep')) or FLAGS_ep_degree")
+        if strat.pipeline and mesh is not None \
+                and "pp" not in mesh.axis_names:
+            raise ValueError(
+                "strategy.expert_parallel + strategy.pipeline needs a "
+                "mesh with BOTH 'ep' and 'pp' axes; build it with "
+                "init_parallel_env(mesh_shape=(dp, ep, pp), "
+                "axis_names=('dp', 'ep', 'pp'))")
+
+        ops, params_grads = self.inner_opt.minimize(
+            loss, startup_program, parameter_list, no_grad_set)
+
+        cfg = strat.expert_parallel_configs or {}
+        # 0 in the stamp means "use the mesh's ep axis size"; an
+        # explicit degree >= 2 is VALIDATED against the mesh at
+        # dispatch time (ShardingPropagationPass)
+        degree = int(cfg.get("expert_parallel_degree") or 0)
+        if degree <= 1:
+            degree = 0
+
+        prog = loss.block.program
+        block = prog.global_block
+        if not any(op.type == "moe_ffn" for op in block.ops):
+            raise ValueError(
+                "strategy.expert_parallel found no moe_ffn ops to "
+                "shard; build the model with layers.moe_ffn(...) or "
+                "unset the strategy")
+        block.ops[:] = [op for op in block.ops
+                        if not op.attr(DP_LOSS_SCALE_ATTR)]
+        stamped = False
+        for op in block.ops:
+            if op.type in _OPTIMIZER_OP_TYPES:
+                op.attrs[EP_DEGREE_ATTR] = degree
+                stamped = True
+        if not stamped:
+            raise ValueError(
+                "strategy.expert_parallel found no optimizer ops to "
+                "stamp its degree on; minimize() must build the "
+                "training program first")
+        prog._bump()
+        return ops, params_grads
+
+
 class GraphExecutionMetaOptimizer(MetaOptimizerBase):
     """The default collective DP transpile (reference
     graph_execution_optimizer.py:92 + transpiler/collective.py:244)."""
@@ -930,6 +1007,10 @@ META_OPTIMIZERS = [
     # parallel rule contract after the dp/ZeRO transpile ran, so it
     # composes with fused-allreduce, AMP, recompute, and ZeRO chains
     TensorParallelMetaOptimizer,
+    # expert parallelism rides the same GSPMD substrate and the same
+    # outermost position (stamps after every transpile, composes with
+    # tp — 'ep' and 'mp' shard disjoint weight families)
+    ExpertParallelMetaOptimizer,
 ]
 
 # strategy flags with no implementation yet: refuse loudly rather than

@@ -62,21 +62,49 @@ def init_parallel_env(mesh_shape: Optional[Sequence[int]] = None,
         from ..framework import flags as _flags
 
         pp = int(_flags.flag("pp_degree") or 0)
-        if pp > 1 and axis_names is None:
-            # FLAGS_pp_degree: carve a (dp, pp) mesh out of the visible
-            # devices so stage-annotated programs run the GPipe
-            # schedule without an explicit mesh_shape.  The pipeline
-            # degree a program runs with is ALWAYS the mesh's 'pp'
-            # size; this default only shapes meshes built fully
-            # shapeless — an EXPLICIT axis_names argument wins over the
-            # flag (the caller named its axes for a reason).
-            if len(devices) % pp != 0:
+        ep = int(_flags.flag("ep_degree") or 0)
+        if (pp > 1 or ep > 1) and axis_names is None:
+            # FLAGS_pp_degree / FLAGS_ep_degree: carve a (dp, pp),
+            # (dp, ep), or (dp, ep, pp) mesh out of the visible devices
+            # so stage/expert-annotated programs run without an
+            # explicit mesh_shape.  The degree a program runs with is
+            # ALWAYS the mesh axis size; these defaults only shape
+            # meshes built fully shapeless — an EXPLICIT axis_names
+            # argument wins over the flags (the caller named its axes
+            # for a reason).  Bad factorizations are rejected HERE,
+            # with the axis named, instead of deep in GSPMD with an
+            # opaque sharding error.
+            carve = 1
+            for name, deg in (("ep", ep), ("pp", pp)):
+                if deg <= 1:
+                    continue
+                if len(devices) % deg != 0:
+                    raise ValueError(
+                        f"FLAGS_{name}_degree={deg} does not divide "
+                        f"the {len(devices)} visible devices; pass an "
+                        f"explicit mesh_shape or fix the flag")
+                carve *= deg
+            if carve > len(devices):
                 raise ValueError(
-                    f"FLAGS_pp_degree={pp} does not divide the "
-                    f"{len(devices)} visible devices; pass an explicit "
-                    f"mesh_shape or fix the flag")
-            mesh_shape = [len(devices) // pp, pp]
-            axis_names = ("dp", "pp")
+                    f"FLAGS_ep_degree={ep} x FLAGS_pp_degree={pp} = "
+                    f"{carve} exceeds the {len(devices)} visible "
+                    f"devices ('ep' x 'pp' must fit the mesh); pass "
+                    f"an explicit mesh_shape or fix the flags")
+            if len(devices) % carve != 0:
+                raise ValueError(
+                    f"FLAGS_ep_degree={ep} x FLAGS_pp_degree={pp} = "
+                    f"{carve} does not divide the {len(devices)} "
+                    f"visible devices; pass an explicit mesh_shape or "
+                    f"fix the flags")
+            mesh_shape = [len(devices) // carve]
+            axis_names = ["dp"]
+            if ep > 1:
+                mesh_shape.append(ep)
+                axis_names.append("ep")
+            if pp > 1:
+                mesh_shape.append(pp)
+                axis_names.append("pp")
+            axis_names = tuple(axis_names)
         else:
             mesh_shape = [len(devices)]
             axis_names = tuple(axis_names or ("dp",))[:1] or ("dp",)

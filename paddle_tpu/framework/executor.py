@@ -1393,7 +1393,8 @@ class Executor:
             # sharding inside the GPipe shard_map
             # (distributed/pipeline.py).  The fuse/cast/DCE passes stay
             # off — the pipeline splits the op stream per stage itself.
-            if not passes_mod.has_tp_marks(program):
+            if not (passes_mod.has_tp_marks(program)
+                    or passes_mod.has_ep_marks(program)):
                 return program
             pipeline = passes_mod.PassPipeline(
                 [passes_mod.ShardingPropagationPass()])
@@ -1407,7 +1408,8 @@ class Executor:
             # FLAGS_layer_scan / recompute_configs scan stamps — its
             # own gate, not the fusion flag, decides it
             reduced = []
-            if passes_mod.has_tp_marks(program):
+            if passes_mod.has_tp_marks(program) \
+                    or passes_mod.has_ep_marks(program):
                 reduced.append(passes_mod.ShardingPropagationPass())
             if passes_mod.LayerScanPass._config(program)[0]:
                 reduced.append(passes_mod.LayerScanPass())
@@ -1557,7 +1559,7 @@ class Executor:
         # loss-grad scale the tp transpile removed.
         tp_plan = getattr(program, "_tp_plan", None)
         if tp_plan is None:
-            from .passes import has_tp_marks
+            from .passes import has_ep_marks, has_tp_marks
 
             if has_tp_marks(program):
                 raise ValueError(
@@ -1566,6 +1568,13 @@ class Executor:
                     "an 'mp' axis; build one with init_parallel_env("
                     "mesh_shape=(dp, mp), axis_names=('dp', 'mp')) or "
                     "set_mesh(Mesh(devs.reshape(dp, mp), ('dp', 'mp')))")
+            if has_ep_marks(program):
+                raise ValueError(
+                    "this program was built with DistributedStrategy."
+                    "expert_parallel but the executor has no mesh with "
+                    "an 'ep' axis; build one with init_parallel_env("
+                    "mesh_shape=(dp, ep), axis_names=('dp', 'ep')) or "
+                    "FLAGS_ep_degree")
         # static per-step accounting for the StepTimer/MFU readout; a
         # failure here must never fail a compile
         try:
@@ -1607,7 +1616,8 @@ class Executor:
             block, op_list, mesh=mesh, tp_plan=tp_plan,
             flops_per_step=flops_per_step,
             cm_chunks=int(_pflags.flag("collective_matmul_chunks") or 0)
-            if tp_plan is not None else 0)
+            if tp_plan is not None else 0,
+            moe_chunks=int(_pflags.flag("moe_alltoall_chunks") or 0))
         out_set = set(state_out)
         state_mut = tuple(n for n in state_in if n in out_set)
         state_const = tuple(n for n in state_in if n not in out_set)

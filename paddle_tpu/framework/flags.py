@@ -409,6 +409,32 @@ define_flag("collective_matmul_chunks", 0,
             "lowering, counted collective_matmul_fallback.  0/1 = off; "
             "pure-jnp semantics, so CPU tier-1 runs stay exact",
             affects_lowering=True)
+define_flag("ep_degree", 0,
+            "default expert-parallel degree for shapeless mesh "
+            "building: parallel_env.init_parallel_env() called with "
+            "NEITHER mesh_shape NOR axis_names factors the visible "
+            "devices into a (dp, ep) named mesh — or (dp, ep, pp) when "
+            "FLAGS_pp_degree also asks for stages — with this many "
+            "expert shards (0 = no ep axis; a non-divisor device "
+            "count, or an ep x pp product exceeding the visible "
+            "devices, is rejected loudly at carve time with the axis "
+            "named).  The expert-parallel degree a program runs with "
+            "is always the mesh's 'ep' axis size — this flag only "
+            "sizes meshes built without an explicit shape, and an "
+            "explicit axis_names argument wins over it")
+define_flag("moe_alltoall_chunks", 0,
+            "latency-hiding MoE all-to-all (ops/moe_ops.py): slice the "
+            "expert-parallel dispatch/combine all-to-all and the "
+            "expert FFN einsums into this many CAPACITY-axis chunks — "
+            "chunk k's all-to-all overlaps chunk k+1's expert compute "
+            "(the collective-matmul chunking idiom generalized to "
+            "all-to-all).  Chunk outputs are CONCATENATED and combined "
+            "once, so chunked and sequential schedules stay bitwise-"
+            "identical; a capacity not divisible by the chunk count "
+            "falls back to the unchunked lowering, counted "
+            "moe_alltoall_fallback.  0/1 = off; pure-jnp semantics, "
+            "so CPU tier-1 runs stay exact",
+            affects_lowering=True)
 define_flag("decode_spec_k", 0,
             "decode engine: speculative decoding window — a draft "
             "model (DecodeEngine(draft_model=, draft_weights=)) "

@@ -72,6 +72,9 @@ __all__ = [
     "EMB_SHARD_ATTR",
     "decode_anchor",
     "DP_LOSS_SCALE_ATTR",
+    "EP_DEGREE_ATTR",
+    "MOE_EP_ATTR",
+    "has_ep_marks",
     "LAYER_SCAN_ATTR",
     "LAYER_SCAN_POLICY_ATTR",
     "LAYER_STACK_ATTR",
@@ -122,6 +125,18 @@ EMB_SHARD_ATTR = "__emb_row_sharded__"
 # (GSPMD computes global-batch-mean gradients directly; keeping the
 # scale would shrink every gradient by the dp degree)
 DP_LOSS_SCALE_ATTR = "__dp_loss_scale__"
+
+# expert-parallel markers.  ExpertParallelMetaOptimizer stamps
+# EP_DEGREE_ATTR on the program's optimizer ops (required 'ep' degree,
+# 0 = any — the same contract as TP_DEGREE_ATTR); ShardingPropagationPass
+# stamps MOE_EP_ATTR (value = the ep degree) on each moe_ffn / moe_ffn_grad
+# op whose stacked expert weights it sharded P('ep', ...), which is what
+# makes the lowering (ops/moe_ops.py) pin the [E, capacity, D] dispatch
+# buffer to the 'ep' axis — the constraint XLA materializes as the
+# dispatch/combine all-to-all pair.  Op attrs, so the contract survives
+# clone/proto round-trips and joins the program fingerprint.
+EP_DEGREE_ATTR = "__ep_degree__"
+MOE_EP_ATTR = "__moe_ep__"
 
 # scan-over-layers markers.  The first two are stamped by the
 # RecomputeMetaOptimizer (DistributedStrategy.recompute_configs
@@ -219,12 +234,14 @@ class TPShardingPlan:
     constraint anchors pin)."""
 
     __slots__ = ("specs", "mp_degree", "dp_axis", "mp_axis",
-                 "grad_reduce", "n_sharded", "n_fallback")
+                 "grad_reduce", "n_sharded", "n_fallback", "ep_degree")
 
     def __init__(self, specs, mp_degree, dp_axis="dp", mp_axis="mp",
-                 grad_reduce=None, n_sharded=0, n_fallback=0):
+                 grad_reduce=None, n_sharded=0, n_fallback=0,
+                 ep_degree=1):
         self.specs = dict(specs)
         self.mp_degree = int(mp_degree)
+        self.ep_degree = int(ep_degree)
         self.dp_axis = dp_axis
         self.mp_axis = mp_axis
         # grad name -> {"axes": ("dp",), "bytes": per-step payload of
@@ -271,6 +288,7 @@ class TPShardingPlan:
 
     def __repr__(self):
         return (f"TPShardingPlan(mp={self.mp_degree}, "
+                f"ep={self.ep_degree}, "
                 f"sharded={self.n_sharded}, fallback={self.n_fallback})")
 
 
@@ -382,6 +400,14 @@ def has_tp_marks(program) -> bool:
     return any(op.attr(TP_RULES_ATTR) for op in program.global_block.ops)
 
 
+def has_ep_marks(program) -> bool:
+    """True when an ExpertParallelMetaOptimizer stamped this program —
+    like a tp-marked program it must run the GSPMD path (the dp
+    loss-grad scale was removed at minimize time)."""
+    return any(op.attr(EP_DEGREE_ATTR) is not None
+               for op in program.global_block.ops)
+
+
 # ops whose output provably carries its (first) input's partition spec
 # through unchanged — the propagation walks only through these plus the
 # structured handlers below; everything else resets to unknown
@@ -440,9 +466,12 @@ class ShardingPropagationPass(Pass):
 
     def should_apply(self, program, ctx):
         mesh = getattr(ctx, "mesh", None)
-        if mesh is None or "mp" not in getattr(mesh, "axis_names", ()):
+        if mesh is None:
             return False
-        return has_tp_marks(program)
+        axes = getattr(mesh, "axis_names", ())
+        if "mp" in axes and has_tp_marks(program):
+            return True
+        return "ep" in axes and has_ep_marks(program)
 
     def apply(self, program, ctx):
         import re
@@ -450,9 +479,26 @@ class ShardingPropagationPass(Pass):
         from ..monitor import stat_set
 
         mesh = ctx.mesh
-        mp_degree = int(mesh.shape["mp"])
+        axes_present = set(getattr(mesh, "axis_names", ()))
+        mp_degree = int(mesh.shape["mp"]) if "mp" in axes_present else 1
+        ep_degree = int(mesh.shape["ep"]) if "ep" in axes_present else 1
         block = program.global_block
         ops = block.ops
+
+        want_ep = self._read_ep_degree(ops)
+        if want_ep is not None:
+            if "ep" not in axes_present:
+                raise ValueError(
+                    "this program was built with DistributedStrategy."
+                    "expert_parallel but the executor's mesh has no "
+                    "'ep' axis; rebuild it with init_parallel_env("
+                    "mesh_shape=(dp, ep), axis_names=('dp', 'ep')) or "
+                    "FLAGS_ep_degree")
+            if want_ep and want_ep != ep_degree:
+                raise ValueError(
+                    f"expert_parallel_degree={want_ep} but the active "
+                    f"mesh's 'ep' axis has {ep_degree} devices; rebuild "
+                    f"the mesh or unset the degree")
 
         rules, want_degree = self._read_config(ops)
         if want_degree and want_degree != mp_degree:
@@ -512,20 +558,55 @@ class ShardingPropagationPass(Pass):
             specs[wname] = spec
             n_sharded += 1
 
+        # -- 1c. moe expert weights shard over 'ep' --------------------
+        # stacked expert carriers ([E, ...] with E = num_experts on dim
+        # 0) of every moe_ffn op seed P('ep', None, ...) — no partition
+        # rule needed, the op IS the request; an expert count not
+        # divisible by the ep degree falls back replicated like any
+        # rule match (the op then runs all experts on every chip)
+        n_moe = 0
+        if "ep" in axes:
+            for op in ops:
+                if op.type != "moe_ffn":
+                    continue
+                for slot in ("W1", "B1", "W2", "B2"):
+                    wname = op.inputs.get(slot, [None])[0]
+                    if not wname or wname in specs:
+                        continue
+                    var = block._find_var_recursive(wname)
+                    if var is None or not var.shape:
+                        continue
+                    if int(var.shape[0]) % ep_degree != 0:
+                        n_fallback += 1
+                        continue
+                    specs[wname] = ("ep",) + (None,) * (len(var.shape) - 1)
+                    n_sharded += 1
+                    n_moe += 1
+
         # -- 2. optimizer slots inherit their param's spec -------------
         self._inherit_slots(block, ops, specs, has_dp="dp" in axes)
 
         # -- 3+4. propagate, stamp anchors and grad collectives --------
         grad_reduce = self._propagate(block, ops, dict(specs), ctx,
-                                      mp_degree, has_dp="dp" in axes)
+                                      mp_degree, has_dp="dp" in axes,
+                                      ep_degree=ep_degree)
+
+        # -- 3b. strict ep-flow walk: refuse consumers of ep-sharded
+        # state outside the routed-FFN family (the mp-flow-walk idiom)
+        if ep_degree > 1:
+            self._check_ep_consumers(ops, specs)
 
         program._tp_plan = TPShardingPlan(
             specs, mp_degree, grad_reduce=grad_reduce,
-            n_sharded=n_sharded, n_fallback=n_fallback)
+            n_sharded=n_sharded, n_fallback=n_fallback,
+            ep_degree=ep_degree)
         program._bump()
         stat_set("pass_tp_sharded_vars", n_sharded)
         stat_set("pass_tp_fallback_replicated", n_fallback)
         stat_set("pass_tp_mp_degree", mp_degree)
+        if "ep" in axes:
+            stat_set("pass_ep_sharded_weights", n_moe)
+            stat_set("pass_ep_degree", ep_degree)
         return True
 
     # -- helpers -----------------------------------------------------------
@@ -540,6 +621,45 @@ class ShardingPropagationPass(Pass):
                     rules.append((pat, spec))
                 return rules, int(op.attr(TP_DEGREE_ATTR, 0) or 0)
         return [], 0
+
+    @staticmethod
+    def _read_ep_degree(ops):
+        """The ExpertParallelMetaOptimizer stamp: the required ep degree
+        (0 = any), or None when the program is not ep-marked."""
+        for op in ops:
+            deg = op.attr(EP_DEGREE_ATTR)
+            if deg is not None:
+                return int(deg)
+        return None
+
+    @staticmethod
+    def _check_ep_consumers(ops, specs):
+        """An ep-sharded var holds only this chip's experts — any op
+        outside the routed-FFN family reading one would silently compute
+        on a 1/ep slice as if it were the whole tensor.  Refuse at plan
+        time, naming the op and var (the PR 15 mp-flow-walk idiom)."""
+        from ..distributed.fleet.meta_optimizers import _OPTIMIZER_OP_TYPES
+
+        ep_vars = {n for n, sp in specs.items() if "ep" in sp}
+        ep_vars |= {n + GRAD_SUFFIX_TP for n in list(ep_vars)}
+        allowed = {"moe_ffn", "moe_ffn_grad", "c_allreduce_sum", "sum",
+                   "cast", "assign", "scale", "share_buffer",
+                   "dequant_matmul"} | set(_OPTIMIZER_OP_TYPES)
+        for op in ops:
+            if op.type in allowed:
+                continue
+            for names in op.inputs.values():
+                for n in names:
+                    if n in ep_vars:
+                        raise ValueError(
+                            f"op {op.type!r} consumes expert-parallel-"
+                            f"sharded var {n!r} (P('ep', ...)): each "
+                            f"chip holds only 1/ep of the experts, so "
+                            f"only the routed-FFN family (moe_ffn / "
+                            f"moe_ffn_grad), gradient collectives, and "
+                            f"optimizer ops may read it — keep the "
+                            f"computation inside the expert FFN or "
+                            f"replicate the var")
 
     @staticmethod
     def _match(compiled_rules, name):
@@ -617,7 +737,8 @@ class ShardingPropagationPass(Pass):
                         # the optimizer-state-over-dp layout
                         specs[nm] = ("dp",) + (None,) * (len(var.shape) - 1)
 
-    def _propagate(self, block, ops, known, ctx, mp_degree, has_dp=True):
+    def _propagate(self, block, ops, known, ctx, mp_degree, has_dp=True,
+                   ep_degree=1):
         """Forward spec walk over the op stream.  ``known`` maps var
         name -> spec tuple (entries None|'dp'|'mp'); feeds seed 'dp' on
         their batch dim (when the mesh has one).  Returns the per-grad
@@ -668,6 +789,30 @@ class ShardingPropagationPass(Pass):
                         known.pop(n, None)
             elif op.type in ("lookup_table", "lookup_table_v2"):
                 self._prop_lookup(op, known, mp_degree)
+            elif op.type == "moe_ffn":
+                # tokens go in and come out in caller order — Out rides
+                # X's spec; AuxLoss/ExpertLoad are replicated scalars/
+                # vectors.  When the expert stack was ep-sharded, stamp
+                # the op so the lowering pins the [E, C, D] dispatch
+                # buffer to 'ep' (the all-to-all anchor) and the phase
+                # ledger can price the wire (COMM_ID_ATTR identity).
+                xs = op.inputs.get("X", [None])[0]
+                spec = known.get(xs) if xs else None
+                out = op.outputs.get("Out", [None])[0]
+                if out:
+                    if spec is not None and self._rank_ok(block, out, spec):
+                        known[out] = spec
+                    else:
+                        known.pop(out, None)
+                for slot in ("AuxLoss", "ExpertLoad"):
+                    n = op.outputs.get(slot, [None])[0]
+                    if n:
+                        known.pop(n, None)
+                w1 = op.inputs.get("W1", [None])[0]
+                if w1 and "ep" in (known.get(w1) or ()):
+                    op.attrs[MOE_EP_ATTR] = int(ep_degree)
+                    if not op.attr(COMM_ID_ATTR):
+                        op.attrs[COMM_ID_ATTR] = f"moe:{out}"
             elif op.type == "c_allreduce_sum":
                 # transpiler grad collective: identity under GSPMD (the
                 # grad is already the global sum); stamp the grad's spec
@@ -682,8 +827,13 @@ class ShardingPropagationPass(Pass):
                             dtypes.to_str(var.dtype))
                     except (KeyError, ValueError):
                         continue
+                    shard_div = 1
                     if spec and "mp" in spec:
-                        nbytes //= mp_degree
+                        shard_div *= mp_degree
+                    if spec and "ep" in spec:
+                        shard_div *= ep_degree
+                    if shard_div > 1:
+                        nbytes //= shard_div
                         op.attrs[TP_SPEC_ATTR] = encode_spec(spec)
                     grad_reduce[g] = {"axes": ("dp",), "bytes": nbytes}
                 continue
@@ -700,6 +850,15 @@ class ShardingPropagationPass(Pass):
                     if wspec and wspec[0] == "mp" \
                             and not any(s == "mp" for s in wspec[1:]):
                         op.attrs[EMB_SHARD_ATTR] = int(mp_degree)
+                elif op.type == "moe_ffn_grad":
+                    # mirror the forward stamp: the generic-vjp lowering
+                    # re-emits the forward from the GRAD op's own attrs
+                    # (copied at backward-build time, before this pass
+                    # ran) — without it the recomputed forward would
+                    # skip the ep all-to-all anchors
+                    w1 = op.inputs.get("W1", [None])[0]
+                    if w1 and "ep" in (known.get(w1) or ()):
+                        op.attrs[MOE_EP_ATTR] = int(ep_degree)
                 # the gradient of a var shares its var's layout (the
                 # Megatron memo: dW of a column-parallel W is itself
                 # column-parallel); unknown bases reset to unknown

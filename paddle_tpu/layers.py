@@ -64,6 +64,7 @@ __all__ = [
     "mul",
     "matmul",
     "fused_multihead_attention",
+    "moe_ffn",
     "scale",
     "clip",
     "clip_by_norm",
@@ -175,6 +176,47 @@ def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None, act=Non
         )
         out = out2
     return helper.append_activation(out, act)
+
+
+def moe_ffn(input, num_experts, ffn_dim=None, top_k=2,
+            capacity_factor=1.25, param_attr=None, bias_attr=None,
+            gate_attr=None, name=None):
+    """Mixture-of-experts routed FFN (ops/moe_ops.py): top-k routing
+    with capacity-factor dispatch over ``num_experts`` stacked expert
+    FFNs.  Returns ``(out, aux_loss, expert_load)`` — add ``aux_loss``
+    (Switch load-balance loss) into the training loss; ``expert_load``
+    is the per-expert kept-token count gauge (stop-gradient)."""
+    helper = LayerHelper("moe_ffn", name=name)
+    d = int(input.shape[-1])
+    h = int(ffn_dim or 4 * d)
+    e = int(num_experts)
+    gate_w = helper.create_parameter(
+        gate_attr, [d, e], dtype=input.dtype_str,
+        default_initializer=NormalInitializer(0.0, 0.02))
+    w1 = helper.create_parameter(param_attr, [e, d, h],
+                                 dtype=input.dtype_str)
+    b1 = helper.create_parameter(bias_attr, [e, h],
+                                 dtype=input.dtype_str, is_bias=True)
+    w2 = helper.create_parameter(param_attr, [e, h, d],
+                                 dtype=input.dtype_str)
+    b2 = helper.create_parameter(bias_attr, [e, d],
+                                 dtype=input.dtype_str, is_bias=True)
+    out = helper.create_variable_for_type_inference(input.dtype_str)
+    out.shape = tuple(input.shape)
+    aux = helper.create_variable_for_type_inference("float32")
+    aux.shape = (1,)
+    load = helper.create_variable_for_type_inference(
+        "float32", stop_gradient=True)
+    load.shape = (e,)
+    helper.append_op(
+        "moe_ffn",
+        {"X": input, "GateW": gate_w, "W1": w1, "B1": b1,
+         "W2": w2, "B2": b2},
+        {"Out": out, "AuxLoss": aux, "ExpertLoad": load},
+        {"num_experts": e, "top_k": int(top_k),
+         "capacity_factor": float(capacity_factor)},
+    )
+    return out, aux, load
 
 
 def conv2d(

@@ -101,6 +101,12 @@ RULES = (
          "Tensor-parallel constraint/fallback counters"),
     Rule("collective_matmul_", "gauge", "tensor_parallel",
          "Collective-matmul chunking engagement/fallbacks"),
+    Rule("ep_", "gauge", "expert_parallel",
+         "Expert-parallel ('ep' axis) mesh/plan bookkeeping"),
+    Rule("moe_", "gauge", "expert_parallel",
+         "Mixture-of-experts routing: expert balance and drop "
+         "fractions (ppm), routed-FFN engagement, all-to-all "
+         "chunking engagement/fallbacks"),
     Rule("flash_attention_", "gauge", "kernels",
          "Flash-attention kernel engagement"),
     Rule("quant_", "gauge", "quantization",

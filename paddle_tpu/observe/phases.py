@@ -72,7 +72,8 @@ BUCKETS = ("compute", "comm_exposed", "host", "input_wait")
 
 
 def collective_inventory(block, op_list, mesh=None, tp_plan=None,
-                         cm_chunks: int = 0) -> List[dict]:
+                         cm_chunks: int = 0,
+                         moe_chunks: int = 0) -> List[dict]:
     """Per-collective entries from the post-pass op stream, in program
     order: ``{"id", "op", "dtype", "bytes", "overlap"}``.
 
@@ -92,16 +93,22 @@ def collective_inventory(block, op_list, mesh=None, tp_plan=None,
     the grad payload and the plan entries are skipped (no double
     count).
     """
+    import math
+
     import numpy as np
 
     from ..framework import dtypes as _dtypes
     from ..framework.passes import (COMM_ID_ATTR, COMM_OVERLAP_ATTR,
-                                    LAYER_STACK_ATTR, TP_CONSTRAINT_ATTR,
-                                    TP_SPEC_ATTR, decode_anchor)
+                                    LAYER_STACK_ATTR, MOE_EP_ATTR,
+                                    TP_CONSTRAINT_ATTR, TP_SPEC_ATTR,
+                                    decode_anchor)
 
     mp_degree = 1
     if mesh is not None and "mp" in getattr(mesh, "axis_names", ()):
         mp_degree = int(mesh.shape["mp"])
+    ep_degree = 1
+    if mesh is not None and "ep" in getattr(mesh, "axis_names", ()):
+        ep_degree = int(mesh.shape["ep"])
 
     def _var_bytes(name):
         var = block._find_var_recursive(name)
@@ -145,6 +152,57 @@ def collective_inventory(block, op_list, mesh=None, tp_plan=None,
                             "overlap": i < cm_chunks - 1,
                         })
                 continue
+        if ep_degree > 1 and op.type in ("moe_ffn", "moe_ffn_grad") \
+                and op.attr(MOE_EP_ATTR):
+            # expert-parallel dispatch + combine all-to-all pair over
+            # the [E, capacity, D] buffer (ops/moe_ops.py).  Capacity
+            # is re-derived from the DECLARED shapes (symbolic batch
+            # dims price per-sample — the same convention as the IR
+            # FLOP estimate); with FLAGS_moe_alltoall_chunks on, each
+            # all-to-all splits into capacity chunks where every chunk
+            # but the last overlaps the next chunk's expert compute.
+            w1 = op.inputs.get("W1", [None])[0]
+            xn = op.inputs.get("X", [None])[0]
+            wvar = block._find_var_recursive(w1) if w1 else None
+            xvar = block._find_var_recursive(xn) if xn else None
+            if wvar is None or xvar is None or len(wvar.shape) != 3:
+                continue
+            e, d = int(wvar.shape[0]), int(wvar.shape[1])
+            tokens = 1
+            symbolic = False
+            for s in xvar.shape[:-1]:
+                if int(s) < 0:
+                    symbolic = True
+                tokens *= max(int(s), 1)
+            k_top = int(op.attr("top_k", 1) or 1)
+            cf = float(op.attr("capacity_factor", 1.0) or 1.0)
+            cap = max(1, int(math.ceil(tokens * k_top * cf / e)))
+            try:
+                np_dt = _dtypes.to_np(xvar.dtype)
+                itemsize = np.dtype(np_dt).itemsize
+                dt = str(np.dtype(np_dt))
+            except (KeyError, ValueError, TypeError):
+                continue
+            total = e * cap * d * itemsize
+            # Symbolic batch prices per-sample (cap collapses to ~1), so
+            # the runtime divisibility test is meaningless here: trust
+            # the flag and let the moe_alltoall_fallback counter record
+            # whether the traced capacity actually engaged chunking.
+            k = moe_chunks if (moe_chunks and moe_chunks > 1
+                               and (symbolic or cap % moe_chunks == 0)) \
+                else 1
+            base = str(op.attr(COMM_ID_ATTR, "") or "") \
+                or f"moe:{op.type}"
+            for leg in ("dispatch", "combine"):
+                for i in range(k):
+                    entries.append({
+                        "id": f"{base}:a2a_{leg}@{i}",
+                        "op": "ep_alltoall",
+                        "dtype": dt,
+                        "bytes": total // k,
+                        "overlap": i < k - 1,
+                    })
+            continue
         if op.type not in COLLECTIVE_OPS:
             continue
         names = op.input_arg_names()
@@ -261,7 +319,8 @@ class PhasePlan:
 
 def build_phase_plan(block, op_list, mesh=None, tp_plan=None,
                      flops_per_step: float = 0.0,
-                     cm_chunks: int = 0) -> Optional["PhasePlan"]:
+                     cm_chunks: int = 0,
+                     moe_chunks: int = 0) -> Optional["PhasePlan"]:
     """Build a :class:`PhasePlan` for one compiled program (called from
     ``Executor._compile``); None when attribution is off.  Never raises
     — a cost-model failure must not fail a compile."""
@@ -269,7 +328,8 @@ def build_phase_plan(block, op_list, mesh=None, tp_plan=None,
         return None
     try:
         inv = collective_inventory(block, op_list, mesh=mesh,
-                                   tp_plan=tp_plan, cm_chunks=cm_chunks)
+                                   tp_plan=tp_plan, cm_chunks=cm_chunks,
+                                   moe_chunks=moe_chunks)
         return PhasePlan(flops_per_step, inv)
     except Exception:  # noqa: BLE001 - telemetry only
         stat_add("phase_plan_errors")

@@ -22,6 +22,7 @@ from . import (  # noqa: F401
     math_ops,
     misc,
     misc_ops,
+    moe_ops,
     nms_ops,
     nn_ops,
     optimizer_ops,

@@ -1,0 +1,264 @@
+"""Mixture-of-experts routed FFN: top-k routing, capacity-factor
+dispatch, stacked per-expert einsums, all-to-all combine.
+
+Role parity: the reference's incubate MoE layer (distributed expert
+parallelism over its fleet collectives).  TPU-native shape (GShard/
+Switch lineage): the router scores every token against E experts,
+keeps the top-k gates, and DISPATCHES tokens into a dense
+[E, capacity, D] buffer — a static shape, so one compiled executable
+serves every routing outcome; tokens past an expert's capacity are
+DROPPED (their combine weight is zero, so the residual stream simply
+passes them through unchanged).  Expert FFNs run as ONE stacked einsum
+per chip over the locally-resident experts ([E, D, H] weights), and
+the combine einsum scatters expert outputs back to token order.
+
+Expert parallelism is pure GSPMD: when the plan stamped the op
+(``__moe_ep__``) and the mesh has an 'ep' axis, the [E, C, D] dispatch
+buffer is sharding-constrained to ``P('ep', None, None)`` — XLA
+materializes the dispatch all-to-all in front of the expert compute
+and the combine all-to-all behind it.  Latency hiding generalizes the
+PR 15 collective-matmul chunking to all-to-all: slice the CAPACITY
+axis into FLAGS_moe_alltoall_chunks chunks, so chunk k's all-to-all
+overlaps chunk k+1's expert einsums.  Chunk outputs are CONCATENATED
+and combined once — every (e, c) slot's compute is independent along
+the capacity axis, so chunked and sequential schedules are
+bitwise-identical by construction (the A/B the bench asserts).
+
+The pure-jnp reference (``moe_ffn_ref``) is the CPU/tier-1 default and
+the only path tier-1 exercises — no Pallas anywhere in this op.  The
+router's aux loss is the Switch load-balance loss
+``E * sum_e f_e * P_e`` (f_e = fraction of tokens whose TOP-1 choice
+is e, P_e = mean router probability of e): differentiable through
+P_e, so the generic vjp gives the router gradient for free.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..framework.lowering import register_lower
+
+__all__ = [
+    "moe_capacity",
+    "moe_router_ref",
+    "moe_ffn_ref",
+    "moe_balance_gauges",
+]
+
+
+def moe_capacity(num_tokens: int, num_experts: int, top_k: int,
+                 capacity_factor: float) -> int:
+    """Static per-expert slot count: ceil(S*K/E * factor), >= 1."""
+    return max(1, int(math.ceil(
+        num_tokens * top_k * capacity_factor / num_experts)))
+
+
+# ---------------------------------------------------------------------------
+# router (pure jnp; shared by training lowering and serving)
+# ---------------------------------------------------------------------------
+
+
+def moe_router_ref(x2d, gate_w, *, num_experts, top_k, capacity_factor):
+    """Route [S, D] tokens: returns (combine [S,E,C] f32, aux_loss
+    scalar, expert_load [E] f32 kept-token counts).
+
+    Deterministic: ties in top-k resolve by lax.top_k's stable index
+    order, and capacity slots are claimed in (choice, token) order —
+    choice 0 of every token outranks choice 1 of any token, and within
+    a choice lower token index wins (the GShard priority rule).
+    """
+    s = x2d.shape[0]
+    e = int(num_experts)
+    k = int(top_k)
+    cap = moe_capacity(s, e, k, capacity_factor)
+
+    logits = jnp.einsum("sd,de->se", x2d.astype(jnp.float32),
+                        gate_w.astype(jnp.float32))
+    probs = jax.nn.softmax(logits, axis=-1)                    # [S, E]
+    gate_vals, gate_idx = lax.top_k(probs, k)                  # [S, K]
+    gate_vals = gate_vals / jnp.maximum(
+        jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9)
+
+    combine = jnp.zeros((s, e, cap), jnp.float32)
+    counts = jnp.zeros((e,), jnp.float32)   # slots claimed per expert
+    for choice in range(k):
+        oh = jax.nn.one_hot(gate_idx[:, choice], e,
+                            dtype=jnp.float32)                 # [S, E]
+        # slot index of each token within its expert: tokens of this
+        # choice queue behind every earlier choice's claims
+        pos = jnp.cumsum(oh, axis=0) - oh + counts[None, :]    # [S, E]
+        slot = jnp.sum(pos * oh, axis=-1)                      # [S]
+        # one_hot zeroes out-of-range slots, so slot >= cap == dropped
+        slot_oh = jax.nn.one_hot(slot, cap, dtype=jnp.float32)
+        slot_oh = slot_oh * jnp.sum(oh, axis=-1, keepdims=True)
+        combine = combine + (gate_vals[:, choice, None, None]
+                             * oh[:, :, None] * slot_oh[:, None, :])
+        counts = counts + jnp.sum(oh, axis=0)
+
+    expert_load = jnp.sum(combine > 0.0, axis=(0, 2)).astype(jnp.float32)
+    # Switch aux loss: top-1 assignment fraction x mean router prob
+    f = jnp.mean(jax.nn.one_hot(gate_idx[:, 0], e, dtype=jnp.float32),
+                 axis=0)
+    p = jnp.mean(probs, axis=0)
+    aux_loss = jnp.asarray(e, jnp.float32) * jnp.sum(
+        lax.stop_gradient(f) * p)
+    return combine, aux_loss, expert_load
+
+
+# ---------------------------------------------------------------------------
+# expert FFN body
+# ---------------------------------------------------------------------------
+
+
+def _expert_ffn(dispatched, w1, b1, w2, b2):
+    """[E, C', D] dispatched slots -> [E, C', D] expert outputs; one
+    stacked einsum pair over the locally-resident experts."""
+    h = jnp.einsum("ecd,edh->ech", dispatched, w1) + b1[:, None, :]
+    h = jax.nn.gelu(h)
+    return jnp.einsum("ech,ehd->ecd", h, w2) + b2[:, None, :]
+
+
+def _ep_constraint(val, mesh, spec):
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    return lax.with_sharding_constraint(
+        val, NamedSharding(mesh, PartitionSpec(*spec)))
+
+
+def moe_ffn_ref(x, gate_w, w1, b1, w2, b2, *, num_experts, top_k,
+                capacity_factor, mesh=None, ep=False, chunks=0):
+    """Full routed FFN over x [..., D] -> (out [..., D], aux_loss,
+    expert_load [E]).  ``ep=True`` + a mesh with an 'ep' axis adds the
+    GSPMD sharding constraints that materialize the dispatch/combine
+    all-to-alls; ``chunks`` > 1 slices the capacity axis (bitwise-equal
+    to the sequential schedule, see module docstring)."""
+    lead = x.shape[:-1]
+    d = x.shape[-1]
+    x2d = x.reshape((-1, d))
+    combine, aux_loss, expert_load = moe_router_ref(
+        x2d, gate_w, num_experts=num_experts, top_k=top_k,
+        capacity_factor=capacity_factor)
+    cap = combine.shape[-1]
+    dispatch = (combine > 0.0).astype(x2d.dtype)               # [S,E,C]
+    combine = combine.astype(x2d.dtype)
+
+    use_ep = bool(ep) and mesh is not None and "ep" in getattr(
+        mesh, "axis_names", ())
+    k = int(chunks or 0)
+    chunked = k > 1 and cap % k == 0
+
+    def body(disp_slice):
+        buf = jnp.einsum("sec,sd->ecd", disp_slice, x2d)
+        if use_ep:
+            buf = _ep_constraint(buf, mesh, ("ep", None, None))
+        y = _expert_ffn(buf, w1, b1, w2, b2)
+        if use_ep:
+            y = _ep_constraint(y, mesh, ("ep", None, None))
+        return y
+
+    if chunked:
+        cc = cap // k
+        y = jnp.concatenate(
+            [body(dispatch[:, :, i * cc:(i + 1) * cc])
+             for i in range(k)], axis=1)
+    else:
+        y = body(dispatch)
+    out = jnp.einsum("sec,ecd->sd", combine, y)
+    if use_ep:
+        # token order is the caller's layout again: pin it replicated
+        # over 'ep' so the combine all-to-all lands HERE, not later
+        out = _ep_constraint(out, mesh, (None, None))
+    return out.reshape(lead + (d,)), aux_loss, expert_load, chunked
+
+
+# ---------------------------------------------------------------------------
+# gauges (host-side; bench + serving)
+# ---------------------------------------------------------------------------
+
+
+def moe_balance_gauges(expert_load, num_tokens: int, top_k: int,
+                       publish: bool = True):
+    """Utilization gauges from one step's kept-token counts: balance =
+    mean/max load in ppm (1e6 = perfectly even), dropped fraction of
+    routed assignments in ppm.  Published via monitor stat_set."""
+    import numpy as np
+
+    load = np.asarray(expert_load, dtype=np.float64)
+    routed = float(max(1, num_tokens * top_k))
+    kept = float(load.sum())
+    balance = float(load.mean() / load.max()) if load.max() > 0 else 0.0
+    gauges = {
+        "moe_expert_balance_ppm": int(balance * 1e6),
+        "moe_dropped_fraction_ppm": int(
+            max(0.0, 1.0 - kept / routed) * 1e6),
+    }
+    if publish:
+        from ..monitor import stat_set
+
+        for key, val in gauges.items():
+            stat_set(key, val)
+    return gauges
+
+
+# ---------------------------------------------------------------------------
+# lowering
+# ---------------------------------------------------------------------------
+
+
+def _dequant_stacked(carrier, scale):
+    """Per-expert per-output-channel dequant of a stacked [E, *, O]
+    carrier with scale [E, O] (ops/quant_ops.quantize_weight_stacked)."""
+    return carrier.astype(scale.dtype) * scale[:, None, :]
+
+
+@register_lower("moe_ffn")
+def _moe_ffn_lower(ctx, op):
+    from ..framework import flags as _flags
+    from ..framework.passes import MOE_EP_ATTR
+    from ..monitor import stat_add
+
+    x = ctx.in1(op, "X")
+    gate_w = ctx.in1(op, "GateW")
+    w1 = ctx.in1(op, "W1")
+    b1 = ctx.in1(op, "B1")
+    w2 = ctx.in1(op, "W2")
+    b2 = ctx.in1(op, "B2")
+    s1 = ctx.in1(op, "W1Scale")
+    s2 = ctx.in1(op, "W2Scale")
+    if s1 is not None:
+        w1 = _dequant_stacked(w1, s1)
+    if s2 is not None:
+        w2 = _dequant_stacked(w2, s2)
+
+    chunks = int(_flags.flag("moe_alltoall_chunks") or 0)
+    ep = bool(op.attr(MOE_EP_ATTR, False))
+    manual = bool(getattr(ctx, "axis_env", ()) or ())
+    if ep and manual:
+        # The GPipe pipeline traces inside a shard_map with EVERY mesh
+        # axis manual, where GSPMD sharding constraints are illegal —
+        # and a manual slab/psum expert split would need the router's
+        # gate gradient psum'd over 'ep', which the pipeline's grad
+        # accumulation (dp-only) does not do.  Experts therefore stay
+        # REPLICATED inside pipeline stages: each rank computes the
+        # full routed FFN bitwise-identically, the plan's ep marks
+        # still price the intended all-to-alls in the ledger, and this
+        # counter records the runtime fallback.
+        stat_add("moe_ep_manual_replicated")
+        ep = False
+    out, aux, load, chunked = moe_ffn_ref(
+        x, gate_w, w1, b1, w2, b2,
+        num_experts=int(op.attr("num_experts")),
+        top_k=int(op.attr("top_k", 1)),
+        capacity_factor=float(op.attr("capacity_factor", 1.0)),
+        mesh=ctx.mesh, ep=ep, chunks=chunks)
+    stat_add("moe_ffn_engaged")
+    if chunked:
+        stat_add("moe_alltoall_chunked")
+    elif chunks > 1:
+        stat_add("moe_alltoall_fallback")
+    ctx.set_out(op, "Out", out)
+    ctx.set_out(op, "AuxLoss", jnp.reshape(aux, (1,)))
+    ctx.set_out(op, "ExpertLoad", load)

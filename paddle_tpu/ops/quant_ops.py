@@ -281,6 +281,47 @@ def dequantize_weight(q, scale, axis: int, dtype=jnp.float32):
             * scale.astype(jnp.float32).reshape(bshape)).astype(dtype)
 
 
+def quantize_weight_stacked(w, axis: int, mode: str = "int8"):
+    """Per-expert variant of :func:`quantize_weight` for stacked
+    ``[E, ...]`` MoE weights: the scale keeps BOTH the leading stack
+    axis and the output-channel ``axis`` (shape ``[E, out]``), so each
+    expert calibrates its own step sizes — a shared scale would let one
+    hot expert's outliers crush every other expert's resolution.  The
+    ``[E, out]`` layout also shards alongside the carrier: carrier
+    ``P('ep', ...)`` pairs with scale ``P('ep', None)``."""
+    w = jnp.asarray(w)
+    if w.ndim < 2 or axis == 0:
+        raise ValueError(
+            f"stacked quantization needs a [E, ...] weight with an "
+            f"output-channel axis != 0, got shape {w.shape} axis {axis}")
+    mode = resolve_quant_mode(mode)
+    red = tuple(i for i in range(w.ndim) if i not in (0, axis))
+    qmax = INT8_QMAX if mode == "int8" else FP8_E4M3_MAX
+    scale = _clamp_scale(jnp.max(jnp.abs(w), axis=red) / qmax)
+    bshape = [1] * w.ndim
+    bshape[0] = w.shape[0]
+    bshape[axis] = w.shape[axis]
+    scaled = w / scale.reshape(bshape)
+    if mode == "int8":
+        q = jnp.clip(jnp.round(scaled), -INT8_QMAX, INT8_QMAX) \
+            .astype(jnp.int8)
+    else:
+        from ..framework import jax_compat
+
+        fp8 = jax_compat.float8_e4m3_dtype()
+        q = jnp.clip(scaled, -FP8_E4M3_MAX, FP8_E4M3_MAX).astype(fp8)
+    return q, scale.astype(jnp.float32)
+
+
+def dequantize_weight_stacked(q, scale, axis: int, dtype=jnp.float32):
+    """Inverse of :func:`quantize_weight_stacked`."""
+    bshape = [1] * q.ndim
+    bshape[0] = q.shape[0]
+    bshape[axis] = q.shape[axis]
+    return (q.astype(jnp.float32)
+            * scale.astype(jnp.float32).reshape(bshape)).astype(dtype)
+
+
 def _dequant_matmul_kernel(x_ref, w_ref, s_ref, o_ref, acc_scr, *, n_k):
     """One (bm, bn) output tile: accumulate x_tile @ dequant(w_tile)
     over the K grid axis.  The carrier tile is dequantized in VMEM —

@@ -349,6 +349,15 @@ WEIGHT_QUANT_ATTR = "__weight_quant__"
 # simulation path — its filter layout needs its own kernel story)
 _WQ_OPS = ("mul", "matmul", "matmul_v2")
 
+# MoE expert FFNs quantize IN PLACE: the stacked [E, in, out] weights
+# become int8 carriers + per-expert [E, out] scales riding new
+# W1Scale/W2Scale input slots that the moe_ffn lowering dequantizes at
+# the einsum's doorstep (ops/moe_ops.py _dequant_stacked) — no op
+# replacement, so the router/combine semantics are untouched and the
+# expert-parallel plan spec P('ep', ...) transfers to the carrier
+_WQ_MOE_OPS = ("moe_ffn",)
+_WQ_MOE_SLOTS = ("W1", "W2")  # output-channel axis 2 for both
+
 _CARRIER_SUFFIX = "@WQ"
 _SCALE_SUFFIX = "@WQ_SCALE"
 
@@ -364,7 +373,7 @@ def mark_weight_quant(program: Program, mode: str = "int8") -> Program:
             f"unknown weight-quant mode {mode!r}; expected one of "
             f"{WEIGHT_QUANT_MODES}")
     for op in program.global_block.ops:
-        if op.type in _WQ_OPS:
+        if op.type in _WQ_OPS or op.type in _WQ_MOE_OPS:
             op.attrs[WEIGHT_QUANT_ATTR] = mode
     program._bump()
     return program
@@ -429,7 +438,7 @@ class PostTrainingWeightQuantPass(Pass):
     def should_apply(self, program, ctx) -> bool:
         if ctx.scope is None or self._mode(program) is None:
             return False
-        return any(op.type in _WQ_OPS
+        return any(op.type in _WQ_OPS or op.type in _WQ_MOE_OPS
                    for op in program.global_block.ops)
 
     @staticmethod
@@ -461,6 +470,59 @@ class PostTrainingWeightQuantPass(Pass):
                 return (xs[0], v) if v is not None else (None, None)
         return None, None
 
+    def _quantize_moe(self, op, block, scope, plan, mode,
+                      quantized) -> Tuple[int, int]:
+        """Quantize one moe_ffn op's stacked expert weights in place:
+        W1/W2 -> int8 carrier + per-expert [E, out] scale riding the
+        W1Scale/W2Scale input slots the lowering already consumes.
+        Returns (n_rewritten_slots, n_skipped_slots)."""
+        from ..ops.quant_ops import quantize_weight_stacked
+
+        n_done = n_skip = 0
+        for slot in _WQ_MOE_SLOTS:
+            names = op.input(slot)
+            if len(names) != 1:
+                n_skip += 1
+                continue
+            wname = names[0]
+            wvar = block._find_var_recursive(wname)
+            if wvar is None or len(getattr(wvar, "shape", ())) != 3 \
+                    or not (isinstance(wvar, Parameter)
+                            or getattr(wvar, "persistable", False)) \
+                    or not scope.has_var(wname):
+                n_skip += 1
+                continue
+            axis = 2  # [E, in, out] for W1 and W2 alike
+            cached = quantized.get(wname)
+            if cached is None:
+                carrier = wname + _CARRIER_SUFFIX
+                scale = wname + _SCALE_SUFFIX
+                q, s = quantize_weight_stacked(
+                    scope.get_var(wname), axis, mode)
+                scope.set_var(carrier, q)
+                scope.set_var(scale, s)
+                block.create_var(
+                    name=carrier, shape=list(wvar.shape),
+                    dtype="int8", persistable=True, stop_gradient=True)
+                block.create_var(
+                    name=scale,
+                    shape=[int(wvar.shape[0]), int(wvar.shape[axis])],
+                    dtype="float32", persistable=True,
+                    stop_gradient=True)
+                if plan is not None and wname in plan.specs:
+                    wspec = tuple(plan.specs[wname])
+                    plan.specs[carrier] = wspec
+                    # expert axis 0 shards; output channels replicate
+                    plan.specs[scale] = (wspec[0], None)
+                quantized[wname] = cached = (carrier, scale)
+            carrier, scale = cached
+            op.inputs[slot] = [carrier]
+            op.inputs[slot + "Scale"] = [scale]
+            n_done += 1
+        if n_done:
+            op.attrs["mode"] = mode
+        return n_done, n_skip
+
     def apply(self, program, ctx) -> bool:
         from ..framework import dtypes
         from ..monitor import stat_add
@@ -473,6 +535,12 @@ class PostTrainingWeightQuantPass(Pass):
         quantized: Dict[str, Tuple[str, str]] = {}
         n_rewritten = n_skipped = 0
         for i, op in enumerate(list(block.ops)):
+            if op.type in _WQ_MOE_OPS:
+                nd, ns = self._quantize_moe(op, block, scope, plan, mode,
+                                            quantized)
+                n_rewritten += nd
+                n_skipped += ns
+                continue
             if op.type not in _WQ_OPS:
                 continue
             ys = op.input("Y")

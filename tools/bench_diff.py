@@ -39,10 +39,11 @@ __all__ = ["load_round", "classify", "diff_rounds", "main"]
 _HIGHER = re.compile(
     r"(per_sec|_rps$|vs_baseline|speedup|goodput|accept|hit_rate|"
     r"fraction_of_synthetic|ratio$|_mfu|tokens_total|improvement|"
-    r"bitwise_ok|reroles)")
+    r"bitwise_ok|reroles|balance)")
 _LOWER = re.compile(
     r"(_seconds|_ms$|_s$|_p50|_p90|_p95|_p99|_bytes|bubble|pad_waste|"
-    r"exposed|latency|restarts|_errors|dropped|redispatch)")
+    r"exposed|latency|restarts|_errors|dropped|redispatch|"
+    r"parity_vs_oracle)")
 
 _BAD_STATUS = ("partial", "failed", "recovered")
 

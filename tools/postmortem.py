@@ -277,7 +277,7 @@ def render(bundle: str, tail: int = 15, stacks: bool = False,
                 "emb_", "dlrm_", "flash_attn_", "prefill_pad",
                 "pass_flash_attention", "phase_", "prof_",
                 "comm_exposed", "comm_hidden", "migrate_", "disagg_",
-                "autoscale_")
+                "autoscale_", "moe_", "ep_", "pass_ep")
         for ln in rows:
             if metrics or any(k in ln for k in keys):
                 w(f"  {ln}\n")
